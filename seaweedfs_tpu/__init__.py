@@ -1,0 +1,26 @@
+"""seaweedfs_tpu — a TPU-native framework with the capabilities of SeaweedFS's
+warm-storage stack (reference: eliefly/seaweedfs).
+
+The compute heart is GF(2^8) Reed-Solomon 10+4 erasure coding executed as batched
+int8 matmuls on TPU MXUs (bit-plane / Cauchy-binary formulation), wrapped in the
+same operational surface the reference exposes: volume striping (`.ec00..ec13`),
+sorted needle indexes (`.ecx`), deletion journals (`.ecj`), interval math for
+degraded reads, rebuild orchestration, and a cluster control plane.
+
+Layout (mirrors SURVEY.md §2 component inventory, TPU-first design per §7):
+  ops/      — GF(2^8) math core + JAX/Pallas RS kernels   (ref: klauspost/reedsolomon)
+  ec/       — stripe engine, interval math, shard formats (ref: weed/storage/erasure_coding)
+  storage/  — needle/volume engine, indexes, superblock   (ref: weed/storage)
+  parallel/ — device mesh, shard_map multi-chip paths     (ref: goroutine/grpc parallelism)
+  models/   — end-to-end pipelines (encode/rebuild/read)  (the "model families")
+  cluster/  — master/volume/topology control plane        (ref: weed/server, weed/topology)
+  utils/    — config, metrics, logging
+"""
+
+__version__ = "0.1.0"
+
+from seaweedfs_tpu.ec.constants import (  # noqa: F401
+    DATA_SHARDS_COUNT,
+    PARITY_SHARDS_COUNT,
+    TOTAL_SHARDS_COUNT,
+)

@@ -1,0 +1,16 @@
+"""EC constants — mirror of the reference's erasure_coding constants.
+
+[VERIFY: weed/storage/erasure_coding/ec_encoder.go — reference mount empty,
+values are upstream SeaweedFS's long-stable constants, see SURVEY.md §2.3.]
+"""
+
+DATA_SHARDS_COUNT = 10
+PARITY_SHARDS_COUNT = 4
+TOTAL_SHARDS_COUNT = DATA_SHARDS_COUNT + PARITY_SHARDS_COUNT
+
+# Two-tier striping: large rows first, then the tail as small rows.
+ERASURE_CODING_LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1 GiB
+ERASURE_CODING_SMALL_BLOCK_SIZE = 1024 * 1024  # 1 MiB
+
+# Buffer granularity the reference encodes with (WriteEcFiles' bufferSize).
+EC_BUFFER_SIZE = 256 * 1024

@@ -1,0 +1,240 @@
+"""GF(2^8) arithmetic core — host-side (numpy), the foundation of the RS codec.
+
+Field: GF(2^8) with the primitive polynomial x^8 + x^4 + x^3 + x^2 + 1
+(0x11D), generator alpha = 2 — the same field used by the reference's codec
+dependency (klauspost/reedsolomon `galois.go` [VERIFY: reference mount empty,
+see SURVEY.md §0]; upstream generates its tables from poly 0x1D low byte).
+
+Everything here is tiny (tables, 14x14 matrices) and runs on the host; the bulk
+data path lives in `rs_jax.py` / `rs_pallas.py` as MXU matmuls over the binary
+lift produced by `gf_matrix_to_bits`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+FIELD_SIZE = 256
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """exp/log tables for GF(2^8) with generator 2.
+
+    exp is doubled (512 entries) so exp[log[a]+log[b]] needs no mod.
+    """
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    log[0] = -1  # log(0) undefined; sentinel
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+# Full 256x256 multiplication table — used for host-side golden checks and for
+# building decode matrices. ~64 KiB, negligible.
+def _build_mul_table() -> np.ndarray:
+    t = np.zeros((256, 256), dtype=np.uint8)
+    for a in range(1, 256):
+        la = GF_LOG[a]
+        t[a, 1:] = GF_EXP[la + GF_LOG[1:256]]
+    return t
+
+
+GF_MUL_TABLE = _build_mul_table()
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Multiply two field elements."""
+    return int(GF_MUL_TABLE[a & 0xFF, b & 0xFF])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(GF_EXP[(GF_LOG[a] - GF_LOG[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of zero")
+    return int(GF_EXP[255 - GF_LOG[a]])
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a raised to the n-th power (klauspost `galExp` semantics)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(GF_LOG[a] * n) % 255])
+
+
+def gf_mat_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8). a: (m,k), b: (k,n) uint8 -> (m,n) uint8."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    # products[i,j,l] = a[i,l] * b[l,j]; XOR-reduce over l
+    prods = GF_MUL_TABLE[a[:, :, None], b[None, :, :]]  # (m,k,n)
+    return np.bitwise_xor.reduce(prods, axis=1)
+
+
+def gf_mat_vec(a: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Matrix-vector product over GF(2^8) applied to byte arrays.
+
+    a: (m,k) uint8 matrix; x: (k, ...) uint8 data -> (m, ...) uint8.
+    Pure-numpy golden path (slow; used by tests and tiny host-side work).
+    """
+    a = np.asarray(a, dtype=np.uint8)
+    x = np.asarray(x, dtype=np.uint8)
+    out = np.zeros((a.shape[0],) + x.shape[1:], dtype=np.uint8)
+    for i in range(a.shape[0]):
+        acc = np.zeros(x.shape[1:], dtype=np.uint8)
+        for l in range(a.shape[1]):
+            c = a[i, l]
+            if c:
+                acc ^= GF_MUL_TABLE[c][x[l]]
+        out[i] = acc
+    return out
+
+
+def gf_mat_inv(m: np.ndarray) -> np.ndarray:
+    """Invert a square matrix over GF(2^8) by Gauss-Jordan elimination.
+
+    Mirrors the role of the reference codec's `matrix.Invert` +
+    `inversion_tree.go` cache consumers [VERIFY]. Raises ValueError if singular.
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    n = m.shape[0]
+    if m.shape != (n, n):
+        raise ValueError(f"not square: {m.shape}")
+    aug = np.concatenate([m.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        # pivot
+        pivot = None
+        for r in range(col, n):
+            if aug[r, col] != 0:
+                pivot = r
+                break
+        if pivot is None:
+            raise ValueError("singular matrix over GF(2^8)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        # scale pivot row to 1
+        inv_p = gf_inv(int(aug[col, col]))
+        aug[col] = GF_MUL_TABLE[inv_p][aug[col]]
+        # eliminate all other rows
+        for r in range(n):
+            if r != col and aug[r, col] != 0:
+                aug[r] ^= GF_MUL_TABLE[int(aug[r, col])][aug[col]]
+    return aug[:, n:].copy()
+
+
+# ---------------------------------------------------------------------------
+# Generator matrices
+# ---------------------------------------------------------------------------
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """vm[r][c] = r^c — klauspost `vandermonde()` semantics [VERIFY]."""
+    m = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            m[r, c] = gf_exp(r, c)
+    return m
+
+
+@functools.lru_cache(maxsize=64)
+def build_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Systematic generator matrix, klauspost/Backblaze default construction:
+    Vandermonde(total, data) times the inverse of its top square — top `data`
+    rows become identity, bottom rows are the parity generator.
+
+    This is what `reedsolomon.New(10, 4)` (no options) uses, i.e. what the
+    reference's `weed/storage/erasure_coding` relies on [VERIFY], so shards we
+    write are byte-compatible with stock CPU nodes.
+    """
+    vm = vandermonde(total_shards, data_shards)
+    top = vm[:data_shards, :data_shards]
+    out = gf_mat_mul(vm, gf_mat_inv(top))
+    out.setflags(write=False)
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def build_matrix_cauchy(data_shards: int, total_shards: int) -> np.ndarray:
+    """Systematic Cauchy matrix (klauspost `WithCauchyMatrix` semantics):
+    identity on top; parity rows m[r][c] = 1/(r ^ c)."""
+    m = np.zeros((total_shards, data_shards), dtype=np.uint8)
+    for r in range(total_shards):
+        for c in range(data_shards):
+            if r < data_shards:
+                m[r, c] = 1 if r == c else 0
+            else:
+                m[r, c] = gf_inv(r ^ c)
+    m.setflags(write=False)
+    return m
+
+
+def generator_matrix(kind: str, data_shards: int, total_shards: int) -> np.ndarray:
+    """Dispatch to the named systematic generator construction."""
+    if kind == "vandermonde":
+        return build_matrix(data_shards, total_shards)
+    if kind == "cauchy":
+        return build_matrix_cauchy(data_shards, total_shards)
+    raise ValueError(f"unknown matrix kind {kind!r}")
+
+
+def parity_matrix(data_shards: int, parity_shards: int, kind: str = "vandermonde") -> np.ndarray:
+    """The (parity x data) block that maps data shards to parity shards."""
+    g = generator_matrix(kind, data_shards, data_shards + parity_shards)
+    return g[data_shards:]
+
+
+# ---------------------------------------------------------------------------
+# Binary (bit-plane) lift — the bridge from GF(2^8) to MXU int8 matmuls
+# ---------------------------------------------------------------------------
+
+
+def gf_const_to_bits(c: int) -> np.ndarray:
+    """Lift multiplication-by-c to its 8x8 GF(2) matrix A_c.
+
+    y = c*x is GF(2)-linear in the bits of x:  A_c[i, j] = bit i of (c * 2^j),
+    with bit j meaning the coefficient of x^j (little-endian bit order).
+    """
+    a = np.zeros((8, 8), dtype=np.uint8)
+    for j in range(8):
+        prod = gf_mul(c, 1 << j)
+        for i in range(8):
+            a[i, j] = (prod >> i) & 1
+    return a
+
+
+def gf_matrix_to_bits(m: np.ndarray) -> np.ndarray:
+    """Lift an (R, C) GF(2^8) matrix to its (R*8, C*8) GF(2) block matrix.
+
+    Row r*8+i, col c*8+j: bit i of (m[r,c] * 2^j). With data bytes unpacked to
+    little-endian bit-planes, `out_bits = (B @ in_bits) & 1` computes the exact
+    GF(2^8) matrix-vector product — this is the matmul the MXU runs
+    (SURVEY.md §7.2; PAPERS.md: arXiv:2108.02692, arXiv:1611.09968).
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    r_n, c_n = m.shape
+    out = np.zeros((r_n * 8, c_n * 8), dtype=np.uint8)
+    for r in range(r_n):
+        for c in range(c_n):
+            out[r * 8 : r * 8 + 8, c * 8 : c * 8 + 8] = gf_const_to_bits(int(m[r, c]))
+    return out

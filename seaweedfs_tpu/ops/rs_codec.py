@@ -1,0 +1,204 @@
+"""Reed-Solomon codec facade — the `reedsolomon.Encoder`-shaped seam.
+
+Mirrors the API surface the reference consumes from klauspost/reedsolomon
+(`New(d, p)`, `Encode`, `Reconstruct`, `ReconstructData`, `Verify`,
+`Split`/`Join` [VERIFY: reference mount empty — upstream API, SURVEY.md §2.1])
+with two backends behind one factory, the same seam SURVEY.md §1 identifies
+for backend selection:
+
+  * "numpy" — host CPU golden path (table-driven GF(2^8)), the correctness
+    oracle and fallback when no accelerator is present.
+  * "jax"   — the TPU path: bit-plane lift + int8 MXU matmuls (rs_jax).
+
+Per-loss-pattern decode matrices are built host-side by GF Gaussian
+elimination and cached — the role of the reference codec's inversion tree
+(`inversion_tree.go`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+from seaweedfs_tpu.ops import gf8
+
+
+@functools.lru_cache(maxsize=4096)
+def _reconstruction_matrix(
+    kind: str,
+    data_shards: int,
+    parity_shards: int,
+    survivors: tuple,
+    wanted: tuple,
+) -> np.ndarray:
+    """(len(wanted) x data_shards) matrix mapping survivor shards to wanted
+    shards. `survivors` must be exactly `data_shards` present shard ids."""
+    gen = gf8.generator_matrix(kind, data_shards, data_shards + parity_shards)
+    sub = gen[list(survivors), :]  # (D, D)
+    inv = gf8.gf_mat_inv(sub)  # survivors -> data
+    rows = []
+    for w in wanted:
+        if w < data_shards:
+            rows.append(inv[w])
+        else:
+            rows.append(gf8.gf_mat_mul(gen[w : w + 1], inv)[0])
+    out = np.stack(rows).astype(np.uint8)
+    out.setflags(write=False)
+    return out
+
+
+class Encoder:
+    """RS(d+p) encoder/reconstructor over GF(2^8).
+
+    All shards in one call must share a length (like the reference codec);
+    striping/padding policy lives a layer up in `ec.stripe`.
+    """
+
+    def __init__(
+        self,
+        data_shards: int = 10,
+        parity_shards: int = 4,
+        matrix_kind: str = "vandermonde",
+        backend: str = "numpy",
+    ):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("shard counts must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("GF(2^8) supports at most 256 total shards")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        if backend not in ("numpy", "jax"):
+            raise ValueError(f"unknown backend {backend!r} (want 'numpy' or 'jax')")
+        self.matrix_kind = matrix_kind
+        self.backend = backend
+        self.gen_matrix = gf8.generator_matrix(matrix_kind, data_shards, self.total_shards)
+        self.parity_matrix = np.ascontiguousarray(self.gen_matrix[data_shards:])
+
+    # -- kernel dispatch ----------------------------------------------------
+
+    def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        """Apply GF matrix m (R x C) to shard stack (C, N) -> (R, N)."""
+        if self.backend == "jax":
+            from seaweedfs_tpu.ops import rs_jax
+
+            return np.asarray(rs_jax.apply_matrix(m, shards))
+        return gf8.gf_mat_vec(m, shards)
+
+    # -- public API (reedsolomon.Encoder parity) ----------------------------
+
+    def encode(self, shards: Sequence[np.ndarray]) -> list[np.ndarray]:
+        """Fill parity shards from data shards.
+
+        `shards` holds `data_shards` equal-length uint8 arrays (extra entries
+        beyond data_shards are ignored/overwritten). Returns the full list of
+        `total_shards` arrays (data passed through, parity computed).
+        """
+        data = np.stack([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]])
+        parity = self._apply(self.parity_matrix, data)
+        return [data[i] for i in range(self.data_shards)] + [
+            parity[i] for i in range(self.parity_shards)
+        ]
+
+    def _pick_survivors(self, shards: Sequence[Optional[np.ndarray]]) -> list[int]:
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ValueError(
+                f"too few shards to reconstruct: {len(present)} < {self.data_shards}"
+            )
+        # Deterministically use the first `data_shards` present shards, like
+        # the reference codec's Reconstruct.
+        return present[: self.data_shards]
+
+    def reconstruct(
+        self,
+        shards: Sequence[Optional[np.ndarray]],
+        data_only: bool = False,
+        wanted: Optional[Sequence[int]] = None,
+    ) -> list[np.ndarray]:
+        """Recompute missing shards in place-semantics: returns a full list
+        where every previously-None entry (or only missing data entries when
+        `data_only`) is filled. `wanted` restricts to specific shard ids."""
+        shards = list(shards)
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} entries, got {len(shards)}")
+        if wanted is None:
+            limit = self.data_shards if data_only else self.total_shards
+            wanted = [i for i in range(limit) if shards[i] is None]
+        else:
+            for w in wanted:
+                if not 0 <= w < self.total_shards:
+                    raise ValueError(f"wanted shard id {w} out of range 0..{self.total_shards - 1}")
+            wanted = [i for i in wanted if shards[i] is None]
+        if not wanted:
+            return shards
+        survivors = self._pick_survivors(shards)
+        m = _reconstruction_matrix(
+            self.matrix_kind,
+            self.data_shards,
+            self.parity_shards,
+            tuple(survivors),
+            tuple(wanted),
+        )
+        stack = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in survivors])
+        out = self._apply(m, stack)
+        for k, w in enumerate(wanted):
+            shards[w] = out[k]
+        return shards
+
+    def reconstruct_data(self, shards):
+        """reedsolomon.ReconstructData: only repair data shards."""
+        return self.reconstruct(shards, data_only=True)
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        """True iff parity shards match the data shards."""
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shards")
+        data = np.stack([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]])
+        parity = self._apply(self.parity_matrix, data)
+        for i in range(self.parity_shards):
+            if not np.array_equal(parity[i], np.asarray(shards[self.data_shards + i])):
+                return False
+        return True
+
+    def split(self, data: bytes | np.ndarray) -> list[np.ndarray]:
+        """Split a byte blob into data_shards equal arrays (zero-padded).
+
+        Empty input raises, matching the reference codec's ErrShortData."""
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8)
+        if len(buf) == 0:
+            raise ValueError("short data: cannot split an empty blob")
+        per = -(-len(buf) // self.data_shards)
+        padded = np.zeros(per * self.data_shards, dtype=np.uint8)
+        padded[: len(buf)] = buf
+        return list(padded.reshape(self.data_shards, per))
+
+    def join(self, shards: Sequence[np.ndarray], out_size: int) -> bytes:
+        return np.concatenate([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]]).tobytes()[:out_size]
+
+
+def new_encoder(
+    data_shards: int = 10,
+    parity_shards: int = 4,
+    backend: str = "auto",
+    matrix_kind: str = "vandermonde",
+) -> Encoder:
+    """Encoder factory — the backend-selection seam (SURVEY.md §1, §7.1 step 5).
+
+    backend: "auto" picks jax when an accelerator (TPU/GPU) is present, else
+    numpy; explicit "jax"/"numpy" force a path.
+    """
+    if backend == "auto":
+        try:
+            import jax
+
+            backend = (
+                "jax"
+                if any(d.platform != "cpu" for d in jax.devices())
+                else "numpy"
+            )
+        except Exception:
+            backend = "numpy"
+    return Encoder(data_shards, parity_shards, matrix_kind=matrix_kind, backend=backend)

@@ -1,0 +1,89 @@
+"""JAX/XLA Reed-Solomon kernels — the TPU replacement for the reference codec's
+SIMD assembly (klauspost/reedsolomon galois_amd64.s PSHUFB nibble tables
+[VERIFY: reference mount empty, SURVEY.md §2.2]).
+
+Formulation (SURVEY.md §7.2): GF(2^8) multiply-by-constant is linear over
+GF(2), so an (R x C) GF(2^8) coding matrix lifts to an (R*8 x C*8) binary
+matrix B. Unpack data bytes into little-endian bit-planes, then
+
+    out_bits = (B @ in_bits) mod 2
+
+is the exact GF(2^8) matrix product — one int8 matmul on the MXU with an
+int32 accumulator (K = C*8 <= 112*8 < 2^31, no overflow) and a final `& 1`.
+Encode, reconstruct, and verify all reduce to this one kernel with different
+(host-built, cached) matrices. Arithmetic intensity is fixed (~R*8 int8
+MACs/byte), so the design problem is feeding the MXU — callers batch tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seaweedfs_tpu.ops import gf8
+
+
+def bytes_to_bits(x: jax.Array) -> jax.Array:
+    """(..., C, N) uint8 -> (..., C*8, N) int8 little-endian bit-planes."""
+    *lead, c, n = x.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., :, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+    return bits.reshape(*lead, c * 8, n).astype(jnp.int8)
+
+
+def bits_to_bytes(bits: jax.Array) -> jax.Array:
+    """(..., R*8, N) int -> (..., R, N) uint8, little-endian bit-planes."""
+    *lead, r8, n = bits.shape
+    b = bits.reshape(*lead, r8 // 8, 8, n).astype(jnp.uint8)
+    out = b[..., 0, :]
+    for i in range(1, 8):
+        out = out | (b[..., i, :] << np.uint8(i))
+    return out
+
+
+@jax.jit
+def gf_apply(b_bits: jax.Array, data: jax.Array) -> jax.Array:
+    """Apply a lifted GF(2^8) matrix to byte shards.
+
+    b_bits: (R*8, C*8) int8 binary matrix (from gf8.gf_matrix_to_bits).
+    data:   (C, N) or (batch, C, N) uint8 input shards.
+    Returns (R, N) / (batch, R, N) uint8 output shards.
+    """
+    bits = bytes_to_bits(data)
+    if data.ndim == 2:
+        acc = jax.lax.dot_general(
+            b_bits,
+            bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+    else:
+        acc = jnp.einsum(
+            "rk,bkn->brn", b_bits, bits, preferred_element_type=jnp.int32
+        )
+    return bits_to_bytes(acc & 1)
+
+
+@functools.lru_cache(maxsize=256)
+def _lifted(matrix_key) -> jax.Array:
+    rows = np.array(matrix_key, dtype=np.uint8)
+    return jnp.asarray(gf8.gf_matrix_to_bits(rows), dtype=jnp.int8)
+
+
+def lifted_matrix(m: np.ndarray) -> jax.Array:
+    """Device int8 binary lift of a GF(2^8) matrix, cached by value."""
+    m = np.asarray(m, dtype=np.uint8)
+    return _lifted(tuple(tuple(int(v) for v in row) for row in m))
+
+
+def encode_parity(data: jax.Array, parity_m: np.ndarray) -> jax.Array:
+    """data: (D, N) or (B, D, N) uint8 -> parity (P, N) / (B, P, N)."""
+    return gf_apply(lifted_matrix(parity_m), data)
+
+
+def apply_matrix(m: np.ndarray, shards: jax.Array) -> jax.Array:
+    """Apply an arbitrary GF(2^8) matrix (e.g. a cached decode matrix)."""
+    return gf_apply(lifted_matrix(m), shards)

@@ -1,0 +1,15 @@
+"""Test harness config: run all tests on CPU with 8 virtual devices so
+multi-chip sharding paths are exercised without TPU hardware (SURVEY.md §4:
+the `xla_force_host_platform_device_count` fake-backend strategy).
+
+Must run before jax initializes, hence env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
