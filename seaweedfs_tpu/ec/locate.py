@@ -1,0 +1,101 @@
+"""Interval math — the exact semantics of weed/storage/erasure_coding/
+ec_locate.go [VERIFY: mount empty; upstream semantics, SURVEY.md §2.3].
+
+A volume's .dat is striped row-major: large rows (DATA_SHARDS x 1 GiB blocks)
+first, then the tail as small rows (DATA_SHARDS x 1 MiB). A shard file is one
+column of that grid, so a logical .dat range maps to a list of
+(shard_id, offset_in_shard) intervals; the large->small transition makes this
+non-trivial and is the part the reference's tests pin down.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT
+
+
+@dataclass(frozen=True)
+class Interval:
+    block_index: int  # index into the row-major grid of blocks of one tier
+    inner_block_offset: int
+    size: int
+    is_large_block: bool
+    large_block_rows_count: int
+
+    def to_shard_id_and_offset(self, large_block_size: int, small_block_size: int) -> tuple[int, int]:
+        ec_file_offset = self.inner_block_offset
+        row_index = self.block_index // DATA_SHARDS_COUNT
+        if self.is_large_block:
+            ec_file_offset += row_index * large_block_size
+        else:
+            ec_file_offset += (
+                self.large_block_rows_count * large_block_size + row_index * small_block_size
+            )
+        shard_id = self.block_index % DATA_SHARDS_COUNT
+        return shard_id, ec_file_offset
+
+
+def large_row_count(dat_size: int, large_block_length: int) -> int:
+    """Number of large rows the encoder emitted for a .dat of this size.
+
+    Matches the encode loop's strictly-greater condition: a volume of exactly
+    one large-row is encoded entirely as small rows."""
+    large_row_size = large_block_length * DATA_SHARDS_COUNT
+    if dat_size <= 0:
+        return 0
+    return (dat_size - 1) // large_row_size
+
+
+def _locate_offset_within_blocks(block_length: int, offset: int) -> tuple[int, int]:
+    return offset // block_length, offset % block_length
+
+
+def locate_offset(
+    large_block_length: int, small_block_length: int, dat_size: int, offset: int
+) -> tuple[int, bool, int, int]:
+    """-> (block_index, is_large_block, n_large_block_rows, inner_block_offset)."""
+    large_row_size = large_block_length * DATA_SHARDS_COUNT
+    n_large_rows = large_row_count(dat_size, large_block_length)
+    if offset < n_large_rows * large_row_size:
+        block_index, inner = _locate_offset_within_blocks(large_block_length, offset)
+        return block_index, True, n_large_rows, inner
+    offset -= n_large_rows * large_row_size
+    block_index, inner = _locate_offset_within_blocks(small_block_length, offset)
+    return block_index, False, n_large_rows, inner
+
+
+def locate_data(
+    large_block_length: int,
+    small_block_length: int,
+    dat_size: int,
+    offset: int,
+    size: int,
+) -> list[Interval]:
+    """Split a logical .dat byte range into per-block intervals."""
+    block_index, is_large, n_large_rows, inner = locate_offset(
+        large_block_length, small_block_length, dat_size, offset
+    )
+    intervals: list[Interval] = []
+    while size > 0:
+        block_len = large_block_length if is_large else small_block_length
+        block_remaining = block_len - inner
+        take = min(size, block_remaining)
+        intervals.append(
+            Interval(
+                block_index=block_index,
+                inner_block_offset=inner,
+                size=take,
+                is_large_block=is_large,
+                large_block_rows_count=n_large_rows,
+            )
+        )
+        size -= take
+        if size <= 0:
+            break
+        block_index += 1
+        if is_large and block_index == n_large_rows * DATA_SHARDS_COUNT:
+            is_large = False
+            block_index = 0
+        inner = 0
+    return intervals

@@ -1,0 +1,58 @@
+"""ShardBits + EcVolumeInfo — mirror of weed/storage/erasure_coding/
+ec_volume_info.go [VERIFY: mount empty]. A uint32 bitmask of which of the 14
+shards a node holds; exchanged in heartbeats and kept in the master's
+EcShardLocations registry."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+
+
+class ShardBits(int):
+    def add_shard_id(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self | (1 << shard_id))
+
+    def remove_shard_id(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self & ~(1 << shard_id))
+
+    def has_shard_id(self, shard_id: int) -> bool:
+        return bool(self & (1 << shard_id))
+
+    def shard_ids(self) -> list[int]:
+        return [i for i in range(TOTAL_SHARDS_COUNT) if self.has_shard_id(i)]
+
+    def shard_id_count(self) -> int:
+        return bin(self & ((1 << TOTAL_SHARDS_COUNT) - 1)).count("1")
+
+    def plus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self | other)
+
+    def minus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self & ~other)
+
+    @classmethod
+    def from_ids(cls, ids) -> "ShardBits":
+        b = cls(0)
+        for i in ids:
+            b = b.add_shard_id(i)
+        return b
+
+
+@dataclass
+class EcVolumeInfo:
+    volume_id: int
+    collection: str = ""
+    shard_bits: ShardBits = field(default_factory=lambda: ShardBits(0))
+
+    def to_dict(self) -> dict:
+        return {
+            "volume_id": self.volume_id,
+            "collection": self.collection,
+            "shard_bits": int(self.shard_bits),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EcVolumeInfo":
+        return cls(d["volume_id"], d.get("collection", ""), ShardBits(d.get("shard_bits", 0)))

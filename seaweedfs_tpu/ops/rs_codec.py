@@ -80,11 +80,14 @@ class Encoder:
     # -- kernel dispatch ----------------------------------------------------
 
     def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
-        """Apply GF matrix m (R x C) to shard stack (C, N) -> (R, N)."""
+        """Apply GF matrix m (R x C) to a shard stack (C, N) -> (R, N) or a
+        batched stack (B, C, N) -> (B, R, N)."""
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_jax
 
             return np.asarray(rs_jax.apply_matrix(m, shards))
+        if shards.ndim == 3:
+            return np.moveaxis(gf8.gf_mat_vec(m, np.moveaxis(shards, 0, 1)), 1, 0)
         return gf8.gf_mat_vec(m, shards)
 
     # -- public API (reedsolomon.Encoder parity) ----------------------------
@@ -101,6 +104,16 @@ class Encoder:
         return [data[i] for i in range(self.data_shards)] + [
             parity[i] for i in range(self.parity_shards)
         ]
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """Batched encode: (B, data_shards, N) -> (B, total_shards, N).
+
+        One device dispatch for the whole batch — the TPU-first replacement
+        for the reference's per-segment goroutine loop (SURVEY.md §2.5)."""
+        data = np.asarray(data, dtype=np.uint8)
+        if data.ndim != 3 or data.shape[1] != self.data_shards:
+            raise ValueError(f"want (B, {self.data_shards}, N), got {data.shape}")
+        return np.concatenate([data, self._apply(self.parity_matrix, data)], axis=1)
 
     def _pick_survivors(self, shards: Sequence[Optional[np.ndarray]]) -> list[int]:
         present = [i for i, s in enumerate(shards) if s is not None]

@@ -1,0 +1,68 @@
+""".idx index-file walker — mirror of weed/storage/idx [VERIFY: mount empty].
+
+A .idx file is an append-only log of 16-byte entries (key, offset, size); the
+same record shape, sorted by key, is the .ecx format.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Callable, Iterator
+
+import numpy as np
+
+from seaweedfs_tpu.storage import types
+
+
+def walk_index_buffer(buf: bytes) -> Iterator[tuple[int, int, int]]:
+    """Yield (key, stored_offset, size) for each complete 16-byte entry."""
+    n = len(buf) // types.NEEDLE_MAP_ENTRY_SIZE
+    for i in range(n):
+        yield types.unpack_index_entry(buf, i * types.NEEDLE_MAP_ENTRY_SIZE)
+
+
+def walk_index_file(f: BinaryIO | str, fn: Callable[[int, int, int], None]) -> None:
+    """WalkIndexFile semantics: call fn(key, offset, size) per entry."""
+    if isinstance(f, str):
+        with open(f, "rb") as fh:
+            data = fh.read()
+    else:
+        data = f.read()
+    for key, off, size in walk_index_buffer(data):
+        fn(key, off, size)
+
+
+def index_entries_array(buf: bytes) -> np.ndarray:
+    """Vectorized parse: -> structured array with key/offset/size columns."""
+    n = len(buf) // types.NEEDLE_MAP_ENTRY_SIZE
+    raw = np.frombuffer(buf[: n * types.NEEDLE_MAP_ENTRY_SIZE], dtype=np.uint8).reshape(n, 16)
+    key = raw[:, 0:8].astype(np.uint64)
+    keys = np.zeros(n, dtype=np.uint64)
+    for b in range(8):
+        keys = (keys << np.uint64(8)) | key[:, b]
+    offs = (
+        (raw[:, 8].astype(np.uint32) << 24)
+        | (raw[:, 9].astype(np.uint32) << 16)
+        | (raw[:, 10].astype(np.uint32) << 8)
+        | raw[:, 11].astype(np.uint32)
+    )
+    sizes = (
+        (raw[:, 12].astype(np.uint32) << 24)
+        | (raw[:, 13].astype(np.uint32) << 16)
+        | (raw[:, 14].astype(np.uint32) << 8)
+        | raw[:, 15].astype(np.uint32)
+    ).astype(np.int32)
+    out = np.zeros(n, dtype=[("key", np.uint64), ("offset", np.uint32), ("size", np.int32)])
+    out["key"], out["offset"], out["size"] = keys, offs, sizes
+    return out
+
+
+def write_entries(entries, out: BinaryIO | str) -> None:
+    """Write (key, stored_offset, size) triples as 16-byte records."""
+    sink = open(out, "wb") if isinstance(out, str) else out
+    try:
+        for key, off, size in entries:
+            sink.write(types.pack_index_entry(key, off, size))
+    finally:
+        if isinstance(out, str):
+            sink.close()

@@ -1,0 +1,67 @@
+"""On-disk primitive types — mirror of weed/storage/types [VERIFY: reference
+mount empty; layouts follow upstream SeaweedFS, SURVEY.md §2.1].
+
+NeedleId: uint64, big-endian on disk.
+Offset:   uint32 on disk, counting units of NEEDLE_PADDING_SIZE (8 bytes) —
+          so a 4-byte offset addresses 32 GiB volumes.
+Size:     int32, big-endian two's complement; negative = deleted
+          (TOMBSTONE_FILE_SIZE = -1).
+Index entry (.idx / .ecx): key(8) | offset(4) | size(4) = 16 bytes.
+"""
+
+from __future__ import annotations
+
+import struct
+
+NEEDLE_ID_SIZE = 8
+OFFSET_SIZE = 4
+SIZE_SIZE = 4
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_PADDING_SIZE = 8
+NEEDLE_HEADER_SIZE = 4 + NEEDLE_ID_SIZE + SIZE_SIZE  # cookie + id + size
+NEEDLE_CHECKSUM_SIZE = 4
+TIMESTAMP_SIZE = 8
+
+TOMBSTONE_FILE_SIZE = -1
+
+_ENTRY = struct.Struct(">QIi")  # key, offset (x8 units), size
+
+
+def is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_FILE_SIZE
+
+
+def offset_to_bytes(actual_offset: int) -> int:
+    """Byte offset -> stored uint32 (units of 8). Must be 8-aligned."""
+    if actual_offset % NEEDLE_PADDING_SIZE:
+        raise ValueError(f"offset {actual_offset} not {NEEDLE_PADDING_SIZE}-aligned")
+    return actual_offset // NEEDLE_PADDING_SIZE
+
+
+def offset_to_actual(stored: int) -> int:
+    return stored * NEEDLE_PADDING_SIZE
+
+
+def pack_index_entry(key: int, stored_offset: int, size: int) -> bytes:
+    return _ENTRY.pack(key, stored_offset, size)
+
+
+def unpack_index_entry(buf: bytes, pos: int = 0) -> tuple[int, int, int]:
+    """-> (key, stored_offset, size)."""
+    return _ENTRY.unpack_from(buf, pos)
+
+
+def actual_size(size: int, version: int = 3) -> int:
+    """Total on-disk bytes a needle record of body `size` occupies
+    (header + body + checksum [+ timestamp for v3] + padding to 8)."""
+    base = NEEDLE_HEADER_SIZE + max(size, 0) + NEEDLE_CHECKSUM_SIZE
+    if version == 3:
+        base += TIMESTAMP_SIZE
+    return base + padding_length(size, version)
+
+
+def padding_length(size: int, version: int = 3) -> int:
+    base = NEEDLE_HEADER_SIZE + max(size, 0) + NEEDLE_CHECKSUM_SIZE
+    if version == 3:
+        base += TIMESTAMP_SIZE
+    return (-base) % NEEDLE_PADDING_SIZE
