@@ -1,0 +1,70 @@
+"""North-star benchmark: device-side RS(10+4) EC encode throughput, GB/s/chip
+(BASELINE.md config 2 analog: batched warm-volume encode on one chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol per BASELINE.md: GB/s counts DATA bytes in (10 shards) / kernel
+wall time with data device-resident (the axon tunnel's ~25 MB/s host<->device
+path would otherwise swamp the measurement; device-side is what the 40 GB/s
+target is defined on). vs_baseline is value / 40.0 — the fraction of the
+driver's 40 GB/s/chip target, since BASELINE.json.published is empty
+(SURVEY.md §6: no reference numbers could be measured).
+"""
+
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TARGET_GBPS = 40.0
+
+
+def main() -> None:
+    from seaweedfs_tpu.ops import gf8, rs_jax
+
+    on_accel = any(d.platform != "cpu" for d in jax.devices())
+    # batch x shards x tile-bytes; modest on CPU so dev runs finish
+    if on_accel:
+        b, n = 8, 4 * 1024 * 1024
+        iters, warmup = 10, 3
+    else:
+        b, n = 2, 256 * 1024
+        iters, warmup = 3, 1
+
+    parity_bits = rs_jax.lifted_matrix(gf8.parity_matrix(10, 4))
+
+    @jax.jit
+    def encode(data):
+        return rs_jax.gf_apply(parity_bits, data)
+
+    key = jax.random.PRNGKey(0)
+    data = jax.random.randint(key, (b, 10, n), 0, 256, dtype=jnp.uint8)
+    data = jax.block_until_ready(data)
+
+    for _ in range(warmup):
+        jax.block_until_ready(encode(data))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(encode(data))
+        times.append(time.perf_counter() - t0)
+
+    data_bytes = b * 10 * n
+    gbps = data_bytes / statistics.median(times) / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "ec_encode_device_gbps_10p4",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / TARGET_GBPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -127,7 +127,13 @@ class EcVolume:
         f = self._shard_files.get(shard_id)
         if f is None:
             return None
-        return stripe.read_padded(f, offset, size)
+        f.seek(offset)
+        raw = f.read(size)
+        if len(raw) != size:
+            # Truncated shard: serving zeros would hand clients corrupt data.
+            # Treat as unavailable so the remote/reconstruct fallback kicks in.
+            return None
+        return np.frombuffer(raw, dtype=np.uint8).copy()
 
     def _read_shard_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         """One interval: local -> remote -> reconstruct-from-survivors."""
@@ -145,17 +151,22 @@ class EcVolume:
         other shard and reconstruct the wanted one."""
         shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
         have = 0
+        # local shards first — remote reads cost RTTs on the p50-critical path
         for s in range(TOTAL_SHARDS_COUNT):
             if s == shard_id or have >= DATA_SHARDS_COUNT:
                 continue
             buf = self._read_local(s, offset, size)
-            if buf is None and self.remote_reader is not None:
-                raw = self.remote_reader(s, offset, size)
-                if raw is not None:
-                    buf = np.frombuffer(raw, dtype=np.uint8).copy()
             if buf is not None:
                 shards[s] = buf
                 have += 1
+        if self.remote_reader is not None:
+            for s in range(TOTAL_SHARDS_COUNT):
+                if s == shard_id or shards[s] is not None or have >= DATA_SHARDS_COUNT:
+                    continue
+                raw = self.remote_reader(s, offset, size)
+                if raw is not None and len(raw) == size:
+                    shards[s] = np.frombuffer(raw, dtype=np.uint8).copy()
+                    have += 1
         if have < DATA_SHARDS_COUNT:
             raise IOError(
                 f"shard {shard_id}: only {have} surviving shards reachable, need {DATA_SHARDS_COUNT}"

@@ -195,7 +195,10 @@ def rebuild_ec_files(
         raise ValueError(
             f"cannot rebuild: only {len(present)} shards present, need {DATA_SHARDS_COUNT}"
         )
-    shard_size = os.path.getsize(shard_file_name(base_file_name, present[0]))
+    sizes = {s: os.path.getsize(shard_file_name(base_file_name, s)) for s in present}
+    if len(set(sizes.values())) != 1:
+        raise IOError(f"surviving shards disagree on length: {sizes} — truncated shard?")
+    shard_size = sizes[present[0]]
     with ExitStack() as stack:
         ins = {
             s: stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
@@ -247,6 +250,7 @@ def write_dat_file(
         small_start = n_large * large_block_size
         row = 0
         while written < dat_file_size:
+            row_progress = 0
             for d in range(DATA_SHARDS_COUNT):
                 if written >= dat_file_size:
                     break
@@ -255,6 +259,12 @@ def write_dat_file(
                 take = min(len(chunk), dat_file_size - written)
                 out.write(chunk[:take])
                 written += take
+                row_progress += take
+            if row_progress == 0:
+                raise IOError(
+                    f"shards exhausted at {written} bytes but dat_file_size says "
+                    f"{dat_file_size} — truncated shards or stale size"
+                )
             row += 1
 
 

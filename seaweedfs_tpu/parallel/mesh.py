@@ -1,0 +1,35 @@
+"""Device-mesh helpers — the ICI/DCN scaling seam (SURVEY.md §2.6).
+
+The reference scales EC work by fanning goroutines/gRPC over volume servers;
+the TPU-native design scales by laying volume batches and stripe tiles over a
+`jax.sharding.Mesh` and letting XLA insert collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_mesh(
+    axis_names: Sequence[str] = ("dp",),
+    shape: Optional[Sequence[int]] = None,
+    devices=None,
+) -> Mesh:
+    """Build a mesh over available devices.
+
+    axis_names: logical axes, e.g. ("dp",) for volume-batch parallelism or
+    ("dp", "sp") for volume x stripe 2D sharding.
+    shape: devices per axis; defaults to all devices on the first axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))

@@ -1,0 +1,98 @@
+"""Multi-chip EC paths: volume-batch (dp) x stripe (sp) sharding via
+shard_map over a Mesh — the TPU-native analog of the reference's
+shell-orchestrated fan-out of encode/rebuild over volume servers
+(SURVEY.md §2.5 rows DP/TP/SP, §2.6).
+
+Design: the coding kernel is elementwise over the volume-batch axis and over
+the stripe (byte) axis, so both shard cleanly with zero communication; the
+only collectives are global reductions (integrity checks, progress counters)
+which ride ICI as psums. Shard-id redistribution (column regrouping across
+chips) is an all_to_all and lives in the distributed rebuild model.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from seaweedfs_tpu.ops import gf8, rs_jax
+
+
+def _bits(m: np.ndarray) -> jax.Array:
+    return jnp.asarray(gf8.gf_matrix_to_bits(np.asarray(m, dtype=np.uint8)), dtype=jnp.int8)
+
+
+def make_encode_fn(mesh: Mesh, parity_m: np.ndarray):
+    """Jitted sharded encode: (B, D, N) uint8 -> (B, D+P, N) uint8, with B on
+    'dp' and N on 'sp' (either axis may be size 1)."""
+    b_bits = _bits(parity_m)
+    spec = P("dp", None, "sp")
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+    )
+    def encode(data):
+        parity = rs_jax.gf_apply(b_bits, data)
+        return jnp.concatenate([data, parity], axis=1)
+
+    return encode
+
+
+def make_apply_fn(mesh: Mesh, matrix: np.ndarray):
+    """Jitted sharded matrix application (reconstruction with a cached decode
+    matrix): (B, C, N) -> (B, R, N)."""
+    b_bits = _bits(matrix)
+    spec = P("dp", None, "sp")
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    def apply(survivors):
+        return rs_jax.gf_apply(b_bits, survivors)
+
+    return apply
+
+
+def make_ec_cycle_fn(mesh: Mesh, parity_m: np.ndarray, recon_m: np.ndarray, lost_ids, survivor_ids):
+    """The full-step function the driver dry-runs: encode -> lose shards ->
+    reconstruct -> global integrity psum. Exercises dp x sp sharding plus an
+    ICI collective, on one jit.
+
+    Returns fn(data (B, D, N)) -> (shards (B, T, N), global_mismatches ())."""
+    b_enc = _bits(parity_m)
+    b_rec = _bits(recon_m)
+    lost_ids = tuple(lost_ids)
+    survivor_ids = tuple(survivor_ids)
+    spec = P("dp", None, "sp")
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P()),
+    )
+    def step(data):
+        parity = rs_jax.gf_apply(b_enc, data)
+        shards = jnp.concatenate([data, parity], axis=1)
+        survivors = shards[:, survivor_ids, :]
+        rebuilt = rs_jax.gf_apply(b_rec, survivors)
+        want = shards[:, lost_ids, :]
+        local_bad = jnp.sum(rebuilt != want)
+        global_bad = jax.lax.psum(local_bad, ("dp", "sp"))
+        return shards, global_bad
+
+    return step
+
+
+def shard_batch(mesh: Mesh, data: np.ndarray) -> jax.Array:
+    """Place a (B, C, N) host array onto the mesh with B on dp, N on sp."""
+    return jax.device_put(data, NamedSharding(mesh, P("dp", None, "sp")))

@@ -32,29 +32,17 @@ def walk_index_file(f: BinaryIO | str, fn: Callable[[int, int, int], None]) -> N
         fn(key, off, size)
 
 
+_BE_ENTRY_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">i4")])
+_NATIVE_ENTRY_DTYPE = np.dtype(
+    [("key", np.uint64), ("offset", np.uint32), ("size", np.int32)]
+)
+
+
 def index_entries_array(buf: bytes) -> np.ndarray:
     """Vectorized parse: -> structured array with key/offset/size columns."""
     n = len(buf) // types.NEEDLE_MAP_ENTRY_SIZE
-    raw = np.frombuffer(buf[: n * types.NEEDLE_MAP_ENTRY_SIZE], dtype=np.uint8).reshape(n, 16)
-    key = raw[:, 0:8].astype(np.uint64)
-    keys = np.zeros(n, dtype=np.uint64)
-    for b in range(8):
-        keys = (keys << np.uint64(8)) | key[:, b]
-    offs = (
-        (raw[:, 8].astype(np.uint32) << 24)
-        | (raw[:, 9].astype(np.uint32) << 16)
-        | (raw[:, 10].astype(np.uint32) << 8)
-        | raw[:, 11].astype(np.uint32)
-    )
-    sizes = (
-        (raw[:, 12].astype(np.uint32) << 24)
-        | (raw[:, 13].astype(np.uint32) << 16)
-        | (raw[:, 14].astype(np.uint32) << 8)
-        | raw[:, 15].astype(np.uint32)
-    ).astype(np.int32)
-    out = np.zeros(n, dtype=[("key", np.uint64), ("offset", np.uint32), ("size", np.int32)])
-    out["key"], out["offset"], out["size"] = keys, offs, sizes
-    return out
+    be = np.frombuffer(buf[: n * types.NEEDLE_MAP_ENTRY_SIZE], dtype=_BE_ENTRY_DTYPE)
+    return be.astype(_NATIVE_ENTRY_DTYPE)
 
 
 def write_entries(entries, out: BinaryIO | str) -> None:

@@ -118,3 +118,15 @@ def test_unreadable_when_too_many_lost(volume):
         nid = 3
         with pytest.raises(IOError, match="surviving"):
             ev.read_needle_blob(nid)
+
+
+def test_truncated_shard_falls_back_to_reconstruct(volume):
+    """A truncated local shard must not serve zero-padded (corrupt) data."""
+    base, records = volume
+    p = stripe.shard_file_name(base, 0)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with open_vol(base) as ev:
+        for nid, (off, size, rec) in records.items():
+            got = ev.read_needle_blob(nid)
+            assert got[: len(rec)] == rec, f"needle {nid} corrupt after truncation"

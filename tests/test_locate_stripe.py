@@ -161,3 +161,21 @@ def test_memdb_idx_replay(tmp_path):
     idx_mod.write_entries([(7, 3, 40), (7, 0, types.TOMBSTONE_FILE_SIZE), (8, 9, 1)], p)
     db.load_from_idx(p)
     assert db.get(7) is None and db.get(8) == (9, 1)
+
+
+def test_rebuild_rejects_truncated_survivor(tmp_path):
+    base, _ = make_dat(tmp_path, 2 * SMALL * DATA_SHARDS_COUNT)
+    encode(base)
+    os.remove(stripe.shard_file_name(base, 13))
+    p = stripe.shard_file_name(base, 3)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(IOError, match="disagree"):
+        stripe.rebuild_ec_files(base, encoder=ENC, buffer_size=BUF)
+
+
+def test_write_dat_file_stale_size_raises(tmp_path):
+    base, data = make_dat(tmp_path, SMALL * DATA_SHARDS_COUNT)
+    encode(base)
+    with pytest.raises(IOError, match="exhausted"):
+        stripe.write_dat_file(base, len(data) * 100, large_block_size=LARGE, small_block_size=SMALL)
