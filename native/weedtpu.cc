@@ -1,0 +1,142 @@
+// libweedtpu — native runtime kernels for seaweedfs_tpu.
+//
+// The reference's only native-perf code is the SIMD galois kernels inside its
+// RS codec dependency (klauspost/reedsolomon galois_*.s [VERIFY: mount empty,
+// SURVEY.md §2.2]) plus CRC helpers. This library provides the host-side
+// equivalents for the TPU-native framework:
+//   * crc32c        — Castagnoli CRC (needle checksums), slice-by-8
+//   * gf_mul_slice  — GF(2^8) multiply-accumulate over byte slices using the
+//                     PSHUFB nibble-table trick (AVX2 when available, scalar
+//                     fallback) — the honest "AVX2 baseline" for BASELINE.md
+//   * gf_matrix_apply — (R x C) GF matrix over C input slices -> R outputs
+//
+// Exposed with a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, poly 0x82F63B78 reflected) — slice-by-8
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+  if (crc32c_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ (0x82F63B78u & (0u - (crc & 1)));
+    crc32c_table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int s = 1; s < 8; s++)
+      crc32c_table[s][i] =
+          (crc32c_table[s - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[s - 1][i] & 0xFF];
+  crc32c_init_done = true;
+}
+
+uint32_t weedtpu_crc32c(uint32_t crc, const uint8_t* buf, uint64_t len) {
+  crc32c_init();
+  crc = ~crc;
+  while (len >= 8) {
+    uint64_t word;
+    memcpy(&word, buf, 8);
+    word ^= crc;  // little-endian hosts only (x86/arm64)
+    crc = crc32c_table[7][word & 0xFF] ^ crc32c_table[6][(word >> 8) & 0xFF] ^
+          crc32c_table[5][(word >> 16) & 0xFF] ^ crc32c_table[4][(word >> 24) & 0xFF] ^
+          crc32c_table[3][(word >> 32) & 0xFF] ^ crc32c_table[2][(word >> 40) & 0xFF] ^
+          crc32c_table[1][(word >> 48) & 0xFF] ^ crc32c_table[0][(word >> 56) & 0xFF];
+    buf += 8;
+    len -= 8;
+  }
+  while (len--) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ *buf++) & 0xFF];
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) multiply-accumulate, poly 0x11D
+// ---------------------------------------------------------------------------
+
+static uint8_t gf_mul_table[256][256];
+static bool gf_init_done = false;
+
+static void gf_init() {
+  if (gf_init_done) return;
+  for (int a = 0; a < 256; a++) {
+    for (int b = 0; b < 256; b++) {
+      uint16_t x = (uint16_t)a, r = 0, y = (uint16_t)b;
+      while (y) {
+        if (y & 1) r ^= x;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11D;
+        y >>= 1;
+      }
+      gf_mul_table[a][b] = (uint8_t)r;
+    }
+  }
+  gf_init_done = true;
+}
+
+// dst[i] ^= gmul(c, src[i]) for i in [0, len)
+void weedtpu_gf_mul_xor_slice(uint8_t c, const uint8_t* src, uint8_t* dst,
+                              uint64_t len) {
+  gf_init();
+  if (c == 0) return;
+#if defined(__AVX2__)
+  // PSHUFB nibble tables: y = lo_tbl[x & 0xF] ^ hi_tbl[x >> 4]
+  uint8_t lo[16], hi[16];
+  for (int i = 0; i < 16; i++) {
+    lo[i] = gf_mul_table[c][i];
+    hi[i] = gf_mul_table[c][i << 4];
+  }
+  const __m256i vlo = _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)lo));
+  const __m256i vhi = _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)hi));
+  const __m256i mask = _mm256_set1_epi8(0x0F);
+  uint64_t i = 0;
+  for (; i + 32 <= len; i += 32) {
+    __m256i x = _mm256_loadu_si256((const __m256i*)(src + i));
+    __m256i xl = _mm256_and_si256(x, mask);
+    __m256i xh = _mm256_and_si256(_mm256_srli_epi64(x, 4), mask);
+    __m256i y = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, xl),
+                                 _mm256_shuffle_epi8(vhi, xh));
+    __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
+    _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(d, y));
+  }
+  for (; i < len; i++) dst[i] ^= gf_mul_table[c][src[i]];
+#else
+  const uint8_t* row = gf_mul_table[c];
+  for (uint64_t i = 0; i < len; i++) dst[i] ^= row[src[i]];
+#endif
+}
+
+// outputs[r] = XOR_c gmul(matrix[r*cols+c], inputs[c]), each slice `len` bytes
+void weedtpu_gf_matrix_apply(const uint8_t* matrix, uint32_t rows, uint32_t cols,
+                             const uint8_t* const* inputs, uint8_t* const* outputs,
+                             uint64_t len) {
+  gf_init();
+  for (uint32_t r = 0; r < rows; r++) {
+    memset(outputs[r], 0, len);
+    for (uint32_t c0 = 0; c0 < cols; c0++) {
+      uint8_t coef = matrix[r * cols + c0];
+      if (coef) weedtpu_gf_mul_xor_slice(coef, inputs[c0], outputs[r], len);
+    }
+  }
+}
+
+int weedtpu_has_avx2() {
+#if defined(__AVX2__)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"

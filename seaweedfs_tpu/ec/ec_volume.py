@@ -55,10 +55,17 @@ class EcVolume:
     ):
         self.base = base_file_name
         self.encoder = encoder or new_encoder()
-        self.large = large_block_size
-        self.small = small_block_size
         self.remote_reader = remote_reader
         self.version = version
+        # recorded stripe geometry (.eci) wins over constructor defaults —
+        # opening shards with the wrong geometry would mis-map every interval
+        info = stripe.read_ec_info(base_file_name)
+        if info is not None:
+            self.large = int(info["large_block_size"])
+            self.small = int(info["small_block_size"])
+        else:
+            self.large = large_block_size
+            self.small = small_block_size
 
         with open(base_file_name + ".ecx", "rb") as f:
             self._index = idx_mod.index_entries_array(f.read())
@@ -80,8 +87,12 @@ class EcVolume:
                 "to locate blocks correctly"
             )
         # The locate math only needs the large-row count; shard_size * D is a
-        # consistent stand-in for the true .dat size (ev.DatFileSize analog).
-        self.dat_file_size = self.shard_size * DATA_SHARDS_COUNT
+        # consistent stand-in for the true .dat size (ev.DatFileSize analog);
+        # the recorded exact size wins when available.
+        if info is not None:
+            self.dat_file_size = int(info["dat_size"])
+        else:
+            self.dat_file_size = self.shard_size * DATA_SHARDS_COUNT
 
     def close(self) -> None:
         for f in self._shard_files.values():

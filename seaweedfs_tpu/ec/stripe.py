@@ -17,6 +17,7 @@ on-disk output is byte-identical either way.
 
 from __future__ import annotations
 
+import json
 import os
 from contextlib import ExitStack
 from typing import Optional, Sequence
@@ -153,6 +154,46 @@ def write_ec_files(
             min(buffer_size, small_block_size),
             max_batch_bytes,
         )
+    write_ec_info(base_file_name, large_block_size, small_block_size, dat_size)
+
+
+def write_ec_info(
+    base_file_name: str, large_block_size: int, small_block_size: int, dat_size: int
+) -> None:
+    """Record the stripe geometry + true .dat size in an .eci sidecar.
+
+    The reference needs no such file because its block sizes are compile-time
+    constants; here they are parameters (tests use scaled-down geometry), and
+    opening a shard set with the wrong geometry would silently mis-map
+    intervals. Shard sets written by stock tooling (no .eci) still open fine
+    with the default constants."""
+    tmp = base_file_name + ".eci.tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "large_block_size": large_block_size,
+                "small_block_size": small_block_size,
+                "dat_size": dat_size,
+            },
+            f,
+        )
+    os.replace(tmp, base_file_name + ".eci")
+
+
+_ECI_KEYS = ("large_block_size", "small_block_size", "dat_size")
+
+
+def read_ec_info(base_file_name: str) -> Optional[dict]:
+    try:
+        with open(base_file_name + ".eci") as f:
+            info = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(info, dict) or not all(
+        isinstance(info.get(k), int) for k in _ECI_KEYS
+    ):
+        return None
+    return info
 
 
 def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
@@ -221,11 +262,22 @@ def rebuild_ec_files(
 
 def write_dat_file(
     base_file_name: str,
-    dat_file_size: int,
+    dat_file_size: Optional[int] = None,
     large_block_size: int = ERASURE_CODING_LARGE_BLOCK_SIZE,
     small_block_size: int = ERASURE_CODING_SMALL_BLOCK_SIZE,
 ) -> None:
-    """Data shards -> <base>.dat (WriteDatFile / ec.decode semantics)."""
+    """Data shards -> <base>.dat (WriteDatFile / ec.decode semantics).
+
+    Recorded .eci geometry overrides the arguments — decoding with the wrong
+    block sizes would interleave garbage silently."""
+    info = read_ec_info(base_file_name)
+    if info is not None:
+        large_block_size = info["large_block_size"]
+        small_block_size = info["small_block_size"]
+        if dat_file_size is None:
+            dat_file_size = info["dat_size"]
+    if dat_file_size is None:
+        raise ValueError("dat_file_size required when no .eci sidecar exists")
     large_row = large_block_size * DATA_SHARDS_COUNT
     n_large = 0
     remaining = dat_file_size

@@ -3,12 +3,13 @@
 Mirrors the API surface the reference consumes from klauspost/reedsolomon
 (`New(d, p)`, `Encode`, `Reconstruct`, `ReconstructData`, `Verify`,
 `Split`/`Join` [VERIFY: reference mount empty — upstream API, SURVEY.md §2.1])
-with two backends behind one factory, the same seam SURVEY.md §1 identifies
+with three backends behind one factory, the same seam SURVEY.md §1 identifies
 for backend selection:
 
-  * "numpy" — host CPU golden path (table-driven GF(2^8)), the correctness
+  * "numpy"  — host CPU golden path (table-driven GF(2^8)), the correctness
     oracle and fallback when no accelerator is present.
-  * "jax"   — the TPU path: bit-plane lift + int8 MXU matmuls (rs_jax).
+  * "jax"    — pure-XLA bit-plane path (rs_jax); any accelerator.
+  * "pallas" — the TPU path: the fused VMEM-resident kernel (rs_pallas).
 
 Per-loss-pattern decode matrices are built host-side by GF Gaussian
 elimination and cached — the role of the reference codec's inversion tree
@@ -70,8 +71,10 @@ class Encoder:
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
-        if backend not in ("numpy", "jax"):
-            raise ValueError(f"unknown backend {backend!r} (want 'numpy' or 'jax')")
+        if backend not in ("numpy", "jax", "pallas"):
+            raise ValueError(
+                f"unknown backend {backend!r} (want 'numpy', 'jax' or 'pallas')"
+            )
         self.matrix_kind = matrix_kind
         self.backend = backend
         self.gen_matrix = gf8.generator_matrix(matrix_kind, data_shards, self.total_shards)
@@ -82,6 +85,10 @@ class Encoder:
     def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
         """Apply GF matrix m (R x C) to a shard stack (C, N) -> (R, N) or a
         batched stack (B, C, N) -> (B, R, N)."""
+        if self.backend == "pallas":
+            from seaweedfs_tpu.ops import rs_pallas
+
+            return np.asarray(rs_pallas.apply_matrix(m, shards))
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_jax
 
@@ -200,18 +207,22 @@ def new_encoder(
 ) -> Encoder:
     """Encoder factory — the backend-selection seam (SURVEY.md §1, §7.1 step 5).
 
-    backend: "auto" picks jax when an accelerator (TPU/GPU) is present, else
-    numpy; explicit "jax"/"numpy" force a path.
+    backend: "auto" picks the fused Pallas kernel on TPU, the XLA path on
+    other accelerators, numpy on plain CPU; explicit values force a path.
     """
     if backend == "auto":
         try:
             import jax
 
-            backend = (
-                "jax"
-                if any(d.platform != "cpu" for d in jax.devices())
-                else "numpy"
-            )
+            from seaweedfs_tpu.utils.devices import is_tpu_device
+
+            d = jax.devices()[0]
+            if is_tpu_device(d):
+                backend = "pallas"
+            elif d.platform != "cpu":
+                backend = "jax"
+            else:
+                backend = "numpy"
         except Exception:
             backend = "numpy"
     return Encoder(data_shards, parity_shards, matrix_kind=matrix_kind, backend=backend)

@@ -1,0 +1,205 @@
+"""Store — the per-server set of disk locations, normal volumes, and EC
+volumes. Mirror of weed/storage/store.go + disk_location*.go + store_ec.go
+[VERIFY: mount empty; SURVEY.md §2.1].
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+from typing import Optional
+
+from seaweedfs_tpu.ec.ec_volume import EcVolume
+from seaweedfs_tpu.ec.shard_bits import EcVolumeInfo, ShardBits
+from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock, TTL
+from seaweedfs_tpu.storage.volume import Volume
+
+_BASE_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)$")
+
+
+def parse_base_name(base: str) -> Optional[tuple[str, int]]:
+    m = _BASE_RE.match(base)
+    if not m:
+        return None
+    return m.group("col") or "", int(m.group("vid"))
+
+
+class DiskLocation:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, EcVolume] = {}
+
+    def load(self, encoder: Optional[Encoder] = None) -> None:
+        for dat in glob.glob(os.path.join(self.directory, "*.dat")):
+            base = os.path.basename(dat)[: -len(".dat")]
+            parsed = parse_base_name(base)
+            if parsed is None:
+                continue
+            collection, vid = parsed
+            if vid not in self.volumes:
+                self.volumes[vid] = Volume(self.directory, vid, collection)
+        for ecx in glob.glob(os.path.join(self.directory, "*.ecx")):
+            base = os.path.basename(ecx)[: -len(".ecx")]
+            parsed = parse_base_name(base)
+            if parsed is None:
+                continue
+            collection, vid = parsed
+            base_path = os.path.join(self.directory, base)
+            if vid not in self.ec_volumes and stripe.find_local_shards(base_path):
+                self.ec_volumes[vid] = EcVolume(base_path, encoder=encoder)
+
+
+class Store:
+    def __init__(self, directories: list[str], encoder: Optional[Encoder] = None):
+        self.encoder = encoder or new_encoder()
+        self.locations = [DiskLocation(d) for d in directories]
+        self._lock = threading.RLock()
+
+    def load(self) -> None:
+        with self._lock:
+            for loc in self.locations:
+                loc.load(self.encoder)
+
+    def close(self) -> None:
+        with self._lock:
+            for loc in self.locations:
+                for v in loc.volumes.values():
+                    v.close()
+                for ev in loc.ec_volumes.values():
+                    ev.close()
+
+    # -- normal volumes ------------------------------------------------------
+
+    def _pick_location(self) -> DiskLocation:
+        return min(self.locations, key=lambda l: len(l.volumes) + len(l.ec_volumes))
+
+    def create_volume(
+        self,
+        vid: int,
+        collection: str = "",
+        replication: str = "000",
+        ttl: str = "",
+        version: int = 3,
+    ) -> Volume:
+        with self._lock:
+            if self.get_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already exists")
+            sb = SuperBlock(
+                version=version,
+                replica_placement=ReplicaPlacement.parse(replication),
+                ttl=TTL.parse(ttl),
+            )
+            loc = self._pick_location()
+            v = Volume(loc.directory, vid, collection, super_block=sb)
+            loc.volumes[vid] = v
+            return v
+
+    def get_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            if vid in loc.volumes:
+                return loc.volumes[vid]
+        return None
+
+    def get_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            if vid in loc.ec_volumes:
+                return loc.ec_volumes[vid]
+        return None
+
+    def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
+        v = self.get_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_needle(self, vid: int, needle_id: int, cookie: Optional[int] = None) -> Needle:
+        v = self.get_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        ev = self.get_ec_volume(vid)
+        if ev is not None:
+            return self.read_ec_needle(vid, needle_id, cookie)
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int) -> bool:
+        v = self.get_volume(vid)
+        if v is not None:
+            return v.delete_needle(needle_id)
+        ev = self.get_ec_volume(vid)
+        if ev is not None:
+            ev.delete_needle(needle_id)
+            return True
+        raise KeyError(f"volume {vid} not found")
+
+    # -- EC volumes (store_ec.go analog) -------------------------------------
+
+    def read_ec_needle(self, vid: int, needle_id: int, cookie: Optional[int] = None) -> Needle:
+        ev = self.get_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"ec volume {vid} not found")
+        blob = ev.read_needle_blob(needle_id)
+        n = Needle.from_bytes(blob, ev.version)
+        if n.id != needle_id:
+            raise IOError(f"ec needle id mismatch: {n.id:x} != {needle_id:x}")
+        if cookie is not None and n.cookie != cookie:
+            raise PermissionError(f"needle {needle_id:x}: cookie mismatch")
+        return n
+
+    def mount_ec_volume(self, vid: int, base_path: str) -> EcVolume:
+        with self._lock:
+            loc = next(
+                (l for l in self.locations if os.path.dirname(base_path) == l.directory),
+                self.locations[0],
+            )
+            old = loc.ec_volumes.pop(vid, None)
+            if old is not None:
+                old.close()
+            ev = EcVolume(base_path, encoder=self.encoder)
+            loc.ec_volumes[vid] = ev
+            return ev
+
+    def unmount_ec_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                ev = loc.ec_volumes.pop(vid, None)
+                if ev is not None:
+                    ev.close()
+
+    # -- status / heartbeat --------------------------------------------------
+
+    def volume_infos(self) -> list[dict]:
+        out = []
+        for loc in self.locations:
+            for vid, v in loc.volumes.items():
+                out.append(
+                    {
+                        "id": vid,
+                        "collection": v.collection,
+                        "size": v.content_size(),
+                        "file_count": v.needle_count(),
+                        "read_only": v.read_only,
+                        "replica_placement": str(v.super_block.replica_placement),
+                        "ttl": str(v.super_block.ttl),
+                        "version": v.version,
+                    }
+                )
+        return out
+
+    def ec_volume_infos(self) -> list[EcVolumeInfo]:
+        out = []
+        for loc in self.locations:
+            for vid, ev in loc.ec_volumes.items():
+                out.append(
+                    EcVolumeInfo(
+                        volume_id=vid,
+                        shard_bits=ShardBits.from_ids(ev.shard_ids),
+                    )
+                )
+        return out

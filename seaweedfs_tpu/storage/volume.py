@@ -1,0 +1,197 @@
+"""Volume engine — append-only needle log + index, the L1 core.
+
+Mirror of weed/storage/volume*.go (volume_read/write/loading/vacuum/checking)
+[VERIFY: mount empty; SURVEY.md §2.1]. A volume is <collection>_<vid>.dat
+(superblock + needle records at 8-aligned offsets) plus <...>.idx (append-only
+16-byte entries). Deletes append a tombstone record and a tombstone index
+entry. Vacuum rewrites live needles into a fresh .dat/.idx pair.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from seaweedfs_tpu.storage import idx as idx_mod
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.needle import CURRENT_VERSION, Needle
+from seaweedfs_tpu.storage.needle_map import CompactMap
+from seaweedfs_tpu.storage.super_block import SuperBlock
+
+
+class VolumeReadOnly(IOError):
+    pass
+
+
+class Volume:
+    def __init__(
+        self,
+        dir_: str,
+        volume_id: int,
+        collection: str = "",
+        super_block: Optional[SuperBlock] = None,
+    ):
+        self.dir = dir_
+        self.id = volume_id
+        self.collection = collection
+        self.read_only = False
+        self._lock = threading.RLock()
+        self.nm = CompactMap()
+        base = f"{collection}_{volume_id}" if collection else str(volume_id)
+        self.base_path = os.path.join(dir_, base)
+        self.dat_path = self.base_path + ".dat"
+        self.idx_path = self.base_path + ".idx"
+
+        exists = os.path.exists(self.dat_path)
+        self._dat = open(self.dat_path, "r+b" if exists else "w+b")
+        if exists:
+            self._dat.seek(0, os.SEEK_END)
+            if self._dat.tell() >= 8:
+                self._dat.seek(0)
+                self.super_block = SuperBlock.from_bytes(self._dat.read(8))
+            else:
+                self.super_block = super_block or SuperBlock()
+                self._write_super_block()
+            if os.path.exists(self.idx_path):
+                self.nm.load_from_idx(self.idx_path)
+        else:
+            self.super_block = super_block or SuperBlock()
+            self._write_super_block()
+        self._idx = open(self.idx_path, "ab")
+
+    def _write_super_block(self) -> None:
+        self._dat.seek(0)
+        self._dat.write(self.super_block.to_bytes())
+        self._dat.flush()
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    def close(self) -> None:
+        with self._lock:
+            self._dat.close()
+            self._idx.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- write path ----------------------------------------------------------
+
+    def write_needle(self, n: Needle) -> tuple[int, int]:
+        """Append a needle; returns (offset, body_size)."""
+        with self._lock:
+            if self.read_only:
+                raise VolumeReadOnly(f"volume {self.id} is read-only")
+            self._dat.seek(0, os.SEEK_END)
+            offset = self._dat.tell()
+            if offset % types.NEEDLE_PADDING_SIZE:
+                pad = types.NEEDLE_PADDING_SIZE - offset % types.NEEDLE_PADDING_SIZE
+                self._dat.write(b"\x00" * pad)
+                offset += pad
+            rec = n.to_bytes(self.version)
+            self._dat.write(rec)
+            self._dat.flush()
+            stored = types.offset_to_bytes(offset)
+            self.nm.set(n.id, stored, n.size)
+            self._idx.write(types.pack_index_entry(n.id, stored, n.size))
+            self._idx.flush()
+            return offset, n.size
+
+    def delete_needle(self, needle_id: int) -> bool:
+        """Tombstone a needle; returns False if absent."""
+        with self._lock:
+            if self.read_only:
+                raise VolumeReadOnly(f"volume {self.id} is read-only")
+            if self.nm.get(needle_id) is None:
+                return False
+            tomb = Needle(id=needle_id, cookie=0)
+            self._dat.seek(0, os.SEEK_END)
+            self._dat.write(tomb.to_bytes(self.version))
+            self._dat.flush()
+            self.nm.delete(needle_id)
+            self._idx.write(
+                types.pack_index_entry(needle_id, 0, types.TOMBSTONE_FILE_SIZE)
+            )
+            self._idx.flush()
+            return True
+
+    # -- read path -----------------------------------------------------------
+
+    def read_needle(self, needle_id: int, cookie: Optional[int] = None) -> Needle:
+        with self._lock:
+            loc = self.nm.get(needle_id)
+            if loc is None:
+                raise KeyError(f"needle {needle_id} not found in volume {self.id}")
+            stored, size = loc
+            offset = types.offset_to_actual(stored)
+            self._dat.seek(offset)
+            buf = self._dat.read(types.actual_size(size, self.version))
+        n = Needle.from_bytes(buf, self.version)
+        if n.id != needle_id:
+            raise IOError(f"needle id mismatch at {offset}: {n.id:x} != {needle_id:x}")
+        if cookie is not None and n.cookie != cookie:
+            raise PermissionError(f"needle {needle_id:x}: cookie mismatch")
+        return n
+
+    def content_size(self) -> int:
+        with self._lock:
+            self._dat.seek(0, os.SEEK_END)
+            return self._dat.tell()
+
+    def needle_count(self) -> int:
+        return len(self.nm)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def check_integrity(self) -> int:
+        """Scan the .dat tail records parse + crc; returns live needle count
+        (volume_checking.go analog — here a full sweep of indexed needles)."""
+        ok = 0
+        for key, stored, size in self.nm.ascending_visit():
+            self.read_needle(key)  # raises on parse/crc error
+            ok += 1
+        return ok
+
+    def compact(self) -> tuple[int, int]:
+        """Vacuum: rewrite live needles into fresh .dat/.idx
+        (volume_vacuum.go analog). Returns (bytes_before, bytes_after)."""
+        with self._lock:
+            before = self.content_size()
+            cpd_dat, cpd_idx = self.dat_path + ".cpd", self.idx_path + ".cpx"
+            new_sb = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compact_revision=self.super_block.compact_revision + 1,
+            )
+            with open(cpd_dat, "wb") as dat, open(cpd_idx, "wb") as idxf:
+                dat.write(new_sb.to_bytes())
+                for key, stored, size in self.nm.ascending_visit():
+                    n = self.read_needle(key)
+                    offset = dat.tell()
+                    rec = n.to_bytes(self.version)
+                    dat.write(rec)
+                    idxf.write(
+                        types.pack_index_entry(key, types.offset_to_bytes(offset), n.size)
+                    )
+            self._dat.close()
+            self._idx.close()
+            os.replace(cpd_dat, self.dat_path)
+            os.replace(cpd_idx, self.idx_path)
+            self._dat = open(self.dat_path, "r+b")
+            self._idx = open(self.idx_path, "ab")
+            self.super_block = new_sb
+            self.nm = CompactMap()
+            self.nm.load_from_idx(self.idx_path)
+            return before, self.content_size()
+
+    def incremental_backup_since(self, offset: int) -> bytes:
+        """Bytes appended since `offset` (volume_backup.go analog)."""
+        with self._lock:
+            self._dat.seek(offset)
+            return self._dat.read()

@@ -1,0 +1,9 @@
+"""Device identification helpers shared across backend-selection sites."""
+
+from __future__ import annotations
+
+
+def is_tpu_device(d) -> bool:
+    """True for real TPUs and for the axon tunnel (platform=="axon",
+    device_kind "TPU v5 lite")."""
+    return d.platform in ("tpu", "axon") or "tpu" in d.device_kind.lower()

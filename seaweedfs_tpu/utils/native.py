@@ -1,0 +1,124 @@
+"""ctypes binding to libweedtpu.so (native/weedtpu.cc) — the C++ runtime
+kernels (CRC32C, AVX2 GF(2^8) baseline). Builds the library on first use if
+the toolchain is present; everything degrades to pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libweedtpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.weedtpu_crc32c.restype = ctypes.c_uint32
+            lib.weedtpu_crc32c.argtypes = [
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+            ]
+            lib.weedtpu_has_avx2.restype = ctypes.c_int
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+# ---------------------------------------------------------------------------
+# CRC32C
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: Optional[list[int]] = None
+
+
+def _py_table() -> list[int]:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        tbl = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+            tbl.append(crc)
+        _CRC_TABLE = tbl
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C (Castagnoli) — the needle checksum algorithm
+    [VERIFY: weed/storage/needle/needle_read_write.go uses Castagnoli]."""
+    lib = load()
+    if lib is not None:
+        return lib.weedtpu_crc32c(crc, bytes(data), len(data))
+    tbl = _py_table()
+    c = crc ^ 0xFFFFFFFF
+    for b in data:
+        c = (c >> 8) ^ tbl[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+def gf_matrix_apply_native(matrix, inputs, length: int):
+    """Native (AVX2 when available) GF matrix apply over byte slices.
+
+    matrix: (R, C) uint8 numpy array; inputs: list of C bytes-like of `length`.
+    Returns list of R bytearrays, or None if the library is unavailable.
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    rows, cols = matrix.shape
+    in_bufs = [np.ascontiguousarray(np.frombuffer(i, dtype=np.uint8)) for i in inputs]
+    out_bufs = [np.zeros(length, dtype=np.uint8) for _ in range(rows)]
+    InArr = ctypes.c_char_p * cols
+    OutArr = ctypes.c_void_p * rows
+    ins = InArr(*[i.ctypes.data_as(ctypes.c_char_p) for i in in_bufs])
+    outs = OutArr(*[o.ctypes.data_as(ctypes.c_void_p) for o in out_bufs])
+    lib.weedtpu_gf_matrix_apply(
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_uint32(rows),
+        ctypes.c_uint32(cols),
+        ins,
+        outs,
+        ctypes.c_uint64(length),
+    )
+    return out_bufs
+
+
+def has_avx2() -> bool:
+    lib = load()
+    return bool(lib and lib.weedtpu_has_avx2())
