@@ -15,7 +15,7 @@
 #include <cstdint>
 #include <cstring>
 
-#if defined(__AVX2__)
+#if defined(__x86_64__)
 #include <immintrin.h>
 #endif
 
@@ -26,10 +26,11 @@ extern "C" {
 // ---------------------------------------------------------------------------
 
 static uint32_t crc32c_table[8][256];
-static bool crc32c_init_done = false;
 
-static void crc32c_init() {
-  if (crc32c_init_done) return;
+// Table init runs as a static constructor during dlopen (single-threaded),
+// so concurrent first calls from GIL-released ctypes threads see a fully
+// published table — no lazy-init data race.
+static const int crc32c_initialized = [] {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t crc = i;
     for (int j = 0; j < 8; j++)
@@ -40,11 +41,10 @@ static void crc32c_init() {
     for (int s = 1; s < 8; s++)
       crc32c_table[s][i] =
           (crc32c_table[s - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[s - 1][i] & 0xFF];
-  crc32c_init_done = true;
-}
+  return 1;
+}();
 
 uint32_t weedtpu_crc32c(uint32_t crc, const uint8_t* buf, uint64_t len) {
-  crc32c_init();
   crc = ~crc;
   while (len >= 8) {
     uint64_t word;
@@ -66,10 +66,8 @@ uint32_t weedtpu_crc32c(uint32_t crc, const uint8_t* buf, uint64_t len) {
 // ---------------------------------------------------------------------------
 
 static uint8_t gf_mul_table[256][256];
-static bool gf_init_done = false;
 
-static void gf_init() {
-  if (gf_init_done) return;
+static const int gf_initialized = [] {
   for (int a = 0; a < 256; a++) {
     for (int b = 0; b < 256; b++) {
       uint16_t x = (uint16_t)a, r = 0, y = (uint16_t)b;
@@ -82,20 +80,20 @@ static void gf_init() {
       gf_mul_table[a][b] = (uint8_t)r;
     }
   }
-  gf_init_done = true;
-}
+  return 1;
+}();
 
-// dst[i] ^= gmul(c, src[i]) for i in [0, len)
-void weedtpu_gf_mul_xor_slice(uint8_t c, const uint8_t* src, uint8_t* dst,
-                              uint64_t len) {
-  gf_init();
-  if (c == 0) return;
-#if defined(__AVX2__)
+#if defined(__x86_64__)
+// AVX2 body compiled with a per-function target attribute and selected at
+// runtime via __builtin_cpu_supports, so one binary runs on any x86-64 host
+// (no -mavx2 global flag, no SIGILL on pre-AVX2 machines).
+__attribute__((target("avx2"))) static void gf_mul_xor_slice_avx2(
+    const uint8_t* row, const uint8_t* src, uint8_t* dst, uint64_t len) {
   // PSHUFB nibble tables: y = lo_tbl[x & 0xF] ^ hi_tbl[x >> 4]
   uint8_t lo[16], hi[16];
   for (int i = 0; i < 16; i++) {
-    lo[i] = gf_mul_table[c][i];
-    hi[i] = gf_mul_table[c][i << 4];
+    lo[i] = row[i];
+    hi[i] = row[i << 4];
   }
   const __m256i vlo = _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)lo));
   const __m256i vhi = _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)hi));
@@ -110,18 +108,28 @@ void weedtpu_gf_mul_xor_slice(uint8_t c, const uint8_t* src, uint8_t* dst,
     __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
     _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(d, y));
   }
-  for (; i < len; i++) dst[i] ^= gf_mul_table[c][src[i]];
-#else
-  const uint8_t* row = gf_mul_table[c];
-  for (uint64_t i = 0; i < len; i++) dst[i] ^= row[src[i]];
+  for (; i < len; i++) dst[i] ^= row[src[i]];
+}
 #endif
+
+// dst[i] ^= gmul(c, src[i]) for i in [0, len)
+void weedtpu_gf_mul_xor_slice(uint8_t c, const uint8_t* src, uint8_t* dst,
+                              uint64_t len) {
+  if (c == 0) return;
+  const uint8_t* row = gf_mul_table[c];
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("avx2")) {
+    gf_mul_xor_slice_avx2(row, src, dst, len);
+    return;
+  }
+#endif
+  for (uint64_t i = 0; i < len; i++) dst[i] ^= row[src[i]];
 }
 
 // outputs[r] = XOR_c gmul(matrix[r*cols+c], inputs[c]), each slice `len` bytes
 void weedtpu_gf_matrix_apply(const uint8_t* matrix, uint32_t rows, uint32_t cols,
                              const uint8_t* const* inputs, uint8_t* const* outputs,
                              uint64_t len) {
-  gf_init();
   for (uint32_t r = 0; r < rows; r++) {
     memset(outputs[r], 0, len);
     for (uint32_t c0 = 0; c0 < cols; c0++) {
@@ -132,8 +140,8 @@ void weedtpu_gf_matrix_apply(const uint8_t* matrix, uint32_t rows, uint32_t cols
 }
 
 int weedtpu_has_avx2() {
-#if defined(__AVX2__)
-  return 1;
+#if defined(__x86_64__)
+  return __builtin_cpu_supports("avx2") ? 1 : 0;
 #else
   return 0;
 #endif

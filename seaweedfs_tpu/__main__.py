@@ -1,0 +1,35 @@
+"""`python -m seaweedfs_tpu <command>` — the `weed`-style single entry point
+(ref: weed/command CLI layout, SURVEY.md §2.1 [VERIFY: mount empty])."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from seaweedfs_tpu.command import commands
+
+
+def main(argv=None) -> int:
+    cmds = commands()
+    parser = argparse.ArgumentParser(
+        prog="seaweedfs_tpu",
+        description="TPU-native SeaweedFS-capability framework",
+    )
+    sub = parser.add_subparsers(dest="command", metavar="command")
+    for cmd in cmds.values():
+        p = sub.add_parser(cmd.name, help=cmd.help)
+        cmd.configure(p)
+        p.set_defaults(_run=cmd.run)
+    args = parser.parse_args(argv)
+    if not getattr(args, "_run", None):
+        parser.print_help()
+        return 2
+    try:
+        return args._run(args)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

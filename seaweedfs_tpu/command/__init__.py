@@ -1,0 +1,34 @@
+"""CLI command registry — mirror of weed/command's Command-struct pattern
+[VERIFY: mount empty; SURVEY.md §2.1 "CLI entry"]. Each command module
+registers a `Command(name, help, run)`; `seaweedfs_tpu.__main__` dispatches.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass
+class Command:
+    name: str
+    help: str
+    configure: Callable[[argparse.ArgumentParser], None]
+    run: Callable[[argparse.Namespace], int]
+
+
+_REGISTRY: dict[str, Command] = {}
+
+
+def register(cmd: Command) -> Command:
+    _REGISTRY[cmd.name] = cmd
+    return cmd
+
+
+def commands() -> dict[str, Command]:
+    # import for side effect of registration
+    from seaweedfs_tpu.command import local  # noqa: F401
+    from seaweedfs_tpu.command import servers  # noqa: F401
+
+    return dict(_REGISTRY)

@@ -1,0 +1,215 @@
+"""Local-file commands: the single-chip `tpuec` slice of SURVEY.md §7.1.3 —
+encode | rebuild | decode | verify on volume files — plus the maintenance
+commands `fix`, `compact`, `export` (mirrors of weed/command/fix.go,
+compact.go, export.go [VERIFY: mount empty]).
+
+All of these operate on a volume *base path* (`/dir/[collection_]<vid>`,
+no extension), like the reference's `-dir` + `-volumeId` flags resolve to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from seaweedfs_tpu.command import Command, register
+from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.ec.constants import (
+    DATA_SHARDS_COUNT,
+    ERASURE_CODING_LARGE_BLOCK_SIZE,
+    ERASURE_CODING_SMALL_BLOCK_SIZE,
+    TOTAL_SHARDS_COUNT,
+)
+from seaweedfs_tpu.ops.rs_codec import new_encoder
+from seaweedfs_tpu.storage import scan as scan_mod
+from seaweedfs_tpu.storage import types
+
+
+def _add_base(p: argparse.ArgumentParser) -> None:
+    p.add_argument("base", help="volume base path: /dir/[collection_]<vid> (no extension)")
+
+
+def _add_geometry(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--large-block",
+        type=int,
+        default=ERASURE_CODING_LARGE_BLOCK_SIZE,
+        help="large stripe block size in bytes (default 1 GiB)",
+    )
+    p.add_argument(
+        "--small-block",
+        type=int,
+        default=ERASURE_CODING_SMALL_BLOCK_SIZE,
+        help="small stripe block size in bytes (default 1 MiB)",
+    )
+
+
+def _run_encode(args: argparse.Namespace) -> int:
+    if not os.path.exists(args.base + ".dat"):
+        print(f"no such file: {args.base}.dat", file=sys.stderr)
+        return 1
+    stripe.write_ec_files(
+        args.base, large_block_size=args.large_block, small_block_size=args.small_block
+    )
+    if os.path.exists(args.base + ".idx"):
+        stripe.write_sorted_file_from_idx(args.base)
+    else:
+        print(f"note: {args.base}.idx missing — wrote shards only, no .ecx", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "encoded": args.base,
+                "dat_bytes": os.path.getsize(args.base + ".dat"),
+                "shard_bytes": os.path.getsize(stripe.shard_file_name(args.base, 0)),
+                "shards": TOTAL_SHARDS_COUNT,
+            }
+        )
+    )
+    return 0
+
+
+def _run_rebuild(args: argparse.Namespace) -> int:
+    rebuilt = stripe.rebuild_ec_files(args.base)
+    print(json.dumps({"rebuilt_shards": rebuilt}))
+    return 0
+
+
+def _run_decode(args: argparse.Namespace) -> int:
+    present = stripe.find_local_shards(args.base)
+    missing_data = [s for s in range(DATA_SHARDS_COUNT) if s not in present]
+    if missing_data:
+        if len(present) < DATA_SHARDS_COUNT:
+            print(
+                f"cannot decode: shards {missing_data} missing and only "
+                f"{len(present)} survivors",
+                file=sys.stderr,
+            )
+            return 1
+        stripe.rebuild_ec_files(args.base)
+    stripe.write_dat_file(args.base, dat_file_size=args.dat_size)
+    if os.path.exists(args.base + ".ecx"):
+        stripe.write_idx_file_from_ec_index(args.base)
+    print(json.dumps({"decoded": args.base + ".dat", "bytes": os.path.getsize(args.base + ".dat")}))
+    return 0
+
+
+def _run_verify(args: argparse.Namespace) -> int:
+    """Re-encode data shards chunkwise and compare against stored parity."""
+    import numpy as np
+
+    present = stripe.find_local_shards(args.base)
+    if len(present) != TOTAL_SHARDS_COUNT:
+        print(
+            f"verify needs all {TOTAL_SHARDS_COUNT} shards, found {sorted(present)}",
+            file=sys.stderr,
+        )
+        return 1
+    enc = new_encoder()
+    shard_size = os.path.getsize(stripe.shard_file_name(args.base, 0))
+    chunk = 4 * 1024 * 1024
+    files = [open(stripe.shard_file_name(args.base, s), "rb") for s in range(TOTAL_SHARDS_COUNT)]
+    try:
+        for off in range(0, shard_size, chunk):
+            n = min(chunk, shard_size - off)
+            shards = [stripe.read_padded(f, off, n) for f in files]
+            if not enc.verify(shards):
+                print(json.dumps({"verified": False, "bad_chunk_offset": off}))
+                return 1
+    finally:
+        for f in files:
+            f.close()
+    print(json.dumps({"verified": True, "shard_bytes": shard_size}))
+    return 0
+
+
+def _run_fix(args: argparse.Namespace) -> int:
+    count = scan_mod.rebuild_idx(args.base)
+    print(json.dumps({"fixed": args.base + ".idx", "records": count}))
+    return 0
+
+
+def _run_compact(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.storage.store import parse_base_name
+    from seaweedfs_tpu.storage.volume import Volume
+
+    d, base = os.path.split(args.base)
+    parsed = parse_base_name(base)
+    if parsed is None:
+        print(f"cannot parse volume id from {base!r}", file=sys.stderr)
+        return 1
+    collection, vid = parsed
+    with Volume(d or ".", vid, collection) as v:
+        before, after = v.compact()
+    print(json.dumps({"compacted": args.base, "bytes_before": before, "bytes_after": after}))
+    return 0
+
+
+def _run_export(args: argparse.Namespace) -> int:
+    """Dump live needles as JSON lines (weed export analog). Two passes so
+    memory stays O(index): collect live (offset,size) first, then re-read
+    one needle at a time while emitting."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+    dat_path = args.base + ".dat"
+    live: dict[int, tuple[int, int]] = {}
+    for offset, n in scan_mod.scan_volume_file(dat_path, verify_crc=False):
+        if n.size > 0:
+            live[n.id] = (offset, n.size)
+        else:
+            live.pop(n.id, None)
+    with open(dat_path, "rb") as f:
+        version = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE)).version
+        for nid, (offset, size) in sorted(live.items()):
+            f.seek(offset)
+            n = Needle.from_bytes(f.read(types.actual_size(size, version)), version)
+            rec = {
+                "id": f"{nid:x}",
+                "cookie": f"{n.cookie:08x}",
+                "offset": offset,
+                "size": n.size,
+                "name": n.name.decode("utf-8", "replace"),
+                "mime": n.mime.decode("utf-8", "replace"),
+                "data_size": len(n.data),
+            }
+            if args.data:
+                import base64
+
+                rec["data"] = base64.b64encode(n.data).decode()
+            print(json.dumps(rec))
+    return 0
+
+
+def _simple(name: str, help_: str, run, extra_conf=None) -> None:
+    def conf(p: argparse.ArgumentParser) -> None:
+        _add_base(p)
+        if extra_conf:
+            extra_conf(p)
+
+    register(Command(name, help_, conf, run))
+
+
+_simple(
+    "encode",
+    "EC-encode a volume: <base>.dat [+.idx] -> .ec00..13 + .ecx (TPU matmul path)",
+    _run_encode,
+    _add_geometry,
+)
+_simple("rebuild", "reconstruct missing .ecNN shards from >=10 survivors", _run_rebuild)
+_simple(
+    "decode",
+    "shards -> <base>.dat (+.idx from .ecx/.ecj)",
+    _run_decode,
+    lambda p: p.add_argument("--dat-size", type=int, default=None),
+)
+_simple("verify", "re-encode data shards and compare stored parity", _run_verify)
+_simple("fix", "rebuild <base>.idx by scanning <base>.dat", _run_fix)
+_simple("compact", "vacuum a volume: rewrite live needles, drop deleted", _run_compact)
+_simple(
+    "export",
+    "dump live needles as JSON lines",
+    _run_export,
+    lambda p: p.add_argument("--data", action="store_true", help="include base64 data"),
+)

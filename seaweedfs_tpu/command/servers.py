@@ -1,0 +1,24 @@
+"""Process-level commands (currently: version). The cluster commands —
+master, volume, server, shell, benchmark (SURVEY.md §2.1) — register here
+as the cluster layer lands."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from seaweedfs_tpu.command import Command, register
+
+
+def _version_conf(p: argparse.ArgumentParser) -> None:
+    pass
+
+
+def _version_run(args: argparse.Namespace) -> int:
+    import seaweedfs_tpu
+
+    print(f"seaweedfs_tpu {seaweedfs_tpu.__version__}")
+    return 0
+
+
+register(Command("version", "print version", _version_conf, _version_run))

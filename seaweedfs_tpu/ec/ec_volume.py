@@ -199,7 +199,14 @@ class EcVolume:
 
     # -- deletes -------------------------------------------------------------
 
-    def delete_needle(self, needle_id: int) -> None:
-        """Append to the deletion journal (VolumeEcBlobDelete semantics)."""
+    def delete_needle(self, needle_id: int) -> bool:
+        """Append to the deletion journal (VolumeEcBlobDelete semantics).
+        Returns False (and journals nothing) when the needle is absent or
+        already deleted, matching Volume.delete_needle."""
+        try:
+            self.find_needle_from_ecx(needle_id)
+        except (NeedleNotFound, NeedleDeleted):
+            return False
         stripe.append_ecj(self.base, needle_id)
         self._deleted.add(needle_id)
+        return True

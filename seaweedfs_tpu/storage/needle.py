@@ -84,13 +84,18 @@ class Needle:
 
     # -- encode --------------------------------------------------------------
 
-    def to_bytes(self, version: int = CURRENT_VERSION) -> bytes:
+    def to_bytes(self, version: int = CURRENT_VERSION, tombstone: bool = False) -> bytes:
+        """Encode the record. Live needles always carry a body (DataSize +
+        flags at minimum, so size >= 5 even for empty data); a tombstone
+        (delete marker appended by Volume.delete_needle) has size == 0 —
+        that's what makes deletes distinguishable from empty writes when
+        rebuilding an index by .dat scan."""
         if version not in (VERSION2, VERSION3):
             raise ValueError(f"unsupported needle version {version}")
         if len(self.name) > 255 or len(self.mime) > 255:
             raise ValueError("name/mime limited to 255 bytes")
         body = bytearray()
-        if self.data:
+        if not tombstone:
             body += struct.pack(">I", len(self.data))
             body += self.data
             body.append(self.flags)
@@ -135,9 +140,18 @@ class Needle:
             raise ValueError(
                 f"buffer too short: body says {size}, have {len(buf) - pos}"
             )
+        def need(k: int) -> None:
+            if pos + k > end_of_body:
+                raise ValueError(
+                    f"needle {nid:x}: corrupt body — field of {k} bytes at "
+                    f"{pos} exceeds body end {end_of_body}"
+                )
+
         if size > 0:
+            need(4)
             (data_size,) = struct.unpack_from(">I", buf, pos)
             pos += 4
+            need(data_size + 1)
             n.data = bytes(buf[pos : pos + data_size])
             pos += data_size
             flags = buf[pos]
@@ -145,24 +159,32 @@ class Needle:
             n.is_compressed = bool(flags & FLAG_IS_COMPRESSED)
             n.is_chunk_manifest = bool(flags & FLAG_IS_CHUNK_MANIFEST)
             if flags & FLAG_HAS_NAME:
+                need(1)
                 ln = buf[pos]
                 pos += 1
+                need(ln)
                 n.name = bytes(buf[pos : pos + ln])
                 pos += ln
             if flags & FLAG_HAS_MIME:
+                need(1)
                 lm = buf[pos]
                 pos += 1
+                need(lm)
                 n.mime = bytes(buf[pos : pos + lm])
                 pos += lm
             if flags & FLAG_HAS_LAST_MODIFIED:
+                need(LAST_MODIFIED_BYTES)
                 n.last_modified = int.from_bytes(buf[pos : pos + LAST_MODIFIED_BYTES], "big")
                 pos += LAST_MODIFIED_BYTES
             if flags & FLAG_HAS_TTL:
+                need(TTL_BYTES)
                 n.ttl = bytes(buf[pos : pos + TTL_BYTES])
                 pos += TTL_BYTES
             if flags & FLAG_HAS_PAIRS:
+                need(2)
                 (lp,) = struct.unpack_from(">H", buf, pos)
                 pos += 2
+                need(lp)
                 n.pairs = bytes(buf[pos : pos + lp])
                 pos += lp
             if pos != end_of_body:

@@ -134,8 +134,7 @@ class Store:
             return v.delete_needle(needle_id)
         ev = self.get_ec_volume(vid)
         if ev is not None:
-            ev.delete_needle(needle_id)
-            return True
+            return ev.delete_needle(needle_id)
         raise KeyError(f"volume {vid} not found")
 
     # -- EC volumes (store_ec.go analog) -------------------------------------

@@ -42,15 +42,25 @@ class TTL:
             return cls()
         unit = s[-1]
         if unit.isdigit():
-            return cls(int(s), "m")
-        if unit not in TTL_UNIT_CODES:
+            count, unit = int(s), "m"
+        elif unit not in TTL_UNIT_CODES:
             raise ValueError(f"bad ttl unit {unit!r}")
-        return cls(int(s[:-1] or "0"), unit)
+        else:
+            count = int(s[:-1] or "0")
+        if not 0 <= count <= 255:
+            # one on-disk byte holds the count: silently wrapping (300m ->
+            # 44m) would expire data early, so reject at the boundary
+            raise ValueError(
+                f"ttl count {count}{unit} exceeds 255 — use a larger unit"
+            )
+        return cls(count, unit)
 
     def to_bytes(self) -> bytes:
         if not self.count:
             return b"\x00\x00"
-        return bytes([self.count & 0xFF, TTL_UNIT_CODES[self.unit]])
+        if not 0 < self.count <= 255:
+            raise ValueError(f"ttl count {self.count} not storable in one byte")
+        return bytes([self.count, TTL_UNIT_CODES[self.unit]])
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "TTL":

@@ -45,20 +45,35 @@ class Volume:
 
         exists = os.path.exists(self.dat_path)
         self._dat = open(self.dat_path, "r+b" if exists else "w+b")
-        if exists:
-            self._dat.seek(0, os.SEEK_END)
-            if self._dat.tell() >= 8:
-                self._dat.seek(0)
-                self.super_block = SuperBlock.from_bytes(self._dat.read(8))
+        try:
+            if exists:
+                self._dat.seek(0, os.SEEK_END)
+                dat_size = self._dat.tell()
+                if dat_size >= 8:
+                    self._dat.seek(0)
+                    self.super_block = SuperBlock.from_bytes(self._dat.read(8))
+                else:
+                    self.super_block = super_block or SuperBlock()
+                    self._write_super_block()
+                if not os.path.exists(self.idx_path) and dat_size > 8:
+                    # .dat has records but the index is gone (crash, manual
+                    # deletion): rebuild it by scan before serving, else
+                    # reads miss and a compact would wipe the volume.
+                    # Structure-only scan: per-needle CRC is not the index's
+                    # job — a flipped data bit surfaces on that needle's
+                    # read, not as a refusal to open the whole volume.
+                    from seaweedfs_tpu.storage.scan import rebuild_idx
+
+                    rebuild_idx(self.base_path, verify_crc=False)
+                if os.path.exists(self.idx_path):
+                    self.nm.load_from_idx(self.idx_path)
             else:
                 self.super_block = super_block or SuperBlock()
                 self._write_super_block()
-            if os.path.exists(self.idx_path):
-                self.nm.load_from_idx(self.idx_path)
-        else:
-            self.super_block = super_block or SuperBlock()
-            self._write_super_block()
-        self._idx = open(self.idx_path, "ab")
+            self._idx = open(self.idx_path, "ab")
+        except BaseException:
+            self._dat.close()
+            raise
 
     def _write_super_block(self) -> None:
         self._dat.seek(0)
@@ -111,7 +126,7 @@ class Volume:
                 return False
             tomb = Needle(id=needle_id, cookie=0)
             self._dat.seek(0, os.SEEK_END)
-            self._dat.write(tomb.to_bytes(self.version))
+            self._dat.write(tomb.to_bytes(self.version, tombstone=True))
             self._dat.flush()
             self.nm.delete(needle_id)
             self._idx.write(
@@ -160,8 +175,29 @@ class Volume:
     def compact(self) -> tuple[int, int]:
         """Vacuum: rewrite live needles into fresh .dat/.idx
         (volume_vacuum.go analog). Returns (bytes_before, bytes_after)."""
+        from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
+
         with self._lock:
             before = self.content_size()
+            idx_entries = (
+                os.path.getsize(self.idx_path)
+                if os.path.exists(self.idx_path)
+                else 0
+            )
+            if (
+                len(self.nm) == 0
+                and before > SUPER_BLOCK_SIZE
+                and idx_entries < types.NEEDLE_MAP_ENTRY_SIZE
+            ):
+                # An empty map with a non-empty .dat AND no index entries at
+                # all means the .idx was lost/never loaded — compacting would
+                # destroy every needle. (A legitimately fully-deleted volume
+                # also has an empty map, but its .idx holds tombstone
+                # entries, so it passes and compaction reclaims the space.)
+                raise IOError(
+                    f"volume {self.id}: index is empty but .dat holds "
+                    f"{before} bytes — refusing to compact (run fix)"
+                )
             cpd_dat, cpd_idx = self.dat_path + ".cpd", self.idx_path + ".cpx"
             new_sb = SuperBlock(
                 version=self.super_block.version,

@@ -32,13 +32,27 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when the .so is missing or older than its source."""
+    try:
+        so_mtime = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+    try:
+        src_mtime = os.path.getmtime(os.path.join(_NATIVE_DIR, "weedtpu.cc"))
+    except OSError:
+        return False
+    return src_mtime > so_mtime
+
+
 def load() -> Optional[ctypes.CDLL]:
-    """The loaded library, building it if needed; None if unavailable."""
+    """The loaded library, (re)building it when missing or out of date;
+    None if unavailable."""
     global _lib, _load_failed
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if _stale() and not _build() and not os.path.exists(_LIB_PATH):
             _load_failed = True
             return None
         try:
@@ -50,8 +64,11 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_uint64,
             ]
             lib.weedtpu_has_avx2.restype = ctypes.c_int
+            lib.weedtpu_gf_matrix_apply.restype = None
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # OSError: unloadable .so; AttributeError: a stale binary
+            # missing expected symbols. Either way fall back to Python.
             _load_failed = True
         return _lib
 

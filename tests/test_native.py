@@ -1,0 +1,38 @@
+"""Native C++ runtime lib (libweedtpu.so) vs pure-Python/numpy goldens."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf8
+from seaweedfs_tpu.utils import native
+
+
+def test_crc32c_native_matches_python():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    got = lib.weedtpu_crc32c(0, data, len(data))
+    # pure-python reference
+    tbl = native._py_table()
+    c = 0xFFFFFFFF
+    for b in data[:1000]:
+        c = (c >> 8) ^ tbl[(c ^ b) & 0xFF]
+    want_1k = c ^ 0xFFFFFFFF
+    assert lib.weedtpu_crc32c(0, data[:1000], 1000) == want_1k
+    assert native.crc32c(data) == got
+
+
+def test_gf_matrix_apply_native_matches_gf8():
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(1)
+    rows, cols, length = 4, 10, 4096
+    matrix = rng.integers(0, 256, size=(rows, cols), dtype=np.uint8)
+    inputs = [rng.integers(0, 256, size=length, dtype=np.uint8) for _ in range(cols)]
+    outs = native.gf_matrix_apply_native(matrix, [i.tobytes() for i in inputs], length)
+    assert outs is not None
+    want = gf8.gf_mat_vec(matrix, np.stack(inputs))
+    for r in range(rows):
+        np.testing.assert_array_equal(np.asarray(outs[r]), want[r])

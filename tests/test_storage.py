@@ -60,9 +60,14 @@ def test_needle_minimal_and_empty():
     n = Needle(cookie=1, id=2, data=b"x")
     m = Needle.from_bytes(n.to_bytes(), VERSION3)
     assert m.data == b"x" and not m.name
+    # a LIVE empty needle still carries DataSize+flags (size 5), so a .dat
+    # scan can tell it apart from a delete marker (size 0)
     empty = Needle(cookie=1, id=3)
     e = Needle.from_bytes(empty.to_bytes(), VERSION3)
-    assert e.data == b"" and e.size == 0
+    assert e.data == b"" and e.size == 5
+    tomb = Needle(cookie=0, id=3)
+    t = Needle.from_bytes(tomb.to_bytes(VERSION3, tombstone=True), VERSION3)
+    assert t.size == 0
 
 
 def test_needle_crc_rejects_corruption():
