@@ -1,0 +1,166 @@
+"""Client library — mirror of weed/wdclient (masterclient.go, vid_map.go) +
+weed/operation (assign_file_id.go, upload_content.go, lookup.go,
+delete_content.go, submit.go) [VERIFY: mount empty; SURVEY.md §2.1].
+
+MasterClient caches vid -> locations (the reference keeps it fresh via the
+KeepConnected stream; here a TTL cache refreshed by Lookup on miss/expiry).
+Operations: assign, upload (HTTP POST to the volume server), read, delete,
+and submit (assign+upload in one call).
+"""
+
+from __future__ import annotations
+
+import time
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.pb import MASTER_SERVICE, AssignResponse, Location
+
+_VID_CACHE_TTL = 30.0
+
+
+class ClusterError(Exception):
+    pass
+
+
+@dataclass
+class SubmitResult:
+    fid: str
+    url: str
+    size: int
+
+
+class MasterClient:
+    def __init__(self, master_address: str):
+        self.master_address = master_address
+        self._rpc = rpc.RpcClient(master_address)
+        self._lock = threading.Lock()
+        self._vid_cache: dict[int, tuple[float, list[Location]]] = {}
+
+    def close(self) -> None:
+        self._rpc.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- master RPCs ---------------------------------------------------------
+
+    def assign(
+        self,
+        count: int = 1,
+        collection: str = "",
+        replication: str = "",
+        ttl: str = "",
+    ) -> AssignResponse:
+        resp = AssignResponse.from_dict(
+            self._rpc.call(
+                MASTER_SERVICE,
+                "Assign",
+                {
+                    "count": count,
+                    "collection": collection,
+                    "replication": replication,
+                    "ttl": ttl,
+                },
+            )
+        )
+        if resp.error:
+            raise ClusterError(f"assign failed: {resp.error}")
+        return resp
+
+    def lookup(self, vid: int, refresh: bool = False) -> list[Location]:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._vid_cache.get(vid)
+            if hit and not refresh and now - hit[0] < _VID_CACHE_TTL:
+                return hit[1]
+        resp = self._rpc.call(
+            MASTER_SERVICE, "Lookup", {"volume_or_file_ids": [str(vid)]}
+        )
+        entries = resp.get("volume_id_locations", [])
+        locations = []
+        if entries and not entries[0].get("error"):
+            locations = [Location.from_dict(d) for d in entries[0]["locations"]]
+        with self._lock:
+            self._vid_cache[vid] = (now, locations)
+        return locations
+
+    def lookup_ec(self, vid: int) -> dict[int, list[Location]]:
+        resp = self._rpc.call(MASTER_SERVICE, "LookupEcVolume", {"volume_id": vid})
+        return {
+            e["shard_id"]: [Location.from_dict(d) for d in e["locations"]]
+            for e in resp.get("shard_id_locations", [])
+        }
+
+    def volume_list(self) -> dict:
+        return self._rpc.call(MASTER_SERVICE, "VolumeList", {})
+
+    def statistics(self) -> dict:
+        return self._rpc.call(MASTER_SERVICE, "Statistics", {})
+
+    # -- data ops (weed/operation analogs) ------------------------------------
+
+    def upload(self, fid: str, data: bytes, mime: str = "") -> int:
+        """POST to the volume server owning fid's volume."""
+        vid = int(fid.split(",", 1)[0])
+        locations = self.lookup(vid)
+        if not locations:
+            raise ClusterError(f"no locations for volume {vid}")
+        last_err: Optional[Exception] = None
+        for loc in locations:
+            try:
+                req = urllib.request.Request(
+                    f"http://{loc.url}/{fid}",
+                    data=data,
+                    method="POST",
+                    headers={"Content-Type": mime} if mime else {},
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                    return len(data)
+            except urllib.error.URLError as e:  # try a replica
+                last_err = e
+        raise ClusterError(f"upload of {fid} failed: {last_err}")
+
+    def read(self, fid: str) -> bytes:
+        vid = int(fid.split(",", 1)[0])
+        locations = self.lookup(vid)
+        if not locations:
+            raise ClusterError(f"no locations for volume {vid}")
+        last_err = None
+        for loc in locations:
+            try:
+                with urllib.request.urlopen(f"http://{loc.url}/{fid}", timeout=30) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                # 404 on one replica can be staleness (e.g. it was down
+                # during the write) — keep trying the others before failing
+                last_err = f"HTTP {e.code}"
+            except urllib.error.URLError as e:
+                last_err = e
+        raise ClusterError(f"read of {fid} failed on all locations: {last_err}")
+
+    def delete(self, fid: str) -> bool:
+        vid = int(fid.split(",", 1)[0])
+        ok = False
+        for loc in self.lookup(vid):
+            try:
+                req = urllib.request.Request(f"http://{loc.url}/{fid}", method="DELETE")
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                    ok = True
+            except urllib.error.URLError:
+                continue
+        return ok
+
+    def submit(self, data: bytes, collection: str = "", replication: str = "", mime: str = "") -> SubmitResult:
+        a = self.assign(collection=collection, replication=replication)
+        size = self.upload(a.fid, data, mime=mime)
+        return SubmitResult(fid=a.fid, url=a.url, size=size)

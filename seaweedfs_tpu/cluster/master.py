@@ -1,0 +1,226 @@
+"""Master server — mirror of weed/server/master_server.go +
+master_grpc_server*.go [VERIFY: mount empty; SURVEY.md §2.1 "Master" row].
+
+Hosts the weedtpu.Master RPC service over seaweedfs_tpu.rpc: heartbeat
+ingest into Topology, fid assignment (Assign -> grow volumes on demand via
+the volume servers' VolumeCreate RPC), volume/EC lookup, and the topology
+dump that powers shell commands. Single-master here; the reference's Raft
+HA seam is the MasterServer boundary — a follower forwards to the leader.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.cluster.sequence import MemorySequencer
+from seaweedfs_tpu.cluster.topology import Topology, VolumeLayout
+from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE, Heartbeat
+from seaweedfs_tpu.storage.file_id import FileId
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+
+class MasterServer:
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        volume_size_limit: Optional[int] = None,
+        default_replication: str = "000",
+        sequencer=None,
+        reap_interval: float = 30.0,
+    ):
+        self.topology = Topology(
+            **({"volume_size_limit": volume_size_limit} if volume_size_limit else {})
+        )
+        self.sequencer = sequencer or MemorySequencer()
+        self.default_replication = default_replication
+        self._rng = random.Random()
+        self._grow_lock = threading.Lock()
+        self._server = rpc.RpcServer(port=port, host=host)
+        self._server.add_service(self._build_service())
+        self.host = host
+        self.port = self._server.port
+        self._reap_interval = reap_interval
+        self._stop = threading.Event()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._server.start()
+        self._reaper.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(self._reap_interval):
+            self.topology.reap_dead_nodes()
+
+    # -- RPC surface ---------------------------------------------------------
+
+    def _build_service(self) -> rpc.Service:
+        svc = rpc.Service(MASTER_SERVICE)
+        svc.add("Heartbeat", self._rpc_heartbeat)
+        svc.add("Assign", self._rpc_assign)
+        svc.add("Lookup", self._rpc_lookup)
+        svc.add("LookupEcVolume", self._rpc_lookup_ec)
+        svc.add("VolumeList", self._rpc_volume_list)
+        svc.add("LeaveCluster", self._rpc_leave)
+        svc.add("Statistics", self._rpc_statistics)
+        return svc
+
+    def _rpc_heartbeat(self, req: dict, ctx) -> dict:
+        hb = Heartbeat.from_dict(req)
+        self.topology.process_heartbeat(hb)
+        return {
+            "volume_size_limit": self.topology.volume_size_limit,
+            "leader": self.address,
+        }
+
+    def _rpc_leave(self, req: dict, ctx) -> dict:
+        self.topology.unregister_node(req["url"])
+        return {}
+
+    def _rpc_assign(self, req: dict, ctx) -> dict:
+        count = int(req.get("count", 1))
+        collection = req.get("collection", "")
+        replication = req.get("replication") or self.default_replication
+        ttl = req.get("ttl", "")
+        layout = self.topology.get_layout(collection, replication, ttl)
+        picked = self.topology.pick_writable(layout, self._rng)
+        if picked is None:
+            self._grow_volumes(layout, collection, replication, ttl)
+            picked = self.topology.pick_writable(layout, self._rng)
+        if picked is None:
+            return {"error": "no writable volumes and growth failed", "count": 0}
+        vid, nodes = picked
+        key = self.sequencer.next_ids(count)
+        cookie = self._rng.getrandbits(32)
+        node = nodes[self._rng.randrange(len(nodes))]
+        return {
+            "fid": str(FileId(vid, key, cookie)),
+            "url": node.url,
+            "public_url": node.public_url,
+            "grpc_port": node.grpc_port,
+            "count": count,
+        }
+
+    def _rpc_lookup(self, req: dict, ctx) -> dict:
+        out = []
+        for raw in req.get("volume_or_file_ids", []):
+            vid_s = str(raw).split(",", 1)[0]
+            try:
+                vid = int(vid_s)
+            except ValueError:
+                out.append({"volume_id": vid_s, "error": "bad volume id", "locations": []})
+                continue
+            nodes = self.topology.lookup(vid, req.get("collection", ""))
+            entry = {
+                "volume_id": vid_s,
+                "locations": [
+                    {"url": n.url, "public_url": n.public_url, "grpc_port": n.grpc_port}
+                    for n in nodes
+                ],
+            }
+            if not nodes and vid not in self.topology.ec_locations:
+                entry["error"] = "volume not found"
+            out.append(entry)
+        return {"volume_id_locations": out}
+
+    def _rpc_lookup_ec(self, req: dict, ctx) -> dict:
+        vid = int(req["volume_id"])
+        shard_map = self.topology.lookup_ec_shards(vid)
+        if not shard_map:
+            raise rpc.NotFoundFault(f"ec volume {vid} not found")
+        return {
+            "volume_id": vid,
+            "shard_id_locations": [
+                {
+                    "shard_id": sid,
+                    "locations": [
+                        {"url": n.url, "public_url": n.public_url, "grpc_port": n.grpc_port}
+                        for n in nodes
+                    ],
+                }
+                for sid, nodes in sorted(shard_map.items())
+            ],
+        }
+
+    def _rpc_volume_list(self, req: dict, ctx) -> dict:
+        return self.topology.to_dict()
+
+    def _rpc_statistics(self, req: dict, ctx) -> dict:
+        t = self.topology
+        with t._lock:
+            total = sum(n.max_volume_count for n in t.nodes.values())
+            used = sum(len(n.volumes) for n in t.nodes.values())
+            return {
+                "node_count": len(t.nodes),
+                "volume_count": used,
+                "max_volume_count": total,
+                "ec_volume_count": len(t.ec_locations),
+            }
+
+    # -- growth (volume_growth.go analog) ------------------------------------
+
+    def _grow_volumes(self, layout: VolumeLayout, collection: str, replication: str, ttl: str) -> int:
+        """Create one new volume (all replicas) via VolumeCreate RPCs."""
+        with self._grow_lock:
+            if self.topology.pick_writable(layout, self._rng) is not None:
+                return 0  # raced: someone grew while we waited
+            rp = ReplicaPlacement.parse(replication or "000")
+            targets = self.topology.place_replicas(rp)
+            if not targets:
+                return 0
+            vid = self.topology.next_volume_id()
+            succeeded = []
+            for node in targets:
+                try:
+                    with rpc.RpcClient(node.grpc_address) as c:
+                        c.call(
+                            VOLUME_SERVICE,
+                            "VolumeCreate",
+                            {
+                                "volume_id": vid,
+                                "collection": collection,
+                                "replication": replication or "000",
+                                "ttl": ttl,
+                            },
+                        )
+                    succeeded.append(node)
+                except Exception:  # noqa: BLE001 — skip unreachable node
+                    continue
+            # registration happens via the next heartbeats; to serve the
+            # pending Assign immediately, register the nodes whose create
+            # actually succeeded
+            if succeeded:
+                from seaweedfs_tpu.pb import VolumeInformation
+
+                with self.topology._lock:
+                    for node in succeeded:
+                        vi = VolumeInformation(
+                            id=vid,
+                            collection=collection,
+                            replica_placement=replication or "000",
+                            ttl=ttl,
+                        )
+                        node.volumes[vid] = vi
+                        layout.register(vi, node)
+            return len(succeeded)

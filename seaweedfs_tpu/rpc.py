@@ -1,0 +1,235 @@
+"""JSON-over-gRPC transport — the control-plane RPC layer.
+
+The reference's control plane is gRPC with protobuf contracts
+(weed/pb/*.proto [VERIFY: mount empty; SURVEY.md §2.6]). This image ships
+grpcio but not grpcio-tools/protoc-gen-python, so instead of generated
+stubs the framework registers methods on grpc's *generic handler* API with
+two wire formats per method:
+
+  "json"  — request/response are UTF-8 JSON objects (control messages)
+  "bytes" — raw byte frames (bulk data: shard copy streams, interval reads);
+            metadata rides in gRPC invocation metadata, not the payload
+
+Method kinds: unary-unary, unary-stream (server streaming). That covers the
+reference's EC surface (SURVEY.md §2.4): control RPCs are unary, shard
+copy/read are server-streamed byte frames.
+
+Errors: handlers raising RpcFault abort with that code/detail; anything
+else maps to INTERNAL. Clients get grpc.RpcError as usual.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import Any, Callable, Iterator, Optional
+
+import grpc
+
+
+def _json_ser(obj: Any) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def _json_de(data: bytes) -> Any:
+    return json.loads(data.decode())
+
+
+def _bytes_ser(b: bytes) -> bytes:
+    return bytes(b)
+
+
+def _bytes_de(b: bytes) -> bytes:
+    return b
+
+
+_FORMATS = {
+    "json": (_json_ser, _json_de),
+    "bytes": (_bytes_ser, _bytes_de),
+}
+
+
+class RpcFault(Exception):
+    """Handler-raised fault with an explicit status code."""
+
+    def __init__(self, detail: str, code: grpc.StatusCode = grpc.StatusCode.INTERNAL):
+        super().__init__(detail)
+        self.code = code
+        self.detail = detail
+
+
+class NotFoundFault(RpcFault):
+    def __init__(self, detail: str):
+        super().__init__(detail, grpc.StatusCode.NOT_FOUND)
+
+
+class Method:
+    def __init__(
+        self,
+        fn: Callable,
+        kind: str = "unary_unary",
+        req_format: str = "json",
+        resp_format: str = "json",
+    ):
+        if kind not in ("unary_unary", "unary_stream", "stream_unary", "stream_stream"):
+            raise ValueError(f"bad rpc kind {kind}")
+        self.fn = fn
+        self.kind = kind
+        self.req_format = req_format
+        self.resp_format = resp_format
+
+
+class Service:
+    """A named bag of methods. Handlers receive (request, context)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: dict[str, Method] = {}
+
+    def method(self, name: str, kind: str = "unary_unary", req_format: str = "json", resp_format: str = "json"):
+        def deco(fn):
+            self.methods[name] = Method(fn, kind, req_format, resp_format)
+            return fn
+
+        return deco
+
+    def add(self, name: str, fn: Callable, **kw) -> None:
+        self.methods[name] = Method(fn, **kw)
+
+
+def _wrap_unary(fn):
+    def handler(request, context):
+        try:
+            return fn(request, context)
+        except RpcFault as e:
+            context.abort(e.code, e.detail)
+        except Exception as e:  # noqa: BLE001 — map to INTERNAL for the peer
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+    return handler
+
+
+def _wrap_stream(fn):
+    def handler(request, context):
+        try:
+            yield from fn(request, context)
+        except RpcFault as e:
+            context.abort(e.code, e.detail)
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+    return handler
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, services: dict[str, Service]):
+        self._services = services
+
+    def service(self, handler_call_details):
+        # method path: /<service>/<method>
+        _, svc_name, m_name = handler_call_details.method.split("/", 2)
+        svc = self._services.get(svc_name)
+        if svc is None:
+            return None
+        m = svc.methods.get(m_name)
+        if m is None:
+            return None
+        req_ser, req_de = _FORMATS[m.req_format]
+        resp_ser, resp_de = _FORMATS[m.resp_format]
+        if m.kind == "unary_unary":
+            return grpc.unary_unary_rpc_method_handler(
+                _wrap_unary(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+            )
+        if m.kind == "unary_stream":
+            return grpc.unary_stream_rpc_method_handler(
+                _wrap_stream(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+            )
+        if m.kind == "stream_unary":
+            return grpc.stream_unary_rpc_method_handler(
+                _wrap_unary(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+            )
+        return grpc.stream_stream_rpc_method_handler(
+            _wrap_stream(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+        )
+
+
+class RpcServer:
+    """grpc.server wrapper hosting Service objects on one port."""
+
+    def __init__(self, port: int = 0, max_workers: int = 16, host: str = "127.0.0.1"):
+        self._services: dict[str, Service] = {}
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", 64 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ],
+        )
+        self._server.add_generic_rpc_handlers((_GenericHandler(self._services),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._started = False
+
+    def add_service(self, svc: Service) -> None:
+        self._services[svc.name] = svc
+
+    def start(self) -> None:
+        self._server.start()
+        self._started = True
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        if self._started:
+            self._server.stop(grace).wait()
+            self._started = False
+
+
+class RpcClient:
+    """Channel wrapper: call(service, method, request) with lazy per-method
+    callables, JSON by default."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_send_message_length", 64 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ],
+        )
+        self._lock = threading.Lock()
+        self._stubs: dict[tuple, Callable] = {}
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _stub(self, service: str, method: str, kind: str, req_format: str, resp_format: str):
+        key = (service, method, kind)
+        with self._lock:
+            stub = self._stubs.get(key)
+            if stub is None:
+                req_ser, _ = _FORMATS[req_format]
+                _, resp_de = _FORMATS[resp_format]
+                path = f"/{service}/{method}"
+                factory = getattr(self._channel, kind)
+                stub = factory(path, request_serializer=req_ser, response_deserializer=resp_de)
+                self._stubs[key] = stub
+        return stub
+
+    def call(self, service: str, method: str, request: Any = None, timeout: float = 30.0) -> Any:
+        """Unary-unary JSON call."""
+        stub = self._stub(service, method, "unary_unary", "json", "json")
+        return stub(request if request is not None else {}, timeout=timeout)
+
+    def stream(
+        self, service: str, method: str, request: Any = None, timeout: float = 600.0,
+        resp_format: str = "bytes",
+    ) -> Iterator:
+        """Unary-stream call; defaults to raw byte frames (bulk transfer)."""
+        stub = self._stub(service, method, "unary_stream", "json", resp_format)
+        return stub(request if request is not None else {}, timeout=timeout)

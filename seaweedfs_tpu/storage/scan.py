@@ -52,6 +52,22 @@ def _valid_record_after(
                         return probe
                     except (ValueError, CrcError):
                         pass
+            elif size == 0:
+                # a delete marker is a legitimate survivor (deletes are often
+                # the last records). Its shape: cookie==0, nonzero id,
+                # checksum==crc32c(b"")==0 — enough constrained bytes to make
+                # a false positive on needle-data noise unlikely.
+                whole = types.actual_size(0, version)
+                cookie = int.from_bytes(mm[probe : probe + 4], "big")
+                nid = int.from_bytes(mm[probe + 4 : probe + 12], "big")
+                checksum = int.from_bytes(mm[probe + 16 : probe + 20], "big")
+                if (
+                    probe + whole <= file_size
+                    and cookie == 0
+                    and nid != 0
+                    and checksum == 0
+                ):
+                    return probe
             probe += types.NEEDLE_PADDING_SIZE
     return -1
 
@@ -75,6 +91,18 @@ def scan_volume_file(
             f.seek(offset)
             header = f.read(types.NEEDLE_HEADER_SIZE)
             size = int.from_bytes(header[12:16], "big", signed=True)
+            if size < 0:
+                # the volume only ever writes size >= 0 (deletes are size 0),
+                # so a negative size in .dat is always corruption — never
+                # yield it as a record (a flipped sign bit would otherwise
+                # silently tombstone a live needle on rebuild)
+                survivor = _valid_record_after(f, offset + 1, file_size, version)
+                if survivor >= 0:
+                    raise CorruptVolume(
+                        f"{dat_path}: negative size {size} at {offset} with a "
+                        f"valid record at {survivor} — corrupt size field"
+                    )
+                break
             whole = types.actual_size(size, version)
             body = f.read(whole - types.NEEDLE_HEADER_SIZE)
             rec = header + body
