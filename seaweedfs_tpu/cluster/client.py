@@ -131,20 +131,23 @@ class MasterClient:
 
     def read(self, fid: str) -> bytes:
         vid = int(fid.split(",", 1)[0])
-        locations = self.lookup(vid)
-        if not locations:
-            raise ClusterError(f"no locations for volume {vid}")
         last_err = None
-        for loc in locations:
-            try:
-                with urllib.request.urlopen(f"http://{loc.url}/{fid}", timeout=30) as r:
-                    return r.read()
-            except urllib.error.HTTPError as e:
-                # 404 on one replica can be staleness (e.g. it was down
-                # during the write) — keep trying the others before failing
-                last_err = f"HTTP {e.code}"
-            except urllib.error.URLError as e:
-                last_err = e
+        # second pass refreshes the vid cache: the volume may have moved
+        # (ec.encode cut-over, balance) since it was cached
+        for attempt in range(2):
+            locations = self.lookup(vid, refresh=attempt > 0)
+            if not locations and attempt > 0:
+                raise ClusterError(f"no locations for volume {vid}")
+            for loc in locations:
+                try:
+                    with urllib.request.urlopen(f"http://{loc.url}/{fid}", timeout=30) as r:
+                        return r.read()
+                except urllib.error.HTTPError as e:
+                    # 404 on one replica can be staleness (e.g. it was down
+                    # during the write) — keep trying the others before failing
+                    last_err = f"HTTP {e.code}"
+                except urllib.error.URLError as e:
+                    last_err = e
         raise ClusterError(f"read of {fid} failed on all locations: {last_err}")
 
     def delete(self, fid: str) -> bool:

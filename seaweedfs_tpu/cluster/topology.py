@@ -62,7 +62,8 @@ class DataNode:
             "max_volume_count": self.max_volume_count,
             "volumes": [v.to_dict() for v in self.volumes.values()],
             "ec_shards": [
-                EcVolumeInfo(vid, bits).to_dict() for vid, bits in self.ec_shards.items()
+                EcVolumeInfo(vid, shard_bits=bits).to_dict()
+                for vid, bits in self.ec_shards.items()
             ],
         }
 
@@ -300,5 +301,8 @@ class Topology:
                 "ec_volumes": {
                     str(vid): {str(sid): sorted(urls) for sid, urls in m.items()}
                     for vid, m in self.ec_locations.items()
+                },
+                "ec_collections": {
+                    str(vid): coll for vid, coll in self.ec_collections.items()
                 },
             }

@@ -211,6 +211,7 @@ class VolumeServer:
         add("VolumeMarkReadonly", self._rpc_mark_readonly)
         add("VolumeMarkWritable", self._rpc_mark_writable)
         add("VolumeCompact", self._rpc_compact)
+        add("VolumeCopy", self._rpc_volume_copy)
         add("VolumeStatus", self._rpc_volume_status)
         add("WriteNeedle", self._rpc_write_needle)
         add("DeleteNeedle", self._rpc_delete_needle)
@@ -247,6 +248,7 @@ class VolumeServer:
                     p = v.base_path + ext
                     if os.path.exists(p):
                         os.remove(p)
+        self.heartbeat_once()  # push the deletion to the master now
         return {}
 
     def _rpc_mark_readonly(self, req: dict, ctx) -> dict:
@@ -269,6 +271,46 @@ class VolumeServer:
             raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
         before, after = v.compact()
         return {"bytes_before": before, "bytes_after": after}
+
+    def _rpc_volume_copy(self, req: dict, ctx) -> dict:
+        """VolumeCopy: pull a volume's .dat/.idx from source_data_node and
+        load it locally (volume_grpc_copy.go analog; serves
+        volume.fix.replication)."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        if self.store.get_volume(vid) is not None:
+            raise rpc.RpcFault(f"volume {vid} already exists locally")
+        base = self._base_path_for(vid, collection)
+        # pull BOTH files to temp names, rename only once both are complete:
+        # a half-copied volume must never be discoverable by Store.load()
+        tmps = {ext: base + ext + ".cpy" for ext in (".dat", ".idx")}
+        try:
+            with rpc.RpcClient(req["source_data_node"]) as c:
+                for ext, tmp in tmps.items():
+                    chunks = c.stream(
+                        VOLUME_SERVICE,
+                        "VolumeEcShardFileCopy",
+                        {"volume_id": vid, "collection": collection, "ext": ext},
+                    )
+                    with open(tmp, "wb") as f:
+                        for chunk in chunks:
+                            f.write(chunk)
+            for ext, tmp in tmps.items():
+                os.replace(tmp, base + ext)
+        finally:
+            for tmp in tmps.values():
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+        from seaweedfs_tpu.storage.volume import Volume
+
+        loc = next(
+            l for l in self.store.locations if os.path.dirname(base) == l.directory
+        )
+        v = Volume(loc.directory, vid, collection)
+        v.read_only = bool(req.get("read_only", False))
+        loc.volumes[vid] = v
+        self.heartbeat_once()
+        return {"size": os.path.getsize(base + ".dat")}
 
     def _rpc_volume_status(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])
@@ -348,10 +390,14 @@ class VolumeServer:
                         {"volume_id": vid, "collection": collection, "ext": name},
                     )
                     tmp = base + name + ".cpy"
-                    with open(tmp, "wb") as f:
-                        for chunk in chunks:
-                            f.write(chunk)
-                    os.replace(tmp, base + name)
+                    try:
+                        with open(tmp, "wb") as f:
+                            for chunk in chunks:
+                                f.write(chunk)
+                        os.replace(tmp, base + name)
+                    finally:
+                        if os.path.exists(tmp):
+                            os.remove(tmp)
                 except Exception:
                     if name in (".ecj", ".eci"):  # optional files
                         continue

@@ -1,11 +1,14 @@
-"""Process-level commands (currently: version). The cluster commands —
-master, volume, server, shell, benchmark (SURVEY.md §2.1) — register here
-as the cluster layer lands."""
+"""Process-level commands — master / volume / server / shell / version,
+mirroring weed/command/{master,volume,server,shell}.go [VERIFY: mount
+empty; SURVEY.md §2.1 "CLI entry"]. `server` runs master+volume in one
+process like `weed server`."""
 
 from __future__ import annotations
 
 import argparse
+import signal
 import sys
+import threading
 
 from seaweedfs_tpu.command import Command, register
 
@@ -22,3 +25,124 @@ def _version_run(args: argparse.Namespace) -> int:
 
 
 register(Command("version", "print version", _version_conf, _version_run))
+
+
+def _wait_forever() -> None:
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # not main thread (tests)
+            break
+    stop.wait()
+
+
+def _master_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-defaultReplication", default="000")
+
+
+def _master_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.cluster.master import MasterServer
+
+    m = MasterServer(
+        port=args.port,
+        host=args.ip,
+        volume_size_limit=args.volumeSizeLimitMB * 1024 * 1024,
+        default_replication=args.defaultReplication,
+    )
+    m.start()
+    print(f"master listening on {m.address}")
+    _wait_forever()
+    m.stop()
+    return 0
+
+
+register(Command("master", "run a master server", _master_conf, _master_run))
+
+
+def _volume_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-grpcPort", type=int, default=0)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-dir", action="append", default=None, help="storage directory (repeatable)")
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-dataCenter", default="DefaultDataCenter")
+    p.add_argument("-rack", default="DefaultRack")
+    p.add_argument("-max", type=int, default=8, help="max volume count")
+
+
+def _volume_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    vs = VolumeServer(
+        args.dir or ["./data"],
+        args.mserver,
+        port=args.port,
+        grpc_port=args.grpcPort,
+        host=args.ip,
+        data_center=args.dataCenter,
+        rack=args.rack,
+        max_volume_count=args.max,
+    )
+    vs.start()
+    print(f"volume server on http {vs.url} grpc {vs.grpc_address}")
+    _wait_forever()
+    vs.stop()
+    return 0
+
+
+register(Command("volume", "run a volume server", _volume_conf, _volume_run))
+
+
+def _server_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-masterPort", type=int, default=9333)
+    p.add_argument("-port", type=int, default=8080, help="volume server http port")
+    p.add_argument("-dir", action="append", default=None)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+
+
+def _server_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    m = MasterServer(
+        port=args.masterPort,
+        host=args.ip,
+        volume_size_limit=args.volumeSizeLimitMB * 1024 * 1024,
+    )
+    m.start()
+    vs = VolumeServer(
+        args.dir or ["./data"], m.address, port=args.port, host=args.ip
+    )
+    vs.start()
+    print(f"server: master {m.address}, volume http {vs.url} grpc {vs.grpc_address}")
+    _wait_forever()
+    vs.stop()
+    m.stop()
+    return 0
+
+
+register(Command("server", "run master + volume server in one process", _server_conf, _server_run))
+
+
+def _shell_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-c", dest="script", default="", help="run `;`-separated commands and exit")
+
+
+def _shell_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.shell import CommandEnv, repl, run_script
+
+    with CommandEnv(args.master) as env:
+        if args.script:
+            run_script(env, args.script, sys.stdout)
+        else:
+            repl(env, sys.stdin, sys.stdout)
+    return 0
+
+
+register(Command("shell", "operator shell (REPL or -c script)", _shell_conf, _shell_run))

@@ -1,0 +1,258 @@
+"""Operator shell — mirror of weed/shell (`weed shell` REPL)
+[VERIFY: mount empty; SURVEY.md §2.1 "Shell (ops)" row, §3.1/§3.3 call
+stacks]. EC lifecycle orchestration lives HERE, not in the master: the
+shell drives encode/rebuild/balance over gRPC while holding a
+cluster-wide exclusive lock leased from the master
+(wdclient/exclusive_locks analog).
+
+Each command is a `ShellCommand(name, help, do)` where
+`do(args: list[str], env: CommandEnv, writer)` mirrors the reference's
+`Do(args, commandEnv, writer)` signature.
+"""
+
+from __future__ import annotations
+
+import shlex
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional, TextIO
+
+import grpc
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE
+
+LOCK_NAME = "admin"
+_RENEW_INTERVAL = 10.0
+
+
+class ShellError(Exception):
+    pass
+
+
+@dataclass
+class ShellCommand:
+    name: str
+    help: str
+    do: Callable[[list[str], "CommandEnv", TextIO], None]
+
+
+_REGISTRY: dict[str, ShellCommand] = {}
+
+
+def register(cmd: ShellCommand) -> ShellCommand:
+    _REGISTRY[cmd.name] = cmd
+    return cmd
+
+
+def commands() -> dict[str, ShellCommand]:
+    # import for registration side effects
+    from seaweedfs_tpu.shell import command_cluster  # noqa: F401
+    from seaweedfs_tpu.shell import command_ec  # noqa: F401
+    from seaweedfs_tpu.shell import command_volume  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+class CommandEnv:
+    """Shared command environment (commandEnv analog): master client, the
+    exclusive-lock lease, and per-node gRPC helpers."""
+
+    def __init__(self, master_address: str, client_name: str = "shell"):
+        self.master_address = master_address
+        self.client = MasterClient(master_address)
+        self._master = rpc.RpcClient(master_address)
+        self.client_name = client_name
+        self._lock_token = 0
+        self._renew_stop: Optional[threading.Event] = None
+
+    def close(self) -> None:
+        if self.is_locked:
+            try:
+                self.unlock()
+            except Exception:  # noqa: BLE001 — master may be gone
+                pass
+        self.client.close()
+        self._master.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- master helpers ------------------------------------------------------
+
+    def master_call(self, method: str, req: dict, timeout: float = 30) -> dict:
+        return self._master.call(MASTER_SERVICE, method, req, timeout=timeout)
+
+    def volume_list(self) -> dict:
+        return self.master_call("VolumeList", {})
+
+    def topology_nodes(self) -> list[dict]:
+        """Flatten VolumeList's dc -> rack -> node tree, annotating each
+        node dict with its dc/rack."""
+        out = []
+        for dc, racks in self.volume_list().get("data_centers", {}).items():
+            for rack, nodes in racks.items():
+                for nd in nodes:
+                    nd = dict(nd)
+                    nd["data_center"] = dc
+                    nd["rack"] = rack
+                    out.append(nd)
+        return out
+
+    def vs_call(self, grpc_address: str, method: str, req: dict, timeout: float = 300) -> dict:
+        with rpc.RpcClient(grpc_address) as c:
+            return c.call(VOLUME_SERVICE, method, req, timeout=timeout)
+
+    # -- exclusive lock (SURVEY.md §3.1 "acquire cluster exclusive lock") ----
+
+    @property
+    def is_locked(self) -> bool:
+        return self._lock_token != 0
+
+    def confirm_locked(self) -> None:
+        if not self.is_locked:
+            raise ShellError("lock the cluster first: run `lock`")
+
+    def lock(self) -> None:
+        resp = self.master_call(
+            "LeaseAdminToken",
+            {
+                "lock_name": LOCK_NAME,
+                "previous_token": self._lock_token,
+                "client_name": self.client_name,
+            },
+        )
+        self._lock_token = int(resp["token"])
+        self._renew_stop = threading.Event()
+        threading.Thread(target=self._renew_loop, daemon=True).start()
+
+    def unlock(self) -> None:
+        if self._renew_stop is not None:
+            self._renew_stop.set()
+        token, self._lock_token = self._lock_token, 0
+        if token:
+            self.master_call(
+                "ReleaseAdminToken", {"lock_name": LOCK_NAME, "previous_token": token}
+            )
+
+    def _renew_once(self) -> bool:
+        """One lease renewal. Returns False — and drops the token, so the
+        next confirm_locked() aborts — when the master says someone else
+        holds the lock (our lease expired and was stolen)."""
+        try:
+            self.master_call(
+                "LeaseAdminToken",
+                {
+                    "lock_name": LOCK_NAME,
+                    "previous_token": self._lock_token,
+                    "client_name": self.client_name,
+                },
+            )
+            return True
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                self._lock_token = 0  # lock lost — stop pretending we hold it
+                return False
+            return True  # transient failure: retry next tick (TTL is 30s)
+        except Exception:  # noqa: BLE001 — transient; retry next tick
+            return True
+
+    def _renew_loop(self) -> None:
+        stop = self._renew_stop
+        while not stop.wait(_RENEW_INTERVAL):
+            if not self._lock_token or not self._renew_once():
+                return
+
+
+# -- argument helpers (flag.FlagSet analog for `-name=value` style) ----------
+
+
+def parse_flags(args: Iterable[str], **defaults):
+    """Parse `-name value` / `-name=value` flags with typed defaults.
+    Returns an attribute namespace; unknown flags raise ShellError."""
+
+    class NS:
+        pass
+
+    ns = NS()
+    for k, v in defaults.items():
+        setattr(ns, k, v)
+    it = iter(list(args))
+    for tok in it:
+        if not tok.startswith("-"):
+            raise ShellError(f"unexpected argument {tok!r}")
+        body = tok.lstrip("-")
+        if "=" in body:
+            name, val = body.split("=", 1)
+        else:
+            name = body
+            val = None
+        key = name.replace(".", "_").replace("-", "_")
+        if key not in defaults:
+            raise ShellError(f"unknown flag -{name}")
+        default = defaults[key]
+        if isinstance(default, bool):
+            setattr(ns, key, True if val is None else val.lower() in ("1", "true", "yes"))
+            continue
+        if val is None:
+            try:
+                val = next(it)
+            except StopIteration:
+                raise ShellError(f"flag -{name} needs a value") from None
+        if isinstance(default, int):
+            setattr(ns, key, int(val))
+        elif isinstance(default, float):
+            setattr(ns, key, float(val))
+        else:
+            setattr(ns, key, val)
+    return ns
+
+
+# -- driver ------------------------------------------------------------------
+
+
+def run_command(env: CommandEnv, line: str, writer: TextIO) -> None:
+    """Parse and run one command line; raises ShellError on failure."""
+    parts = shlex.split(line.strip())
+    if not parts or parts[0].startswith("#"):
+        return
+    name, args = parts[0], parts[1:]
+    cmds = commands()
+    if name in ("help", "?"):
+        if args and args[0] in cmds:
+            writer.write(f"{args[0]}\n\t{cmds[args[0]].help}\n")
+        else:
+            for c in sorted(cmds):
+                writer.write(f"  {c:<28} {cmds[c].help.splitlines()[0]}\n")
+        return
+    cmd = cmds.get(name)
+    if cmd is None:
+        raise ShellError(f"unknown command {name!r} (try `help`)")
+    cmd.do(args, env, writer)
+
+
+def run_script(env: CommandEnv, script: str, writer: TextIO) -> None:
+    """Run `;`-separated commands (the `weed shell -c` path)."""
+    for line in script.split(";"):
+        if line.strip():
+            run_command(env, line, writer)
+
+
+def repl(env: CommandEnv, stdin, writer: TextIO) -> None:
+    writer.write(f"seaweedfs_tpu shell — connected to {env.master_address}\n")
+    while True:
+        writer.write("> ")
+        writer.flush()
+        line = stdin.readline()
+        if not line or line.strip() in ("exit", "quit"):
+            return
+        try:
+            run_command(env, line, writer)
+        except (ShellError, rpc.RpcFault) as e:
+            writer.write(f"error: {e}\n")
+        except Exception as e:  # noqa: BLE001 — REPL survives command crashes
+            writer.write(f"error: {type(e).__name__}: {e}\n")

@@ -1,0 +1,75 @@
+"""Cluster-level shell commands — lock / unlock / cluster.check,
+mirroring weed/shell/command_lock_unlock.go and command_cluster_check.go
+[VERIFY: mount empty; SURVEY.md §3.1 "acquire cluster exclusive lock"]."""
+
+from __future__ import annotations
+
+from typing import TextIO
+
+import grpc
+
+from seaweedfs_tpu.shell import CommandEnv, ShellCommand, register
+
+
+def do_lock(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    env.lock()
+    w.write("cluster locked\n")
+
+
+def do_unlock(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    env.unlock()
+    w.write("cluster unlocked\n")
+
+
+register(
+    ShellCommand(
+        "lock",
+        "lock\n\tlease the cluster-wide exclusive admin lock from the master",
+        do_lock,
+    )
+)
+register(
+    ShellCommand(
+        "unlock",
+        "unlock\n\trelease the cluster-wide exclusive admin lock",
+        do_unlock,
+    )
+)
+
+
+def do_cluster_check(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    stats = env.master_call("Statistics", {})
+    w.write(
+        f"master {env.master_address}: {stats.get('node_count')} nodes, "
+        f"{stats.get('volume_count')} volumes, "
+        f"{stats.get('ec_volume_count')} ec volumes\n"
+    )
+    ok = bad = 0
+    for n in env.topology_nodes():
+        host = n["url"].rsplit(":", 1)[0]
+        addr = f"{host}:{n['grpc_port']}"
+        try:
+            # unconditional probe: NOT_FOUND proves the server answered
+            env.vs_call(addr, "VolumeStatus", {"volume_id": 0}, timeout=5)
+            w.write(f"  node {n['url']}: ok\n")
+            ok += 1
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                w.write(f"  node {n['url']}: ok\n")
+                ok += 1
+            else:
+                w.write(f"  node {n['url']}: UNREACHABLE ({e.code()})\n")
+                bad += 1
+        except Exception as e:  # noqa: BLE001 — health summary keeps going
+            w.write(f"  node {n['url']}: UNREACHABLE ({e})\n")
+            bad += 1
+    w.write(f"cluster.check: {ok} healthy, {bad} unreachable\n")
+
+
+register(
+    ShellCommand(
+        "cluster.check",
+        "cluster.check\n\tverify master and volume-server connectivity",
+        do_cluster_check,
+    )
+)

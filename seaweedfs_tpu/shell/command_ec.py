@@ -1,0 +1,488 @@
+"""EC lifecycle shell commands — ec.encode / ec.rebuild / ec.decode /
+ec.balance, mirroring weed/shell/command_ec_encode.go, command_ec_rebuild.go,
+command_ec_decode.go, command_ec_balance.go + command_ec_common.go
+[VERIFY: mount empty; SURVEY.md §3.1/§3.3]. Fan-out over nodes uses a
+thread pool (errgroup analog)."""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import TextIO
+
+from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ec.shard_bits import ShardBits
+from seaweedfs_tpu.shell import (
+    CommandEnv,
+    ShellCommand,
+    ShellError,
+    parse_flags,
+    register,
+)
+
+_POOL = 8
+
+
+def _grpc_addr(node: dict) -> str:
+    host = node["url"].rsplit(":", 1)[0]
+    return f"{host}:{node['grpc_port']}"
+
+
+def _node_ec_load(node: dict) -> int:
+    """Total EC shards currently on the node."""
+    return sum(
+        ShardBits(e.get("shard_bits", 0)).shard_id_count()
+        for e in node.get("ec_shards", [])
+    )
+
+
+def _node_shards_of(node: dict, vid: int) -> list[int]:
+    for e in node.get("ec_shards", []):
+        if int(e.get("volume_id", -1)) == vid:
+            return ShardBits(e.get("shard_bits", 0)).shard_ids()
+    return []
+
+
+def _volume_locations(nodes: list[dict], vid: int) -> list[dict]:
+    return [n for n in nodes if any(int(v["id"]) == vid for v in n.get("volumes", []))]
+
+
+def allocate_shards(nodes: list[dict], total: int = TOTAL_SHARDS_COUNT) -> dict[str, list[int]]:
+    """Greedy balanced+rack-aware spread of `total` shard ids over nodes
+    (command_ec_common.go balancedEcDistribution analog): each shard goes
+    to the node with the fewest (assigned + existing) shards, tie-broken
+    toward racks with fewer shards of this volume."""
+    if not nodes:
+        raise ShellError("no volume servers available")
+    assigned: dict[str, list[int]] = {n["url"]: [] for n in nodes}
+    base_load = {n["url"]: _node_ec_load(n) for n in nodes}
+    rack_count: dict[str, int] = {}
+    for sid in range(total):
+        best = min(
+            nodes,
+            key=lambda n: (
+                len(assigned[n["url"]]) + base_load[n["url"]],
+                rack_count.get(n["rack"], 0),
+                n["url"],
+            ),
+        )
+        assigned[best["url"]].append(sid)
+        rack_count[best["rack"]] = rack_count.get(best["rack"], 0) + 1
+    return {u: s for u, s in assigned.items() if s}
+
+
+def _parallel(work: list) -> None:
+    """Run thunks concurrently, re-raising the first failure."""
+    if not work:
+        return
+    with futures.ThreadPoolExecutor(max_workers=_POOL) as pool:
+        for f in [pool.submit(t) for t in work]:
+            f.result()
+
+
+# -- ec.encode ---------------------------------------------------------------
+
+
+def _do_ec_encode(
+    env: CommandEnv,
+    nodes: list[dict],
+    vid: int,
+    collection: str,
+    w: TextIO,
+    large_block_size: int = 0,
+    small_block_size: int = 0,
+) -> None:
+    locations = _volume_locations(nodes, vid)
+    if not locations:
+        raise ShellError(f"volume {vid} not found on any node")
+    # 1. freeze writes on every replica (SURVEY.md §3.1); roll the freeze
+    # back if anything later fails, or the volume is stuck readonly forever
+    for loc in locations:
+        env.vs_call(_grpc_addr(loc), "VolumeMarkReadonly", {"volume_id": vid})
+    try:
+        _encode_spread_cutover(
+            env, nodes, locations, vid, collection, w, large_block_size, small_block_size
+        )
+    except Exception:
+        for loc in locations:
+            try:
+                env.vs_call(_grpc_addr(loc), "VolumeMarkWritable", {"volume_id": vid})
+            except Exception:  # noqa: BLE001 — best-effort rollback
+                pass
+        raise
+
+
+def _encode_spread_cutover(
+    env: CommandEnv,
+    nodes: list[dict],
+    locations: list[dict],
+    vid: int,
+    collection: str,
+    w: TextIO,
+    large_block_size: int,
+    small_block_size: int,
+) -> None:
+    # 2. generate all 14 shards + .ecx on the first replica holder
+    source = locations[0]
+    src_addr = _grpc_addr(source)
+    gen_req = {"volume_id": vid, "collection": collection}
+    if large_block_size:
+        gen_req["large_block_size"] = large_block_size
+    if small_block_size:
+        gen_req["small_block_size"] = small_block_size
+    env.vs_call(src_addr, "VolumeEcShardsGenerate", gen_req)
+    # 3. spread: balanced, rack-aware allocation; targets pull from source
+    alloc = allocate_shards(nodes)
+
+    def copy_and_mount(node: dict, sids: list[int]):
+        def run():
+            addr = _grpc_addr(node)
+            if node["url"] != source["url"]:
+                env.vs_call(
+                    addr,
+                    "VolumeEcShardsCopy",
+                    {
+                        "volume_id": vid,
+                        "collection": collection,
+                        "shard_ids": sids,
+                        "source_data_node": src_addr,
+                        "copy_ecx_file": True,
+                    },
+                )
+                env.vs_call(
+                    addr,
+                    "VolumeEcShardsMount",
+                    {"volume_id": vid, "collection": collection, "shard_ids": sids},
+                )
+            return None
+
+        return run
+
+    _parallel([copy_and_mount(n, sids) for url, sids in alloc.items()
+               for n in nodes if n["url"] == url])
+    # 4. source keeps only its allocated shards (delete remounts the rest)
+    kept = alloc.get(source["url"], [])
+    moved = [s for s in range(TOTAL_SHARDS_COUNT) if s not in kept]
+    env.vs_call(
+        src_addr,
+        "VolumeEcShardsDelete",
+        {"volume_id": vid, "collection": collection, "shard_ids": moved},
+    )
+    if kept:
+        env.vs_call(
+            src_addr,
+            "VolumeEcShardsMount",
+            {"volume_id": vid, "collection": collection, "shard_ids": kept},
+        )
+    # 5. drop the original volume + replicas — cut-over complete
+    for loc in locations:
+        env.vs_call(_grpc_addr(loc), "VolumeDelete", {"volume_id": vid})
+    w.write(f"ec.encode volume {vid}: spread {_fmt_alloc(alloc)}\n")
+
+
+def _fmt_alloc(alloc: dict[str, list[int]]) -> str:
+    return " ".join(f"{u}={','.join(map(str, s))}" for u, s in sorted(alloc.items()))
+
+
+def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(
+        args,
+        volumeId=0,
+        collection="",
+        fullPercent=95.0,
+        force=False,
+        largeBlockSize=0,
+        smallBlockSize=0,
+    )
+    env.confirm_locked()
+    topo = env.volume_list()
+    nodes = env.topology_nodes()
+    limit = int(topo.get("volume_size_limit", 0)) or 1
+    # each volume's real collection comes from the topology, not the flag —
+    # the flag only SELECTS volumes
+    coll_of: dict[int, str] = {}
+    for n in nodes:
+        for v in n.get("volumes", []):
+            coll_of[int(v["id"])] = v.get("collection", "")
+    vids: list[int] = []
+    if fl.volumeId:
+        if fl.volumeId not in coll_of:
+            raise ShellError(f"volume {fl.volumeId} not found on any node")
+        vids = [fl.volumeId]
+    else:
+        seen = set()
+        for n in nodes:
+            for v in n.get("volumes", []):
+                if int(v["id"]) in seen:
+                    continue
+                if v.get("collection", "") != fl.collection:
+                    continue
+                if fl.force or int(v.get("size", 0)) >= limit * fl.fullPercent / 100.0:
+                    seen.add(int(v["id"]))
+        vids = sorted(seen)
+    if not vids:
+        w.write("ec.encode: no matching volumes\n")
+        return
+    for vid in vids:
+        _do_ec_encode(
+            env,
+            nodes,
+            vid,
+            coll_of[vid],
+            w,
+            large_block_size=fl.largeBlockSize,
+            small_block_size=fl.smallBlockSize,
+        )
+
+
+register(
+    ShellCommand(
+        "ec.encode",
+        "ec.encode -volumeId <id> | -collection <name> [-fullPercent 95] [-force]\n"
+        "\tencode a volume into 14 EC shards, spread them, delete the original",
+        do_ec_encode,
+    )
+)
+
+
+# -- ec.rebuild --------------------------------------------------------------
+
+
+def _shard_holders(nodes: list[dict], vid: int) -> dict[int, list[dict]]:
+    out: dict[int, list[dict]] = {}
+    for n in nodes:
+        for sid in _node_shards_of(n, vid):
+            out.setdefault(sid, []).append(n)
+    return out
+
+
+def _copy_missing_to(env: CommandEnv, node: dict, vid: int, collection: str,
+                     holders: dict[int, list[dict]]) -> list[int]:
+    """Pull every survivor shard `node` lacks onto it; returns the shard ids
+    temporarily copied (for cleanup)."""
+    local = set(_node_shards_of(node, vid))
+    by_source: dict[str, list[int]] = {}
+    for sid, hs in holders.items():
+        if sid in local:
+            continue
+        src = next((h for h in hs if h["url"] != node["url"]), None)
+        if src is None:
+            continue
+        by_source.setdefault(_grpc_addr(src), []).append(sid)
+    copied: list[int] = []
+    first = not local  # no local shards: also pull the index files
+    for src_addr, sids in sorted(by_source.items()):
+        env.vs_call(
+            _grpc_addr(node),
+            "VolumeEcShardsCopy",
+            {
+                "volume_id": vid,
+                "collection": collection,
+                "shard_ids": sids,
+                "source_data_node": src_addr,
+                "copy_ecx_file": first,
+            },
+        )
+        first = False
+        copied.extend(sids)
+    return copied
+
+
+def _ec_collections(env: CommandEnv) -> dict[int, str]:
+    """vid -> collection, from the master's EC registry."""
+    return {
+        int(vid): coll
+        for vid, coll in env.volume_list().get("ec_collections", {}).items()
+    }
+
+
+def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, collection="")
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    colls = _ec_collections(env)
+    ec_vids = sorted(
+        {int(e["volume_id"]) for n in nodes for e in n.get("ec_shards", [])}
+    )
+    if fl.collection:
+        ec_vids = [v for v in ec_vids if colls.get(v, "") == fl.collection]
+    for vid in ec_vids:
+        collection = colls.get(vid, "")
+        holders = _shard_holders(nodes, vid)
+        missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in holders]
+        if not missing:
+            continue
+        if len(holders) < DATA_SHARDS_COUNT:
+            w.write(
+                f"ec.rebuild volume {vid}: only {len(holders)} shards survive, "
+                f"need {DATA_SHARDS_COUNT} — data LOST\n"
+            )
+            continue
+        # rebuilder = node already holding the most shards (fewest copies)
+        rebuilder = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
+        addr = _grpc_addr(rebuilder)
+        copied = _copy_missing_to(env, rebuilder, vid, collection, holders)
+        resp = env.vs_call(
+            addr, "VolumeEcShardsRebuild", {"volume_id": vid, "collection": collection}
+        )
+        rebuilt = resp.get("rebuilt_shard_ids", [])
+        # drop the temp survivor copies; delete remounts local = original+rebuilt
+        if copied:
+            env.vs_call(
+                addr,
+                "VolumeEcShardsDelete",
+                {"volume_id": vid, "collection": collection, "shard_ids": copied},
+            )
+        else:
+            env.vs_call(
+                addr,
+                "VolumeEcShardsMount",
+                {"volume_id": vid, "collection": collection, "shard_ids": rebuilt},
+            )
+        w.write(f"ec.rebuild volume {vid}: rebuilt {rebuilt} on {rebuilder['url']}\n")
+
+
+register(
+    ShellCommand(
+        "ec.rebuild",
+        "ec.rebuild [-collection <name>]\n\tfind EC volumes with lost shards and "
+        "reconstruct them on a rebuilder node",
+        do_ec_rebuild,
+    )
+)
+
+
+# -- ec.decode ---------------------------------------------------------------
+
+
+def do_ec_decode(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, volumeId=0, collection="")
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    colls = _ec_collections(env)
+    ec_vids = sorted(
+        {int(e["volume_id"]) for n in nodes for e in n.get("ec_shards", [])}
+    )
+    if fl.volumeId:
+        if fl.volumeId not in ec_vids:
+            raise ShellError(f"ec volume {fl.volumeId} not found")
+        ec_vids = [fl.volumeId]
+    elif fl.collection:
+        ec_vids = [v for v in ec_vids if colls.get(v, "") == fl.collection]
+    for vid in ec_vids:
+        collection = colls.get(vid, "")
+        holders = _shard_holders(nodes, vid)
+        if len(holders) < DATA_SHARDS_COUNT:
+            w.write(f"ec.decode volume {vid}: insufficient shards — data LOST\n")
+            continue
+        target = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
+        addr = _grpc_addr(target)
+        _copy_missing_to(env, target, vid, collection, holders)
+        env.vs_call(
+            addr, "VolumeEcShardsToVolume", {"volume_id": vid, "collection": collection}
+        )
+        # remove EC remnants everywhere (the .dat volume now lives on target)
+        for n in nodes:
+            if _node_shards_of(n, vid) or n["url"] == target["url"]:
+                env.vs_call(
+                    _grpc_addr(n),
+                    "VolumeEcShardsDelete",
+                    {
+                        "volume_id": vid,
+                        "collection": collection,
+                        "shard_ids": list(range(TOTAL_SHARDS_COUNT)),
+                    },
+                )
+        w.write(f"ec.decode volume {vid}: restored as normal volume on {target['url']}\n")
+
+
+register(
+    ShellCommand(
+        "ec.decode",
+        "ec.decode [-volumeId <id>] [-collection <name>]\n\tconvert EC shard sets "
+        "back into normal volumes",
+        do_ec_decode,
+    )
+)
+
+
+# -- ec.balance --------------------------------------------------------------
+
+
+def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, collection="")
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    colls = _ec_collections(env)
+    if not nodes:
+        raise ShellError("no volume servers")
+    # live shard map: url -> {vid -> set(sids)}
+    placement: dict[str, dict[int, set]] = {
+        n["url"]: {
+            int(e["volume_id"]): set(ShardBits(e.get("shard_bits", 0)).shard_ids())
+            for e in n.get("ec_shards", [])
+        }
+        for n in nodes
+    }
+    by_url = {n["url"]: n for n in nodes}
+
+    def load(url: str) -> int:
+        return sum(len(s) for s in placement[url].values())
+
+    moves = 0
+    while True:
+        urls = sorted(placement, key=load)
+        lightest, heaviest = urls[0], urls[-1]
+        if load(heaviest) - load(lightest) <= 1:
+            break
+        # move one shard of some volume from heaviest to lightest
+        moved = False
+        for vid, sids in sorted(placement[heaviest].items()):
+            if fl.collection and colls.get(vid, "") != fl.collection:
+                continue
+            movable = sids - placement[lightest].get(vid, set())
+            if not movable:
+                continue
+            sid = min(movable)
+            collection = colls.get(vid, "")
+            src, dst = by_url[heaviest], by_url[lightest]
+            env.vs_call(
+                _grpc_addr(dst),
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "shard_ids": [sid],
+                    "source_data_node": _grpc_addr(src),
+                    "copy_ecx_file": not placement[lightest].get(vid),
+                },
+            )
+            env.vs_call(
+                _grpc_addr(dst),
+                "VolumeEcShardsMount",
+                {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+            )
+            env.vs_call(
+                _grpc_addr(src),
+                "VolumeEcShardsDelete",
+                {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+            )
+            placement[heaviest][vid].discard(sid)
+            if not placement[heaviest][vid]:
+                del placement[heaviest][vid]
+            placement[lightest].setdefault(vid, set()).add(sid)
+            moves += 1
+            moved = True
+            break
+        if not moved:
+            break
+    w.write(f"ec.balance: moved {moves} shards\n")
+
+
+register(
+    ShellCommand(
+        "ec.balance",
+        "ec.balance [-collection <name>]\n\teven out EC shard counts across "
+        "volume servers",
+        do_ec_balance,
+    )
+)

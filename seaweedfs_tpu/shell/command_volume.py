@@ -1,0 +1,259 @@
+"""Volume & collection shell commands — volume.list / volume.delete /
+volume.mark / volume.vacuum / volume.fix.replication / collection.list,
+mirroring weed/shell/command_volume_*.go and command_collection_list.go
+[VERIFY: mount empty; SURVEY.md §2.1 "Shell (ops)"]."""
+
+from __future__ import annotations
+
+from typing import TextIO
+
+from seaweedfs_tpu.ec.shard_bits import ShardBits
+from seaweedfs_tpu.shell import (
+    CommandEnv,
+    ShellCommand,
+    ShellError,
+    parse_flags,
+    register,
+)
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+
+def _grpc_addr(node: dict) -> str:
+    host = node["url"].rsplit(":", 1)[0]
+    return f"{host}:{node['grpc_port']}"
+
+
+def do_volume_list(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    topo = env.volume_list()
+    w.write(f"volume size limit: {topo.get('volume_size_limit')}\n")
+    for dc, racks in sorted(topo.get("data_centers", {}).items()):
+        w.write(f"DataCenter {dc}\n")
+        for rack, nodes in sorted(racks.items()):
+            w.write(f"  Rack {rack}\n")
+            for n in nodes:
+                w.write(
+                    f"    Node {n['url']} (grpc :{n['grpc_port']}) "
+                    f"slots {len(n.get('volumes', []))}/{n.get('max_volume_count')}\n"
+                )
+                for v in sorted(n.get("volumes", []), key=lambda v: int(v["id"])):
+                    w.write(
+                        f"      volume {v['id']} collection={v.get('collection', '')!r} "
+                        f"size={v.get('size', 0)} files={v.get('file_count', 0)} "
+                        f"del={v.get('delete_count', 0)} "
+                        f"ro={v.get('read_only', False)} rp={v.get('replica_placement')}\n"
+                    )
+                for e in sorted(n.get("ec_shards", []), key=lambda e: int(e["volume_id"])):
+                    sids = ShardBits(e.get("shard_bits", 0)).shard_ids()
+                    w.write(f"      ec volume {e['volume_id']} shards {sids}\n")
+
+
+register(
+    ShellCommand(
+        "volume.list",
+        "volume.list\n\tprint the cluster topology: dc/rack/node/volumes/ec shards",
+        do_volume_list,
+    )
+)
+
+
+def _locations_of(env: CommandEnv, vid: int) -> list[dict]:
+    return [
+        n
+        for n in env.topology_nodes()
+        if any(int(v["id"]) == vid for v in n.get("volumes", []))
+    ]
+
+
+def do_volume_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, volumeId=0)
+    env.confirm_locked()
+    if not fl.volumeId:
+        raise ShellError("volume.delete -volumeId <id>")
+    locs = _locations_of(env, fl.volumeId)
+    if not locs:
+        raise ShellError(f"volume {fl.volumeId} not found")
+    for n in locs:
+        env.vs_call(_grpc_addr(n), "VolumeDelete", {"volume_id": fl.volumeId})
+    w.write(f"volume.delete {fl.volumeId}: removed from {[n['url'] for n in locs]}\n")
+
+
+register(
+    ShellCommand(
+        "volume.delete",
+        "volume.delete -volumeId <id>\n\tdelete a volume from every replica holder",
+        do_volume_delete,
+    )
+)
+
+
+def do_volume_mark(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, volumeId=0, readonly=False, writable=False)
+    env.confirm_locked()
+    if not fl.volumeId or fl.readonly == fl.writable:
+        raise ShellError("volume.mark -volumeId <id> (-readonly | -writable)")
+    method = "VolumeMarkReadonly" if fl.readonly else "VolumeMarkWritable"
+    locs = _locations_of(env, fl.volumeId)
+    if not locs:
+        raise ShellError(f"volume {fl.volumeId} not found")
+    for n in locs:
+        env.vs_call(_grpc_addr(n), method, {"volume_id": fl.volumeId})
+    w.write(f"volume.mark {fl.volumeId}: {'readonly' if fl.readonly else 'writable'}\n")
+
+
+register(
+    ShellCommand(
+        "volume.mark",
+        "volume.mark -volumeId <id> (-readonly | -writable)\n\tflip a volume's "
+        "write protection on all replicas",
+        do_volume_mark,
+    )
+)
+
+
+def do_volume_vacuum(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Compact volumes to reclaim deleted-needle space
+    (topology_vacuum.go analog, operator-driven)."""
+    fl = parse_flags(args, volumeId=0, garbageThreshold=0.3)
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    done = 0
+    for n in nodes:
+        for v in n.get("volumes", []):
+            vid = int(v["id"])
+            if fl.volumeId and vid != fl.volumeId:
+                continue
+            fc, dc = int(v.get("file_count", 0)), int(v.get("delete_count", 0))
+            if not fl.volumeId and (fc + dc == 0 or dc / max(fc + dc, 1) < fl.garbageThreshold):
+                continue
+            resp = env.vs_call(_grpc_addr(n), "VolumeCompact", {"volume_id": vid})
+            w.write(
+                f"volume.vacuum {vid} on {n['url']}: "
+                f"{resp.get('bytes_before')} -> {resp.get('bytes_after')} bytes\n"
+            )
+            done += 1
+    if not done:
+        w.write("volume.vacuum: nothing to do\n")
+
+
+register(
+    ShellCommand(
+        "volume.vacuum",
+        "volume.vacuum [-volumeId <id>] [-garbageThreshold 0.3]\n\tcompact volumes "
+        "whose deleted fraction exceeds the threshold",
+        do_volume_vacuum,
+    )
+)
+
+
+def _placement_candidates(
+    nodes: list[dict], holders: list[dict], rp: ReplicaPlacement
+) -> list[dict]:
+    """Candidate targets ordered so the xyz placement deficits are restored
+    first (same placement predicate as Topology.place_replicas): count the
+    surviving holders per category relative to the primary, then prefer
+    nodes that fill an unmet category."""
+    primary = holders[0]
+    held = {h["url"] for h in holders}
+
+    def category(node: dict) -> str:
+        if node["data_center"] != primary["data_center"]:
+            return "diff_dc"
+        if node["rack"] != primary["rack"]:
+            return "diff_rack"
+        return "same_rack"
+
+    have = {"same_rack": 0, "diff_rack": 0, "diff_dc": 0}
+    for h in holders[1:]:
+        have[category(h)] += 1
+    deficit = {
+        "same_rack": rp.same_rack - have["same_rack"],
+        "diff_rack": rp.diff_rack - have["diff_rack"],
+        "diff_dc": rp.diff_dc - have["diff_dc"],
+    }
+    out = [m for m in nodes if m["url"] not in held]
+    out.sort(
+        key=lambda m: (
+            -min(deficit[category(m)], 1),  # nodes filling an unmet slot first
+            len(m.get("volumes", [])) + len(m.get("ec_shards", [])),
+        )
+    )
+    return out
+
+
+def do_volume_fix_replication(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Re-replicate under-replicated volumes (command_volume_fix_replication.go
+    analog): VolumeCopy the .dat/.idx onto a fresh node."""
+    fl = parse_flags(args, noFix=False)
+    if not fl.noFix:
+        env.confirm_locked()
+    nodes = env.topology_nodes()
+    fixed = checked = 0
+    seen: set[int] = set()
+    for n in nodes:
+        for v in n.get("volumes", []):
+            vid = int(v["id"])
+            if vid in seen:
+                continue
+            seen.add(vid)
+            rp = ReplicaPlacement.parse(v.get("replica_placement", "000"))
+            want = rp.copy_count
+            holders = [
+                m
+                for m in nodes
+                if any(int(x["id"]) == vid for x in m.get("volumes", []))
+            ]
+            checked += 1
+            if len(holders) >= want:
+                continue
+            w.write(
+                f"volume {vid}: {len(holders)}/{want} replicas "
+                f"({[h['url'] for h in holders]})\n"
+            )
+            if fl.noFix:
+                continue
+            candidates = _placement_candidates(nodes, holders, rp)
+            src = holders[0]
+            for dst in candidates[: want - len(holders)]:
+                env.vs_call(
+                    _grpc_addr(dst),
+                    "VolumeCopy",
+                    {
+                        "volume_id": vid,
+                        "collection": v.get("collection", ""),
+                        "source_data_node": _grpc_addr(src),
+                        "read_only": v.get("read_only", False),
+                    },
+                )
+                w.write(f"volume {vid}: copied {src['url']} -> {dst['url']}\n")
+                fixed += 1
+    w.write(f"volume.fix.replication: checked {checked}, fixed {fixed}\n")
+
+
+register(
+    ShellCommand(
+        "volume.fix.replication",
+        "volume.fix.replication [-noFix]\n\tdetect under-replicated volumes and "
+        "copy them to fresh nodes",
+        do_volume_fix_replication,
+    )
+)
+
+
+def do_collection_list(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    names = set()
+    for n in env.topology_nodes():
+        for v in n.get("volumes", []):
+            names.add(v.get("collection", ""))
+        for e in n.get("ec_shards", []):
+            names.add(e.get("collection", ""))
+    for name in sorted(names):
+        w.write(f"collection: {name!r}\n")
+
+
+register(
+    ShellCommand(
+        "collection.list",
+        "collection.list\n\tlist all collections present in the cluster",
+        do_collection_list,
+    )
+)

@@ -195,9 +195,11 @@ class Store:
         out = []
         for loc in self.locations:
             for vid, ev in loc.ec_volumes.items():
+                parsed = parse_base_name(os.path.basename(ev.base))
                 out.append(
                     EcVolumeInfo(
                         volume_id=vid,
+                        collection=parsed[0] if parsed else "",
                         shard_bits=ShardBits.from_ids(ev.shard_ids),
                     )
                 )

@@ -1,0 +1,265 @@
+"""Shell (weed/shell analog) end-to-end tests against a real in-process
+cluster — the §3.1/§3.3 call stacks driven the way an operator drives
+them: lock, ec.encode, degraded read, ec.rebuild, ec.balance,
+volume.fix.replication (SURVEY.md §4 test strategy)."""
+
+import io
+
+import pytest
+
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.ec.shard_bits import ShardBits
+from seaweedfs_tpu.shell import CommandEnv, ShellError, run_command, run_script
+
+LARGE, SMALL = 4096, 512
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    servers = []
+    for i in range(4):
+        d = tmp_path / f"srv{i}"
+        d.mkdir()
+        vs = VolumeServer(
+            [str(d)],
+            master.address,
+            heartbeat_interval=0.3,
+            rack=f"rack{i % 2}",
+            max_volume_count=50,
+        )
+        vs.start()
+        servers.append(vs)
+    client = MasterClient(master.address)
+    env = CommandEnv(master.address)
+    yield master, servers, client, env
+    env.close()
+    client.close()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def run(env, line):
+    out = io.StringIO()
+    run_command(env, line, out)
+    return out.getvalue()
+
+
+def _upload_some(client, n=20, size=700):
+    import os as _os
+
+    fids = []
+    for i in range(n):
+        res = client.submit(_os.urandom(size))
+        fids.append((res.fid, client.read(res.fid)))
+    return fids
+
+
+def _ec_shard_spread(env, vid):
+    """url -> shard ids for vid, from the master's view."""
+    out = {}
+    for n in env.topology_nodes():
+        for e in n.get("ec_shards", []):
+            if int(e["volume_id"]) == vid:
+                out[n["url"]] = ShardBits(e["shard_bits"]).shard_ids()
+    return out
+
+
+def test_lock_required_and_contention(cluster):
+    master, servers, client, env = cluster
+    with pytest.raises(ShellError, match="lock the cluster"):
+        run(env, "volume.delete -volumeId 1")
+    assert "locked" in run(env, "lock")
+    env2 = CommandEnv(master.address, client_name="intruder")
+    try:
+        with pytest.raises(Exception, match="held by"):
+            env2.lock()
+    finally:
+        env2.close()
+    assert "unlocked" in run(env, "unlock")
+    env2 = CommandEnv(master.address, client_name="second")
+    try:
+        env2.lock()  # free now
+        env2.unlock()
+    finally:
+        env2.close()
+
+
+def test_help_and_volume_list(cluster):
+    master, servers, client, env = cluster
+    _upload_some(client, n=3)
+    out = run(env, "help")
+    assert "ec.encode" in out and "volume.list" in out
+    out = run(env, "volume.list")
+    assert "DataCenter" in out and "volume 1" in out
+    out = run(env, "collection.list")
+    assert "collection: ''" in out
+    out = run(env, "cluster.check")
+    assert "4 nodes" in out and "unreachable" not in out.replace("0 unreachable", "")
+
+
+def test_ec_encode_read_rebuild_balance(cluster):
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=25)
+    vid = int(fids[0][0].split(",", 1)[0])
+    run(env, "lock")
+
+    out = run(
+        env,
+        f"ec.encode -volumeId {vid} -largeBlockSize {LARGE} -smallBlockSize {SMALL}",
+    )
+    assert f"ec.encode volume {vid}" in out
+    spread = _ec_shard_spread(env, vid)
+    assert sorted(s for sids in spread.values() for s in sids) == list(range(14))
+    assert len(spread) == 4  # spread across all nodes
+    # original volume is gone from the topology
+    assert not any(
+        int(v["id"]) == vid
+        for n in env.topology_nodes()
+        for v in n.get("volumes", [])
+    ), "original volume must be deleted after cut-over"
+
+    # every blob still readable through the EC path (incl. remote intervals)
+    for fid, payload in fids:
+        assert client.read(fid) == payload, f"fid {fid} corrupted after ec.encode"
+
+    # lose one node's shards entirely -> rebuild restores 14/14
+    victim_url, victim_sids = sorted(spread.items())[0]
+    victim = next(s for s in servers if s.url == victim_url)
+    host = victim_url.rsplit(":", 1)[0]
+    env.vs_call(
+        f"{host}:{victim.grpc_port}",
+        "VolumeEcShardsDelete",
+        {"volume_id": vid, "shard_ids": victim_sids},
+    )
+    assert sorted(
+        s for sids in _ec_shard_spread(env, vid).values() for s in sids
+    ) != list(range(14))
+    out = run(env, "ec.rebuild")
+    assert "rebuilt" in out
+    spread2 = _ec_shard_spread(env, vid)
+    assert sorted(s for sids in spread2.values() for s in sids) == list(range(14))
+    for fid, payload in fids:
+        assert client.read(fid) == payload, f"fid {fid} corrupted after ec.rebuild"
+
+    # balance: counts within 1 of each other afterwards
+    run(env, "ec.balance")
+    counts = [len(s) for s in _ec_shard_spread(env, vid).values()]
+    assert max(counts) - min(counts) <= 1 or len(counts) == 4
+
+    # decode back to a normal volume; data still readable
+    out = run(env, f"ec.decode -volumeId {vid}")
+    assert "restored as normal volume" in out
+    assert _ec_shard_spread(env, vid) == {}
+    for fid, payload in fids:
+        assert client.read(fid) == payload, f"fid {fid} corrupted after ec.decode"
+
+
+def test_volume_vacuum_and_mark(cluster):
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=10)
+    vid = int(fids[0][0].split(",", 1)[0])
+    for fid, _ in fids[:6]:
+        client.delete(fid)
+    run(env, "lock")
+    out = run(env, f"volume.vacuum -volumeId {vid}")
+    assert "->" in out
+    for fid, payload in fids[6:]:
+        assert client.read(fid) == payload
+    out = run(env, f"volume.mark -volumeId {vid} -readonly")
+    assert "readonly" in out
+    out = run(env, f"volume.mark -volumeId {vid} -writable")
+    assert "writable" in out
+
+
+def test_fix_replication(cluster):
+    master, servers, client, env = cluster
+    res = client.submit(b"replicated payload", replication="001")
+    vid = int(res.fid.split(",", 1)[0])
+    # wait for heartbeats to register both replicas
+    holders = [
+        n for n in env.topology_nodes()
+        if any(int(v["id"]) == vid for v in n.get("volumes", []))
+    ]
+    assert len(holders) == 2
+    # drop one replica behind the master's back
+    victim = holders[0]
+    host = victim["url"].rsplit(":", 1)[0]
+    env.vs_call(f"{host}:{victim['grpc_port']}", "VolumeDelete", {"volume_id": vid})
+    out = run(env, "volume.fix.replication -noFix")
+    assert f"volume {vid}: 1/2 replicas" in out
+    run(env, "lock")
+    out = run(env, "volume.fix.replication")
+    assert "fixed 1" in out
+    holders = [
+        n for n in env.topology_nodes()
+        if any(int(v["id"]) == vid for v in n.get("volumes", []))
+    ]
+    assert len(holders) == 2
+    assert client.read(res.fid) == b"replicated payload"
+
+
+def test_lock_lost_after_lease_steal(cluster):
+    """If the master re-leases the lock to someone else (our lease expired),
+    the next renewal must drop the token so mutating commands abort."""
+    import time as _time
+
+    master, servers, client, env = cluster
+    env.lock()
+    assert env.is_locked
+    with master._admin_lock_mu:
+        master._admin_locks["admin"] = (999, _time.monotonic() + 30, "thief")
+    assert env._renew_once() is False
+    assert not env.is_locked
+    with pytest.raises(ShellError, match="lock the cluster"):
+        run(env, "volume.delete -volumeId 1")
+
+
+def test_ec_lifecycle_with_collection(cluster):
+    """Collection must ride the heartbeat into the EC registry so rebuild
+    resolves the right shard paths without a flag."""
+    master, servers, client, env = cluster
+    import os as _os
+
+    fids = []
+    for i in range(8):
+        res = client.submit(_os.urandom(600), collection="foo")
+        fids.append((res.fid, client.read(res.fid)))
+    vid = int(fids[0][0].split(",", 1)[0])
+    run(env, "lock")
+    out = run(
+        env,
+        f"ec.encode -volumeId {vid} -largeBlockSize {LARGE} -smallBlockSize {SMALL}",
+    )
+    assert f"ec.encode volume {vid}" in out
+    # master's registry knows the collection
+    assert env.volume_list().get("ec_collections", {}).get(str(vid)) == "foo"
+    # lose shards, rebuild WITHOUT passing -collection
+    spread = _ec_shard_spread(env, vid)
+    victim_url, victim_sids = sorted(spread.items())[0]
+    victim = next(s for s in servers if s.url == victim_url)
+    host = victim_url.rsplit(":", 1)[0]
+    env.vs_call(
+        f"{host}:{victim.grpc_port}",
+        "VolumeEcShardsDelete",
+        {"volume_id": vid, "collection": "foo", "shard_ids": victim_sids},
+    )
+    out = run(env, "ec.rebuild")
+    assert "rebuilt" in out
+    assert sorted(
+        s for sids in _ec_shard_spread(env, vid).values() for s in sids
+    ) == list(range(14))
+    for fid, payload in fids:
+        assert client.read(fid) == payload
+
+
+def test_run_script_multiple_commands(cluster):
+    master, servers, client, env = cluster
+    out = io.StringIO()
+    run_script(env, "lock; volume.list; unlock", out)
+    s = out.getvalue()
+    assert "locked" in s and "DataCenter" in s and "unlocked" in s
