@@ -19,6 +19,7 @@ from typing import Optional
 
 from seaweedfs_tpu import rpc
 from seaweedfs_tpu.pb import MASTER_SERVICE, AssignResponse, Location
+from seaweedfs_tpu.security.jwt import mint_file_token
 
 _VID_CACHE_TTL = 30.0
 
@@ -35,8 +36,18 @@ class SubmitResult:
 
 
 class MasterClient:
-    def __init__(self, master_address: str):
+    def __init__(
+        self,
+        master_address: str,
+        signing_key: Optional[bytes] = None,
+        read_signing_key: Optional[bytes] = None,
+    ):
+        """Trusted clients share the cluster's security.toml keys and mint
+        their own per-fid JWTs for delete/read (the reference's clients do
+        the same; Assign only covers the freshly assigned fid)."""
         self.master_address = master_address
+        self.signing_key = signing_key
+        self.read_signing_key = read_signing_key
         self._rpc = rpc.RpcClient(master_address)
         self._lock = threading.Lock()
         self._vid_cache: dict[int, tuple[float, list[Location]]] = {}
@@ -107,20 +118,28 @@ class MasterClient:
 
     # -- data ops (weed/operation analogs) ------------------------------------
 
-    def upload(self, fid: str, data: bytes, mime: str = "") -> int:
-        """POST to the volume server owning fid's volume."""
+    def upload(self, fid: str, data: bytes, mime: str = "", auth: str = "") -> int:
+        """POST to the volume server owning fid's volume. `auth` is the
+        JWT from Assign (required when the cluster runs secured)."""
         vid = int(fid.split(",", 1)[0])
         locations = self.lookup(vid)
         if not locations:
             raise ClusterError(f"no locations for volume {vid}")
         last_err: Optional[Exception] = None
+        headers = {}
+        if mime:
+            headers["Content-Type"] = mime
+        if not auth and self.signing_key:
+            auth = mint_file_token(self.signing_key, fid)
+        if auth:
+            headers["Authorization"] = "Bearer " + auth
         for loc in locations:
             try:
                 req = urllib.request.Request(
                     f"http://{loc.url}/{fid}",
                     data=data,
                     method="POST",
-                    headers={"Content-Type": mime} if mime else {},
+                    headers=headers,
                 )
                 with urllib.request.urlopen(req, timeout=30) as r:
                     r.read()
@@ -138,9 +157,15 @@ class MasterClient:
             locations = self.lookup(vid, refresh=attempt > 0)
             if not locations and attempt > 0:
                 raise ClusterError(f"no locations for volume {vid}")
+            headers = {}
+            if self.read_signing_key:
+                headers["Authorization"] = "Bearer " + mint_file_token(
+                    self.read_signing_key, fid
+                )
             for loc in locations:
                 try:
-                    with urllib.request.urlopen(f"http://{loc.url}/{fid}", timeout=30) as r:
+                    req = urllib.request.Request(f"http://{loc.url}/{fid}", headers=headers)
+                    with urllib.request.urlopen(req, timeout=30) as r:
                         return r.read()
                 except urllib.error.HTTPError as e:
                     # 404 on one replica can be staleness (e.g. it was down
@@ -153,9 +178,14 @@ class MasterClient:
     def delete(self, fid: str) -> bool:
         vid = int(fid.split(",", 1)[0])
         ok = False
+        headers = {}
+        if self.signing_key:
+            headers["Authorization"] = "Bearer " + mint_file_token(self.signing_key, fid)
         for loc in self.lookup(vid):
             try:
-                req = urllib.request.Request(f"http://{loc.url}/{fid}", method="DELETE")
+                req = urllib.request.Request(
+                    f"http://{loc.url}/{fid}", method="DELETE", headers=headers
+                )
                 with urllib.request.urlopen(req, timeout=30) as r:
                     r.read()
                     ok = True
@@ -165,5 +195,5 @@ class MasterClient:
 
     def submit(self, data: bytes, collection: str = "", replication: str = "", mime: str = "") -> SubmitResult:
         a = self.assign(collection=collection, replication=replication)
-        size = self.upload(a.fid, data, mime=mime)
+        size = self.upload(a.fid, data, mime=mime, auth=a.auth)
         return SubmitResult(fid=a.fid, url=a.url, size=size)

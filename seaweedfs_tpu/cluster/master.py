@@ -17,8 +17,9 @@ from typing import Optional
 
 import grpc
 
-from seaweedfs_tpu import rpc
+from seaweedfs_tpu import rpc, stats
 from seaweedfs_tpu.cluster.sequence import MemorySequencer
+from seaweedfs_tpu.security.jwt import mint_file_token
 from seaweedfs_tpu.cluster.topology import Topology, VolumeLayout
 from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE, Heartbeat
 from seaweedfs_tpu.storage.file_id import FileId
@@ -34,7 +35,9 @@ class MasterServer:
         default_replication: str = "000",
         sequencer=None,
         reap_interval: float = 30.0,
+        guard=None,
     ):
+        self.guard = guard
         self.topology = Topology(
             **({"volume_size_limit": volume_size_limit} if volume_size_limit else {})
         )
@@ -131,6 +134,7 @@ class MasterServer:
         return {}
 
     def _rpc_heartbeat(self, req: dict, ctx) -> dict:
+        stats.MasterReceivedHeartbeatCounter.inc()
         hb = Heartbeat.from_dict(req)
         self.topology.process_heartbeat(hb)
         return {
@@ -158,13 +162,21 @@ class MasterServer:
         key = self.sequencer.next_ids(count)
         cookie = self._rng.getrandbits(32)
         node = nodes[self._rng.randrange(len(nodes))]
-        return {
-            "fid": str(FileId(vid, key, cookie)),
+        stats.MasterAssignCounter.inc()
+        fid = str(FileId(vid, key, cookie))
+        resp = {
+            "fid": fid,
             "url": node.url,
             "public_url": node.public_url,
             "grpc_port": node.grpc_port,
             "count": count,
         }
+        if self.guard is not None and self.guard.signing_key:
+            # token the client must present to the volume server (jwt.go analog)
+            resp["auth"] = mint_file_token(
+                self.guard.signing_key, fid, self.guard.expires_seconds
+            )
+        return resp
 
     def _rpc_lookup(self, req: dict, ctx) -> dict:
         out = []

@@ -24,8 +24,11 @@ import urllib.parse
 import urllib.request
 from typing import Optional
 
-from seaweedfs_tpu import rpc
+import time
+
+from seaweedfs_tpu import rpc, stats
 from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.security import Guard
 from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NeedleDeleted, NeedleNotFound
 from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE, Heartbeat
@@ -52,7 +55,9 @@ class VolumeServer:
         max_volume_count: int = 8,
         heartbeat_interval: float = 5.0,
         encoder=None,
+        guard: Optional[Guard] = None,
     ):
+        self.guard = guard or Guard()
         self.store = Store(directories, encoder=encoder)
         self.store.load()
         self.master_address = master_address
@@ -366,8 +371,11 @@ class VolumeServer:
             kwargs["large_block_size"] = int(req["large_block_size"])
         if req.get("small_block_size"):
             kwargs["small_block_size"] = int(req["small_block_size"])
+        t0 = time.monotonic()
         stripe.write_ec_files(v.base_path, encoder=self.store.encoder, **kwargs)
         stripe.write_sorted_file_from_idx(v.base_path)
+        stats.EcEncodeSeconds.observe(time.monotonic() - t0)
+        stats.EcEncodeBytes.inc(os.path.getsize(v.base_path + ".dat"))
         return {"shard_ids": list(range(TOTAL_SHARDS_COUNT))}
 
     def _rpc_ec_copy(self, req: dict, ctx) -> dict:
@@ -554,6 +562,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self._reply(code, json.dumps(obj).encode(), "application/json", head=head)
 
     def _serve_get(self, head: bool) -> None:
+        stats.VolumeServerRequestCounter.labels("get").inc()
+        if urllib.parse.urlparse(self.path).path == "/metrics":
+            self._reply(
+                200,
+                stats.REGISTRY.expose().encode(),
+                "text/plain; version=0.0.4",
+                head=head,
+            )
+            return
         if urllib.parse.urlparse(self.path).path == "/status":
             self._reply_json(
                 200,
@@ -567,6 +584,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         fid = self._parse_fid()
         if fid is None:
             self._reply_json(400, {"error": "bad file id"}, head=head)
+            return
+        if not self.vs.guard.check_read(
+            str(fid), self.headers.get("Authorization", ""), self.client_address[0]
+        ):
+            self._reply_json(401, {"error": "unauthorized read"}, head=head)
             return
         try:
             self.vs._open_ec_volume(fid.volume_id)  # wire the remote reader
@@ -608,6 +630,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             return f"replica lookup failed: {e}"
         errs = []
+        # replica hop needs its own token: volume servers share the signing
+        # key, so mint one here rather than forwarding the client's
+        auth = {}
+        if self.vs.guard.signing_key:
+            from seaweedfs_tpu.security.jwt import mint_file_token
+
+            auth = {
+                "Authorization": "Bearer "
+                + mint_file_token(self.vs.guard.signing_key, str(fid))
+            }
         for locd in locations:
             if locd["url"] == self.vs.url:
                 continue
@@ -616,7 +648,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     f"http://{locd['url']}/{fid}",
                     data=data,
                     method=method,
-                    headers={"X-Weed-Replicate": "1", **({"Content-Type": ctype} if ctype else {})},
+                    headers={
+                        "X-Weed-Replicate": "1",
+                        **auth,
+                        **({"Content-Type": ctype} if ctype else {}),
+                    },
                 )
                 with urllib.request.urlopen(req, timeout=30) as r:
                     r.read()
@@ -629,9 +665,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         return "; ".join(errs) or None
 
     def do_POST(self) -> None:
+        stats.VolumeServerRequestCounter.labels("post").inc()
         fid = self._parse_fid()
         if fid is None:
             self._reply_json(400, {"error": "bad file id"})
+            return
+        if not self.vs.guard.check_write(
+            str(fid), self.headers.get("Authorization", ""), self.client_address[0]
+        ):
+            self._reply_json(401, {"error": "unauthorized write"})
             return
         length = int(self.headers.get("Content-Length", 0))
         data = self.rfile.read(length)
@@ -659,9 +701,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     do_PUT = do_POST
 
     def do_DELETE(self) -> None:
+        stats.VolumeServerRequestCounter.labels("delete").inc()
         fid = self._parse_fid()
         if fid is None:
             self._reply_json(400, {"error": "bad file id"})
+            return
+        if not self.vs.guard.check_write(
+            str(fid), self.headers.get("Authorization", ""), self.client_address[0]
+        ):
+            self._reply_json(401, {"error": "unauthorized delete"})
             return
         try:
             found = self.vs.store.delete_needle(fid.volume_id, fid.key)

@@ -37,11 +37,40 @@ def _wait_forever() -> None:
     stop.wait()
 
 
+def _load_guard():
+    """Build a security.Guard from security.toml (None = security off)."""
+    from seaweedfs_tpu.security import Guard
+    from seaweedfs_tpu.utils.config import get_nested, load_configuration
+
+    conf = load_configuration("security")
+    key = str(get_nested(conf, "jwt.signing.key", "") or "")
+    read_key = str(get_nested(conf, "jwt.signing.read.key", "") or "")
+    wl = list(get_nested(conf, "guard.white_list", []) or [])
+    exp = int(get_nested(conf, "jwt.signing.expires_after_seconds", 10) or 10)
+    if not (key or read_key or wl):
+        return None
+    return Guard(
+        signing_key=key.encode() or None,
+        read_signing_key=read_key.encode() or None,
+        white_list=wl,
+        expires_seconds=exp,
+    )
+
+
+def _maybe_metrics(port: int):
+    if port:
+        from seaweedfs_tpu.stats import start_metrics_server
+
+        start_metrics_server(port)
+        print(f"metrics on :{port}")
+
+
 def _master_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-port", type=int, default=9333)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-metricsPort", type=int, default=0)
 
 
 def _master_run(args: argparse.Namespace) -> int:
@@ -52,8 +81,10 @@ def _master_run(args: argparse.Namespace) -> int:
         host=args.ip,
         volume_size_limit=args.volumeSizeLimitMB * 1024 * 1024,
         default_replication=args.defaultReplication,
+        guard=_load_guard(),
     )
     m.start()
+    _maybe_metrics(args.metricsPort)
     print(f"master listening on {m.address}")
     _wait_forever()
     m.stop()
@@ -72,6 +103,7 @@ def _volume_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-dataCenter", default="DefaultDataCenter")
     p.add_argument("-rack", default="DefaultRack")
     p.add_argument("-max", type=int, default=8, help="max volume count")
+    p.add_argument("-metricsPort", type=int, default=0)
 
 
 def _volume_run(args: argparse.Namespace) -> int:
@@ -86,8 +118,10 @@ def _volume_run(args: argparse.Namespace) -> int:
         data_center=args.dataCenter,
         rack=args.rack,
         max_volume_count=args.max,
+        guard=_load_guard(),
     )
     vs.start()
+    _maybe_metrics(args.metricsPort)
     print(f"volume server on http {vs.url} grpc {vs.grpc_address}")
     _wait_forever()
     vs.stop()
@@ -146,3 +180,21 @@ def _shell_run(args: argparse.Namespace) -> int:
 
 
 register(Command("shell", "operator shell (REPL or -c script)", _shell_conf, _shell_run))
+
+
+def _scaffold_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-config", default="security", help="security|master|shell|filer")
+
+
+def _scaffold_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.utils.config import SCAFFOLDS, scaffold
+
+    text = scaffold(args.config)
+    if text is None:
+        print(f"unknown config {args.config!r}; one of {sorted(SCAFFOLDS)}", file=sys.stderr)
+        return 1
+    print(text, end="")
+    return 0
+
+
+register(Command("scaffold", "print a commented TOML config template", _scaffold_conf, _scaffold_run))

@@ -160,6 +160,17 @@ class EcVolume:
     def _recover_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         """recoverOneRemoteEcShardInterval: read the same interval from every
         other shard and reconstruct the wanted one."""
+        import time as _time
+
+        from seaweedfs_tpu import stats
+
+        t0 = _time.monotonic()
+        try:
+            return self._recover_interval_inner(shard_id, offset, size)
+        finally:
+            stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
+
+    def _recover_interval_inner(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
         have = 0
         # local shards first — remote reads cost RTTs on the p50-critical path

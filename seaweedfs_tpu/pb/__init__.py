@@ -146,6 +146,7 @@ class AssignResponse:
     grpc_port: int = 0
     count: int = 0
     error: str = ""
+    auth: str = ""  # JWT authorizing the write of fid (when security is on)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -159,4 +160,5 @@ class AssignResponse:
             grpc_port=int(d.get("grpc_port", 0)),
             count=int(d.get("count", 0)),
             error=d.get("error", ""),
+            auth=d.get("auth", ""),
         )

@@ -1,0 +1,10 @@
+"""Security — mirror of weed/security (guard.go, jwt handling)
+[VERIFY: mount empty; SURVEY.md §2.1 "Security" row]: HMAC-SHA256 JWTs
+minted by the master on Assign and enforced by volume servers on the
+write/delete data path; optional separate read key. Keys come from
+`security.toml` (seaweedfs_tpu.utils.config)."""
+
+from seaweedfs_tpu.security.guard import Guard
+from seaweedfs_tpu.security.jwt import decode_jwt, encode_jwt
+
+__all__ = ["Guard", "encode_jwt", "decode_jwt"]

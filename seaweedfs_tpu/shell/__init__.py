@@ -66,6 +66,7 @@ class CommandEnv:
         self.client_name = client_name
         self._lock_token = 0
         self._renew_stop: Optional[threading.Event] = None
+        self._renew_thread: Optional[threading.Thread] = None
 
     def close(self) -> None:
         if self.is_locked:
@@ -127,8 +128,12 @@ class CommandEnv:
             },
         )
         self._lock_token = int(resp["token"])
-        self._renew_stop = threading.Event()
-        threading.Thread(target=self._renew_loop, daemon=True).start()
+        # a second `lock` while already locked is a renewal, not a second
+        # renew thread
+        if self._renew_thread is None or not self._renew_thread.is_alive():
+            self._renew_stop = threading.Event()
+            self._renew_thread = threading.Thread(target=self._renew_loop, daemon=True)
+            self._renew_thread.start()
 
     def unlock(self) -> None:
         if self._renew_stop is not None:
@@ -169,6 +174,12 @@ class CommandEnv:
 
 
 # -- argument helpers (flag.FlagSet analog for `-name=value` style) ----------
+
+
+def grpc_addr(node: dict) -> str:
+    """gRPC address of a topology node dict (shared by all commands)."""
+    host = node["url"].rsplit(":", 1)[0]
+    return f"{host}:{node['grpc_port']}"
 
 
 def parse_flags(args: Iterable[str], **defaults):

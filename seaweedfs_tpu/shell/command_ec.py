@@ -15,6 +15,7 @@ from seaweedfs_tpu.shell import (
     CommandEnv,
     ShellCommand,
     ShellError,
+    grpc_addr,
     parse_flags,
     register,
 )
@@ -22,9 +23,6 @@ from seaweedfs_tpu.shell import (
 _POOL = 8
 
 
-def _grpc_addr(node: dict) -> str:
-    host = node["url"].rsplit(":", 1)[0]
-    return f"{host}:{node['grpc_port']}"
 
 
 def _node_ec_load(node: dict) -> int:
@@ -97,7 +95,7 @@ def _do_ec_encode(
     # 1. freeze writes on every replica (SURVEY.md §3.1); roll the freeze
     # back if anything later fails, or the volume is stuck readonly forever
     for loc in locations:
-        env.vs_call(_grpc_addr(loc), "VolumeMarkReadonly", {"volume_id": vid})
+        env.vs_call(grpc_addr(loc), "VolumeMarkReadonly", {"volume_id": vid})
     try:
         _encode_spread_cutover(
             env, nodes, locations, vid, collection, w, large_block_size, small_block_size
@@ -105,7 +103,7 @@ def _do_ec_encode(
     except Exception:
         for loc in locations:
             try:
-                env.vs_call(_grpc_addr(loc), "VolumeMarkWritable", {"volume_id": vid})
+                env.vs_call(grpc_addr(loc), "VolumeMarkWritable", {"volume_id": vid})
             except Exception:  # noqa: BLE001 — best-effort rollback
                 pass
         raise
@@ -123,7 +121,7 @@ def _encode_spread_cutover(
 ) -> None:
     # 2. generate all 14 shards + .ecx on the first replica holder
     source = locations[0]
-    src_addr = _grpc_addr(source)
+    src_addr = grpc_addr(source)
     gen_req = {"volume_id": vid, "collection": collection}
     if large_block_size:
         gen_req["large_block_size"] = large_block_size
@@ -135,7 +133,7 @@ def _encode_spread_cutover(
 
     def copy_and_mount(node: dict, sids: list[int]):
         def run():
-            addr = _grpc_addr(node)
+            addr = grpc_addr(node)
             if node["url"] != source["url"]:
                 env.vs_call(
                     addr,
@@ -175,7 +173,7 @@ def _encode_spread_cutover(
         )
     # 5. drop the original volume + replicas — cut-over complete
     for loc in locations:
-        env.vs_call(_grpc_addr(loc), "VolumeDelete", {"volume_id": vid})
+        env.vs_call(grpc_addr(loc), "VolumeDelete", {"volume_id": vid})
     w.write(f"ec.encode volume {vid}: spread {_fmt_alloc(alloc)}\n")
 
 
@@ -267,12 +265,12 @@ def _copy_missing_to(env: CommandEnv, node: dict, vid: int, collection: str,
         src = next((h for h in hs if h["url"] != node["url"]), None)
         if src is None:
             continue
-        by_source.setdefault(_grpc_addr(src), []).append(sid)
+        by_source.setdefault(grpc_addr(src), []).append(sid)
     copied: list[int] = []
     first = not local  # no local shards: also pull the index files
     for src_addr, sids in sorted(by_source.items()):
         env.vs_call(
-            _grpc_addr(node),
+            grpc_addr(node),
             "VolumeEcShardsCopy",
             {
                 "volume_id": vid,
@@ -319,7 +317,7 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
             continue
         # rebuilder = node already holding the most shards (fewest copies)
         rebuilder = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
-        addr = _grpc_addr(rebuilder)
+        addr = grpc_addr(rebuilder)
         copied = _copy_missing_to(env, rebuilder, vid, collection, holders)
         resp = env.vs_call(
             addr, "VolumeEcShardsRebuild", {"volume_id": vid, "collection": collection}
@@ -375,7 +373,7 @@ def do_ec_decode(args: list[str], env: CommandEnv, w: TextIO) -> None:
             w.write(f"ec.decode volume {vid}: insufficient shards — data LOST\n")
             continue
         target = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
-        addr = _grpc_addr(target)
+        addr = grpc_addr(target)
         _copy_missing_to(env, target, vid, collection, holders)
         env.vs_call(
             addr, "VolumeEcShardsToVolume", {"volume_id": vid, "collection": collection}
@@ -384,7 +382,7 @@ def do_ec_decode(args: list[str], env: CommandEnv, w: TextIO) -> None:
         for n in nodes:
             if _node_shards_of(n, vid) or n["url"] == target["url"]:
                 env.vs_call(
-                    _grpc_addr(n),
+                    grpc_addr(n),
                     "VolumeEcShardsDelete",
                     {
                         "volume_id": vid,
@@ -446,23 +444,23 @@ def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
             collection = colls.get(vid, "")
             src, dst = by_url[heaviest], by_url[lightest]
             env.vs_call(
-                _grpc_addr(dst),
+                grpc_addr(dst),
                 "VolumeEcShardsCopy",
                 {
                     "volume_id": vid,
                     "collection": collection,
                     "shard_ids": [sid],
-                    "source_data_node": _grpc_addr(src),
+                    "source_data_node": grpc_addr(src),
                     "copy_ecx_file": not placement[lightest].get(vid),
                 },
             )
             env.vs_call(
-                _grpc_addr(dst),
+                grpc_addr(dst),
                 "VolumeEcShardsMount",
                 {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
             )
             env.vs_call(
-                _grpc_addr(src),
+                grpc_addr(src),
                 "VolumeEcShardsDelete",
                 {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
             )

@@ -12,15 +12,13 @@ from seaweedfs_tpu.shell import (
     CommandEnv,
     ShellCommand,
     ShellError,
+    grpc_addr,
     parse_flags,
     register,
 )
 from seaweedfs_tpu.storage.super_block import ReplicaPlacement
 
 
-def _grpc_addr(node: dict) -> str:
-    host = node["url"].rsplit(":", 1)[0]
-    return f"{host}:{node['grpc_port']}"
 
 
 def do_volume_list(args: list[str], env: CommandEnv, w: TextIO) -> None:
@@ -73,7 +71,7 @@ def do_volume_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
     if not locs:
         raise ShellError(f"volume {fl.volumeId} not found")
     for n in locs:
-        env.vs_call(_grpc_addr(n), "VolumeDelete", {"volume_id": fl.volumeId})
+        env.vs_call(grpc_addr(n), "VolumeDelete", {"volume_id": fl.volumeId})
     w.write(f"volume.delete {fl.volumeId}: removed from {[n['url'] for n in locs]}\n")
 
 
@@ -96,7 +94,7 @@ def do_volume_mark(args: list[str], env: CommandEnv, w: TextIO) -> None:
     if not locs:
         raise ShellError(f"volume {fl.volumeId} not found")
     for n in locs:
-        env.vs_call(_grpc_addr(n), method, {"volume_id": fl.volumeId})
+        env.vs_call(grpc_addr(n), method, {"volume_id": fl.volumeId})
     w.write(f"volume.mark {fl.volumeId}: {'readonly' if fl.readonly else 'writable'}\n")
 
 
@@ -125,7 +123,7 @@ def do_volume_vacuum(args: list[str], env: CommandEnv, w: TextIO) -> None:
             fc, dc = int(v.get("file_count", 0)), int(v.get("delete_count", 0))
             if not fl.volumeId and (fc + dc == 0 or dc / max(fc + dc, 1) < fl.garbageThreshold):
                 continue
-            resp = env.vs_call(_grpc_addr(n), "VolumeCompact", {"volume_id": vid})
+            resp = env.vs_call(grpc_addr(n), "VolumeCompact", {"volume_id": vid})
             w.write(
                 f"volume.vacuum {vid} on {n['url']}: "
                 f"{resp.get('bytes_before')} -> {resp.get('bytes_after')} bytes\n"
@@ -213,19 +211,37 @@ def do_volume_fix_replication(args: list[str], env: CommandEnv, w: TextIO) -> No
                 continue
             candidates = _placement_candidates(nodes, holders, rp)
             src = holders[0]
-            for dst in candidates[: want - len(holders)]:
-                env.vs_call(
-                    _grpc_addr(dst),
-                    "VolumeCopy",
-                    {
-                        "volume_id": vid,
-                        "collection": v.get("collection", ""),
-                        "source_data_node": _grpc_addr(src),
-                        "read_only": v.get("read_only", False),
-                    },
-                )
-                w.write(f"volume {vid}: copied {src['url']} -> {dst['url']}\n")
-                fixed += 1
+            was_writable = not v.get("read_only", False)
+            # freeze the survivors during the copy — writes landing mid-copy
+            # would be missing from the new replica (same rule as ec.encode)
+            if was_writable:
+                for h in holders:
+                    env.vs_call(grpc_addr(h), "VolumeMarkReadonly", {"volume_id": vid})
+            try:
+                for dst in candidates[: want - len(holders)]:
+                    env.vs_call(
+                        grpc_addr(dst),
+                        "VolumeCopy",
+                        {
+                            "volume_id": vid,
+                            "collection": v.get("collection", ""),
+                            "source_data_node": grpc_addr(src),
+                            # lands frozen; thawed with the others below
+                            "read_only": True,
+                        },
+                    )
+                    w.write(f"volume {vid}: copied {src['url']} -> {dst['url']}\n")
+                    fixed += 1
+                    holders.append(dst)
+            finally:
+                if was_writable:
+                    for h in holders:
+                        try:
+                            env.vs_call(
+                                grpc_addr(h), "VolumeMarkWritable", {"volume_id": vid}
+                            )
+                        except Exception:  # noqa: BLE001 — best-effort thaw
+                            pass
     w.write(f"volume.fix.replication: checked {checked}, fixed {fixed}\n")
 
 
@@ -240,12 +256,13 @@ register(
 
 
 def do_collection_list(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    names = set()
-    for n in env.topology_nodes():
-        for v in n.get("volumes", []):
-            names.add(v.get("collection", ""))
-        for e in n.get("ec_shards", []):
-            names.add(e.get("collection", ""))
+    topo = env.volume_list()
+    names = set(topo.get("ec_collections", {}).values())
+    for racks in topo.get("data_centers", {}).values():
+        for nodes in racks.values():
+            for n in nodes:
+                for v in n.get("volumes", []):
+                    names.add(v.get("collection", ""))
     for name in sorted(names):
         w.write(f"collection: {name!r}\n")
 

@@ -1,0 +1,283 @@
+"""Metrics — mirror of weed/stats/metrics.go [VERIFY: mount empty;
+SURVEY.md §2.1 "Metrics" row, §5]: Prometheus-model counters / gauges /
+histograms on a process-global registry, exposed in text exposition
+format. Stdlib-only (the prometheus client isn't a dependency); the
+format is wire-compatible with Prometheus scrapers.
+
+North-star EC metrics (SURVEY.md §5) are pre-registered:
+  weedtpu_ec_encode_bytes_total, weedtpu_ec_encode_seconds,
+  weedtpu_ec_reconstruct_seconds (p50 shard-reconstruct latency source).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Optional
+
+
+class _Labeled:
+    """One metric family; children keyed by label values."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._children: dict[tuple, "_Child"] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str) -> "_Child":
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} label values, got {len(values)}"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _make_child(self) -> "_Child":
+        raise NotImplementedError
+
+    def _label_str(self, key: tuple) -> str:
+        if not self.label_names:
+            return ""
+        pairs = ",".join(
+            f'{n}="{v}"' for n, v in zip(self.label_names, key)
+        )
+        return "{" + pairs + "}"
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            lines.extend(child.render(self.name, self._label_str(key)))
+        return lines
+
+
+class _Child:
+    def render(self, name: str, labels: str) -> list[str]:
+        raise NotImplementedError
+
+
+class _CounterChild(_Child):
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def render(self, name, labels):
+        return [f"{name}{labels} {self._v}"]
+
+
+class Counter(_Labeled):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild()
+
+    # label-less sugar
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self.labels().value
+
+
+class _GaugeChild(_CounterChild):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Gauge(_Labeled):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self.labels().value
+
+
+_DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class _HistogramChild(_Child):
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.total += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket upper bounds (ops dashboards;
+        the p50 reconstruct-latency metric reads this)."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            rank = q * self.total
+            seen = 0
+            for i, c in enumerate(self.counts):
+                seen += c
+                if seen >= rank:
+                    return self.buckets[i] if i < len(self.buckets) else float("inf")
+            return float("inf")
+
+    def render(self, name, labels):
+        out = []
+        cum = 0
+        inner = labels[1:-1] if labels else ""
+        for ub, c in zip(self.buckets, self.counts):
+            cum += c
+            le = f'le="{ub}"'
+            lab = "{" + (inner + "," if inner else "") + le + "}"
+            out.append(f"{name}_bucket{lab} {cum}")
+        lab = "{" + (inner + "," if inner else "") + 'le="+Inf"' + "}"
+        out.append(f"{name}_bucket{lab} {cum + self.counts[-1]}")
+        out.append(f"{name}_sum{labels} {self.sum}")
+        out.append(f"{name}_count{labels} {self.total}")
+        return out
+
+
+class Histogram(_Labeled):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def quantile(self, q: float) -> float:
+        return self.labels().quantile(q)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Labeled] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Labeled) -> _Labeled:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+        if not metric.label_names:
+            metric.labels()  # label-less metrics expose a zero sample eagerly
+        return metric
+
+    def counter(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Counter:
+        return self.register(Counter(name, help_, labels))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))  # type: ignore[return-value]
+
+    def histogram(
+        self, name: str, help_: str = "", labels: tuple[str, ...] = (),
+        buckets=_DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self.register(Histogram(name, help_, labels, buckets))  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- the framework's standard metric set (metrics.go analog) -----------------
+
+VolumeServerRequestCounter = REGISTRY.counter(
+    "weedtpu_volume_request_total", "volume server http/grpc requests", ("type",)
+)
+VolumeServerRequestHistogram = REGISTRY.histogram(
+    "weedtpu_volume_request_seconds", "volume server request latency", ("type",)
+)
+MasterReceivedHeartbeatCounter = REGISTRY.counter(
+    "weedtpu_master_received_heartbeats_total", "heartbeats ingested by the master"
+)
+MasterAssignCounter = REGISTRY.counter(
+    "weedtpu_master_assign_total", "fid assignments served"
+)
+EcEncodeBytes = REGISTRY.counter(
+    "weedtpu_ec_encode_bytes_total", "data bytes erasure-encoded"
+)
+EcEncodeSeconds = REGISTRY.histogram(
+    "weedtpu_ec_encode_seconds", "wall time of volume EC encodes"
+)
+EcReconstructSeconds = REGISTRY.histogram(
+    "weedtpu_ec_reconstruct_seconds",
+    "latency of shard-interval reconstructions (p50 is the north-star)",
+)
+VolumeServerVolumeGauge = REGISTRY.gauge(
+    "weedtpu_volume_server_volumes", "volumes hosted", ("type",)
+)
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1"):
+    """Standalone pull endpoint (the reference's -metricsPort). Returns the
+    http.server instance (caller owns shutdown)."""
+    import http.server
+    import threading as _threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = REGISTRY.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.HTTPServer((host, port), H)
+    _threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv

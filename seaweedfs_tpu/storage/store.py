@@ -31,7 +31,9 @@ def parse_base_name(base: str) -> Optional[tuple[str, int]]:
 
 class DiskLocation:
     def __init__(self, directory: str):
-        self.directory = directory
+        # normpath: path-equality checks (e.g. resolving which location owns
+        # a base path) must not break on a trailing slash in -dir
+        self.directory = os.path.normpath(directory)
         os.makedirs(directory, exist_ok=True)
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}

@@ -1,0 +1,91 @@
+"""TOML configuration — weed/util/config.go + command/scaffold.go analog
+[VERIFY: mount empty; SURVEY.md §5 "Config/flag system"]: named TOML
+files (security.toml, master.toml, filer.toml, shell.toml) searched in
+`.`, `~/.seaweedfs_tpu/`, `/etc/seaweedfs_tpu/`; `scaffold` prints
+commented templates. Parsing uses stdlib tomllib."""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Optional
+
+SEARCH_PATHS = [".", "~/.seaweedfs_tpu", "/etc/seaweedfs_tpu"]
+
+
+def load_configuration(name: str, required: bool = False) -> dict[str, Any]:
+    """Load `<name>.toml` from the search path; {} when absent."""
+    fname = name if name.endswith(".toml") else name + ".toml"
+    for d in SEARCH_PATHS:
+        path = os.path.join(os.path.expanduser(d), fname)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return tomllib.load(f)
+    if required:
+        raise FileNotFoundError(
+            f"{fname} not found in {[os.path.expanduser(d) for d in SEARCH_PATHS]}"
+        )
+    return {}
+
+
+def get_nested(conf: dict, dotted: str, default: Any = None) -> Any:
+    """conf lookup by 'a.b.c' path."""
+    cur: Any = conf
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+SCAFFOLDS = {
+    "security": '''\
+# security.toml — put in ., ~/.seaweedfs_tpu/, or /etc/seaweedfs_tpu/
+# JWT signing on the volume-server write path. Empty key = auth disabled.
+
+[jwt.signing]
+key = ""
+expires_after_seconds = 10
+
+# optional separate key gating reads
+[jwt.signing.read]
+key = ""
+expires_after_seconds = 10
+
+[guard]
+# IPs allowed to bypass JWT checks
+white_list = []
+''',
+    "master": '''\
+# master.toml
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+copy_other = 1
+
+[master.sequencer]
+type = "memory"   # memory | snowflake
+''',
+    "shell": '''\
+# shell.toml
+[cluster]
+default = "localhost"
+
+[cluster.localhost]
+master = "127.0.0.1:9333"
+''',
+    "filer": '''\
+# filer.toml — filer metadata store selection
+[memory]
+enabled = false
+
+[sqlite]
+enabled = true
+dbFile = "./filer.db"
+''',
+}
+
+
+def scaffold(name: str) -> Optional[str]:
+    return SCAFFOLDS.get(name)

@@ -1,0 +1,59 @@
+"""Leveled logging — weed/glog analog [VERIFY: mount empty; SURVEY.md
+§2.1 "Logging" row]: `V(n)`-style verbosity gating on top of stdlib
+logging, so call sites read like the reference (`glog.V(3).infof(...)`).
+Verbosity comes from set_verbosity() or the WEEDTPU_V env var."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_logger = logging.getLogger("seaweedfs_tpu")
+if not _logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(
+        logging.Formatter("%(levelname).1s%(asctime)s %(name)s] %(message)s", "%m%d %H:%M:%S")
+    )
+    _logger.addHandler(h)
+    _logger.setLevel(logging.INFO)
+    _logger.propagate = False
+
+_verbosity = int(os.environ.get("WEEDTPU_V", "0"))
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+class _Verbose:
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def info(self, msg: str, *args) -> None:
+        if self.enabled:
+            _logger.info(msg, *args)
+
+    infof = info
+
+
+def V(level: int) -> _Verbose:  # noqa: N802 — glog's exact API shape
+    return _Verbose(level <= _verbosity)
+
+
+def info(msg: str, *args) -> None:
+    _logger.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _logger.warning(msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    _logger.error(msg, *args)
+
+
+infof = info
+warningf = warning
+errorf = error

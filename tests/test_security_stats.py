@@ -1,0 +1,210 @@
+"""Security (JWT guard) + metrics tests — weed/security and weed/stats
+analogs (SURVEY.md §2.1, §5). The guarded-cluster test runs a real
+master+volume pair with a signing key: unauthorized writes/deletes must
+401 while the assign->upload flow (and replica fan-out) works."""
+
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import stats
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.security import Guard
+from seaweedfs_tpu.security.jwt import (
+    JwtError,
+    check_file_token,
+    decode_jwt,
+    encode_jwt,
+    mint_file_token,
+)
+
+KEY = b"test-signing-key"
+
+
+# -- jwt unit ----------------------------------------------------------------
+
+
+def test_jwt_roundtrip_and_tamper():
+    tok = encode_jwt(KEY, {"fid": "3,0102deadbeef"}, expires_seconds=60)
+    claims = decode_jwt(KEY, tok)
+    assert claims["fid"] == "3,0102deadbeef"
+    assert claims["exp"] > time.time()
+    with pytest.raises(JwtError, match="bad signature"):
+        decode_jwt(b"other-key", tok)
+    h, p, s = tok.split(".")
+    with pytest.raises(JwtError):
+        decode_jwt(KEY, h + "." + p + ".AAAA")
+    with pytest.raises(JwtError, match="malformed"):
+        decode_jwt(KEY, "not-a-token")
+
+
+def test_jwt_expiry():
+    tok = encode_jwt(KEY, {"fid": "1,ab"}, expires_seconds=-5)
+    with pytest.raises(JwtError, match="expired"):
+        decode_jwt(KEY, tok)
+
+
+def test_file_token_checks():
+    tok = mint_file_token(KEY, "7,aa11", expires_seconds=60)
+    assert check_file_token(KEY, tok, "7,aa11")
+    assert not check_file_token(KEY, tok, "7,aa12")  # other fid
+    assert not check_file_token(KEY, "", "7,aa11")  # missing token
+    assert check_file_token(None, "", "7,aa11")  # auth disabled
+    assert mint_file_token(None, "7,aa11") == ""
+
+
+def test_guard_white_list():
+    g = Guard(signing_key=KEY, white_list=["10.0.0.9"])
+    assert g.secured
+    assert g.check_write("1,ab", "", remote_ip="10.0.0.9")
+    assert not g.check_write("1,ab", "", remote_ip="10.0.0.7")
+    # whitelist-ONLY mode must deny non-members, not degrade to auth-off
+    g2 = Guard(white_list=["10.0.0.9"])
+    assert g2.secured
+    assert g2.check_write("1,ab", "", remote_ip="10.0.0.9")
+    assert not g2.check_write("1,ab", "", remote_ip="10.0.0.7")
+
+
+# -- guarded cluster e2e ------------------------------------------------------
+
+
+@pytest.fixture
+def secured_cluster(tmp_path):
+    guard = Guard(signing_key=KEY)
+    master = MasterServer(port=0, reap_interval=3600, guard=guard)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"srv{i}"
+        d.mkdir()
+        vs = VolumeServer(
+            [str(d)], master.address, heartbeat_interval=0.3, guard=guard
+        )
+        vs.start()
+        servers.append(vs)
+    client = MasterClient(master.address)
+    yield master, servers, client
+    client.close()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_secured_write_flow(secured_cluster):
+    master, servers, client = secured_cluster
+    a = client.assign(replication="001")
+    assert a.auth, "secured master must return an auth token on assign"
+    payload = b"locked down payload"
+    client.upload(a.fid, payload, auth=a.auth)  # replica fan-out included
+    assert client.read(a.fid) == payload
+    # both replicas actually hold it (fan-out hop minted its own token)
+    held = sum(
+        1
+        for vs in servers
+        if _direct_read(vs.url, a.fid) == payload
+    )
+    assert held == 2
+
+    # un-authenticated write to a fresh fid: 401
+    b = client.assign()
+    with pytest.raises(Exception) as ei:
+        client.upload(b.fid, b"no token")
+    assert "401" in str(ei.value)
+    # token for fid A does not authorize fid B
+    with pytest.raises(Exception) as ei:
+        client.upload(b.fid, b"wrong token", auth=a.auth)
+    assert "401" in str(ei.value)
+    # un-authenticated delete: 401 surfaces as not-deleted
+    req = urllib.request.Request(f"http://{servers[0].url}/{a.fid}", method="DELETE")
+    with pytest.raises(urllib.error.HTTPError) as he:
+        urllib.request.urlopen(req, timeout=10)
+    assert he.value.code == 401
+    assert client.read(a.fid) == payload  # still there
+
+    # a trusted client configured with the shared key self-mints delete tokens
+    trusted = MasterClient(client.master_address, signing_key=KEY)
+    try:
+        assert trusted.delete(a.fid)
+        with pytest.raises(Exception):
+            trusted.read(a.fid)
+    finally:
+        trusted.close()
+
+
+def _direct_read(url, fid):
+    try:
+        with urllib.request.urlopen(f"http://{url}/{fid}", timeout=10) as r:
+            return r.read()
+    except urllib.error.HTTPError:
+        return None
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def test_metrics_exposition_and_counters(tmp_path):
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "srv"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+    vs.start()
+    client = MasterClient(master.address)
+    try:
+        hb_before = stats.MasterReceivedHeartbeatCounter.value
+        vs.heartbeat_once()
+        res = client.submit(b"metrics payload")
+        assert client.read(res.fid) == b"metrics payload"
+        body = urllib.request.urlopen(f"http://{vs.url}/metrics", timeout=10).read().decode()
+        assert "# TYPE weedtpu_volume_request_total counter" in body
+        assert 'weedtpu_volume_request_total{type="post"}' in body
+        assert 'weedtpu_volume_request_total{type="get"}' in body
+        assert "# TYPE weedtpu_ec_reconstruct_seconds histogram" in body
+        assert "weedtpu_ec_reconstruct_seconds_bucket" in body
+        assert stats.MasterReceivedHeartbeatCounter.value > hb_before
+        assert stats.MasterAssignCounter.value >= 1
+    finally:
+        client.close()
+        vs.stop()
+        master.stop()
+
+
+def test_histogram_quantile():
+    h = stats.Histogram("t_q_seconds", "test", buckets=(0.001, 0.01, 0.1, 1.0))
+    for _ in range(50):
+        h.observe(0.005)
+    for _ in range(50):
+        h.observe(0.05)
+    assert h.quantile(0.25) == 0.01
+    assert h.quantile(0.9) == 0.1
+
+
+def test_standalone_metrics_server():
+    srv = stats.start_metrics_server(0)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+        assert b"weedtpu_master_assign_total" in body
+    finally:
+        srv.shutdown()
+
+
+def test_scaffold_and_config(tmp_path, monkeypatch):
+    from seaweedfs_tpu.utils import config as cfg
+
+    text = cfg.scaffold("security")
+    assert "[jwt.signing]" in text
+    p = tmp_path / "security.toml"
+    p.write_text(text.replace('key = ""', 'key = "abc"', 1))
+    monkeypatch.setattr(cfg, "SEARCH_PATHS", [str(tmp_path)])
+    conf = cfg.load_configuration("security")
+    assert cfg.get_nested(conf, "jwt.signing.key") == "abc"
+    assert cfg.get_nested(conf, "jwt.signing.read.key") == ""
+    assert cfg.get_nested(conf, "nope.deep", 42) == 42
+    assert cfg.load_configuration("missing") == {}
+    with pytest.raises(FileNotFoundError):
+        cfg.load_configuration("missing", required=True)
