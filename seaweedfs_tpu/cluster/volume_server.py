@@ -562,7 +562,6 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self._reply(code, json.dumps(obj).encode(), "application/json", head=head)
 
     def _serve_get(self, head: bool) -> None:
-        stats.VolumeServerRequestCounter.labels("get").inc()
         if urllib.parse.urlparse(self.path).path == "/metrics":
             self._reply(
                 200,
@@ -581,6 +580,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 head=head,
             )
             return
+        stats.VolumeServerRequestCounter.labels("get").inc()
         fid = self._parse_fid()
         if fid is None:
             self._reply_json(400, {"error": "bad file id"}, head=head)

@@ -163,6 +163,51 @@ def _server_run(args: argparse.Namespace) -> int:
 register(Command("server", "run master + volume server in one process", _server_conf, _server_run))
 
 
+def _filer_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-grpcPort", type=int, default=0)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-store", default="memory", help="memory|sqlite")
+    p.add_argument("-dir", default="", help="store/meta-log directory (sqlite store)")
+    p.add_argument("-collection", default="")
+    p.add_argument("-defaultReplicaPlacement", default="")
+    p.add_argument("-maxMB", type=int, default=4, help="chunk size in MiB")
+    p.add_argument("-metricsPort", type=int, default=0)
+
+
+def _filer_run(args: argparse.Namespace) -> int:
+    import os
+
+    from seaweedfs_tpu.filer import FilerServer, make_store
+
+    # share the cluster's jwt keys so chunk deletes/reads work secured
+    guard = _load_guard()
+    store_path = os.path.join(args.dir, "filer.db") if args.dir else ""
+    f = FilerServer(
+        args.master,
+        store=make_store(args.store, store_path),
+        port=args.port,
+        grpc_port=args.grpcPort,
+        host=args.ip,
+        chunk_size=args.maxMB * 1024 * 1024,
+        log_dir=args.dir,
+        collection=args.collection,
+        replication=args.defaultReplicaPlacement,
+        signing_key=guard.signing_key if guard else None,
+        read_signing_key=guard.read_signing_key if guard else None,
+    )
+    f.start()
+    _maybe_metrics(args.metricsPort)
+    print(f"filer on http {f.url} grpc {f.grpc_address}")
+    _wait_forever()
+    f.stop()
+    return 0
+
+
+register(Command("filer", "run a filer (namespace) server", _filer_conf, _filer_run))
+
+
 def _shell_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-c", dest="script", default="", help="run `;`-separated commands and exit")

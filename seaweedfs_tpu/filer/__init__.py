@@ -1,0 +1,33 @@
+"""Filer — the namespace layer (L5): a directory tree of entries, each a
+list of chunks stored on the volume tier. Mirror of weed/filer/ [VERIFY:
+mount empty; SURVEY.md §2.1 "Filer" row, §1 L5].
+
+Components:
+  entry.py   — Entry / Attributes / FileChunk records (filer.proto analogs)
+  store.py   — FilerStore interface + memory / sqlite implementations
+               (the reference's pluggable leveldb/mysql/... store wall)
+  chunks.py  — chunk upload/read against the volume tier, manifests, etags
+  filer.py   — Filer core: mkdirs, CRUD, recursive delete, rename,
+               metadata event log with subscriptions
+  server.py  — FilerServer: HTTP file API + weedtpu.Filer RPC service
+"""
+
+from seaweedfs_tpu.filer.entry import Attributes, Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer, MetaEvent
+from seaweedfs_tpu.filer.store import FilerStore, MemoryStore, SqliteStore, make_store
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.filer.client import FilerClient
+
+__all__ = [
+    "Attributes",
+    "Entry",
+    "FileChunk",
+    "Filer",
+    "MetaEvent",
+    "FilerStore",
+    "MemoryStore",
+    "SqliteStore",
+    "make_store",
+    "FilerServer",
+    "FilerClient",
+]

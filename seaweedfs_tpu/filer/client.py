@@ -1,0 +1,110 @@
+"""FilerClient — RPC client for the weedtpu.Filer service, the analog of
+the filer_pb client helpers in weed/pb/filer_pb_helper.go and the
+FilerClient wrappers used by mount / s3 / replication [VERIFY: mount
+empty; SURVEY.md §2.1].
+
+Gateways running in-process with the FilerServer can skip RPC and use
+`server.filer` directly; this client is for separate processes
+(mount, filer.sync, mq broker)."""
+
+from __future__ import annotations
+
+import base64
+from typing import Iterator, Optional
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filer import MetaEvent
+from seaweedfs_tpu.pb import FILER_SERVICE
+
+
+class FilerClient:
+    def __init__(self, grpc_address: str):
+        self._rpc = rpc.RpcClient(grpc_address)
+
+    def close(self) -> None:
+        self._rpc.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def lookup(self, path: str) -> Optional[Entry]:
+        import grpc as _grpc
+
+        try:
+            resp = self._rpc.call(FILER_SERVICE, "LookupDirectoryEntry", {"path": path})
+        except _grpc.RpcError as e:
+            if e.code() == _grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+        return Entry.from_dict(resp["entry"])
+
+    def list(
+        self, directory: str, start_from: str = "", limit: int = 1024, prefix: str = ""
+    ) -> list[Entry]:
+        resp = self._rpc.call(
+            FILER_SERVICE,
+            "ListEntries",
+            {
+                "directory": directory,
+                "start_from": start_from,
+                "limit": limit,
+                "prefix": prefix,
+            },
+        )
+        return [Entry.from_dict(d) for d in resp["entries"]]
+
+    def create(self, entry: Entry, o_excl: bool = False) -> None:
+        self._rpc.call(
+            FILER_SERVICE, "CreateEntry", {"entry": entry.to_dict(), "o_excl": o_excl}
+        )
+
+    def update(self, entry: Entry) -> None:
+        self._rpc.call(FILER_SERVICE, "UpdateEntry", {"entry": entry.to_dict()})
+
+    def delete(
+        self, path: str, recursive: bool = False, delete_data: bool = True
+    ) -> None:
+        self._rpc.call(
+            FILER_SERVICE,
+            "DeleteEntry",
+            {"path": path, "is_recursive": recursive, "is_delete_data": delete_data},
+        )
+
+    def rename(self, old_path: str, new_path: str) -> None:
+        self._rpc.call(
+            FILER_SERVICE, "AtomicRenameEntry", {"old_path": old_path, "new_path": new_path}
+        )
+
+    def read_file(self, path: str) -> bytes:
+        return b"".join(self._rpc.stream(FILER_SERVICE, "ReadFile", {"path": path}))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        import grpc as _grpc
+
+        try:
+            resp = self._rpc.call(FILER_SERVICE, "KvGet", {"key": key})
+        except _grpc.RpcError as e:
+            if e.code() == _grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+        return base64.b64decode(resp["value"])
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._rpc.call(
+            FILER_SERVICE, "KvPut", {"key": key, "value": base64.b64encode(value).decode()}
+        )
+
+    def subscribe(
+        self, since_ns: int = 0, path_prefix: str = "/", max_idle_s: float = 0
+    ) -> Iterator[MetaEvent]:
+        for d in self._rpc.stream(
+            FILER_SERVICE,
+            "SubscribeMetadata",
+            {"since_ns": since_ns, "path_prefix": path_prefix, "max_idle_s": max_idle_s},
+            resp_format="json",
+        ):
+            yield MetaEvent.from_dict(d)

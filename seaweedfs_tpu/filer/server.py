@@ -1,0 +1,425 @@
+"""Filer server — mirror of weed/server/filer_server.go + the filer HTTP
+handlers (filer_server_handlers_read.go/_write.go) and the weedtpu.Filer
+gRPC surface from weed/pb/filer.proto [VERIFY: mount empty; SURVEY.md
+§2.1 "Filer" row, §1 L5].
+
+HTTP file API (the data path):
+  GET    /path/to/file          -> file bytes (Range: bytes=a-b honored)
+  GET    /path/to/dir           -> JSON directory listing
+                                   (?limit=&lastFileName=&prefix=)
+  PUT    /path/to/file          -> chunked upload via assign+POST
+  POST   /path/to/file?mv.from= -> rename
+  DELETE /path[?recursive=true] -> delete (+chunk reclamation)
+
+RPC service weedtpu.Filer: LookupDirectoryEntry, ListEntries, CreateEntry,
+UpdateEntry, DeleteEntry, AtomicRenameEntry, Statistics, KvGet/KvPut,
+SubscribeMetadata (server stream of MetaEvent JSON frames).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import socketserver
+import threading
+import urllib.parse
+from typing import Optional
+
+import grpc
+
+from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.filer.chunks import ChunkIO, DEFAULT_CHUNK_SIZE, etag_of
+from seaweedfs_tpu.filer.entry import Attributes, Entry, normalize_path
+from seaweedfs_tpu.filer.filer import Filer, MetaEvent
+from seaweedfs_tpu.filer.store import EntryNotFound, FilerStore, make_store
+from seaweedfs_tpu.pb import FILER_SERVICE
+
+import io
+import time
+
+
+class FilerServer:
+    def __init__(
+        self,
+        master_address: str,
+        store: Optional[FilerStore] = None,
+        port: int = 0,
+        grpc_port: int = 0,
+        host: str = "127.0.0.1",
+        chunk_size: int = DEFAULT_CHUNK_SIZE,
+        log_dir: str = "",
+        collection: str = "",
+        replication: str = "",
+        signing_key: Optional[bytes] = None,
+        read_signing_key: Optional[bytes] = None,
+    ):
+        self.master_address = master_address
+        self.master = MasterClient(
+            master_address, signing_key=signing_key, read_signing_key=read_signing_key
+        )
+        self.chunk_io = ChunkIO(self.master, chunk_size=chunk_size)
+        self.filer = Filer(store or make_store("memory"), self.chunk_io, log_dir=log_dir)
+        self.collection = collection
+        self.replication = replication
+        self.host = host
+
+        self._grpc = rpc.RpcServer(port=grpc_port, host=host)
+        self._grpc.add_service(self._build_service())
+        self.grpc_port = self._grpc.port
+
+        self._http = _ThreadingHTTPServer((host, port), _Handler)
+        self._http.filer_server = self
+        self.port = self._http.server_address[1]
+        self._http_thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def grpc_address(self) -> str:
+        return f"{self.host}:{self.grpc_port}"
+
+    def start(self) -> None:
+        self._grpc.start()
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self._grpc.stop()
+        self.master.close()
+        self.filer.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- upload/read helpers shared by HTTP and gateways ----------------------
+
+    def write_file(
+        self,
+        path: str,
+        reader,
+        mime: str = "",
+        mode: int = 0o660,
+        collection: str = "",
+        replication: str = "",
+        ttl: str = "",
+        extended: Optional[dict] = None,
+        o_excl: bool = False,
+    ) -> Entry:
+        collection = collection or self.collection
+        replication = replication or self.replication
+        chunks, size, md5hex = self.chunk_io.upload_stream(
+            reader, collection=collection, replication=replication, ttl=ttl
+        )
+        chunks = self.chunk_io.maybe_manifestize(
+            chunks, collection=collection, replication=replication, ttl=ttl
+        )
+        entry = Entry(
+            path=path,
+            is_directory=False,
+            attributes=Attributes(
+                mtime=time.time(),
+                mode=mode,
+                mime=mime,
+                collection=collection,
+                replication=replication,
+                md5=md5hex,
+                file_size=size,
+            ),
+            chunks=chunks,
+            extended=dict(extended or {}),
+        )
+        return self.filer.create_entry(entry, o_excl=o_excl)
+
+    def read_file(self, entry: Entry) -> bytes:
+        return self.chunk_io.read_all(entry.chunks)
+
+    # -- RPC service ---------------------------------------------------------
+
+    def _build_service(self) -> rpc.Service:
+        svc = rpc.Service(FILER_SERVICE)
+        add = svc.add
+        add("LookupDirectoryEntry", self._rpc_lookup)
+        add("ListEntries", self._rpc_list)
+        add("CreateEntry", self._rpc_create)
+        add("UpdateEntry", self._rpc_update)
+        add("DeleteEntry", self._rpc_delete)
+        add("AtomicRenameEntry", self._rpc_rename)
+        add("Statistics", self._rpc_statistics)
+        add("KvGet", self._rpc_kv_get)
+        add("KvPut", self._rpc_kv_put)
+        add("ReadFile", self._rpc_read_file, kind="unary_stream", resp_format="bytes")
+        add("SubscribeMetadata", self._rpc_subscribe, kind="unary_stream", resp_format="json")
+        return svc
+
+    def _rpc_lookup(self, req: dict, ctx) -> dict:
+        try:
+            e = self.filer.find_entry(req["path"])
+        except EntryNotFound:
+            raise rpc.NotFoundFault(f"{req['path']} not found")
+        return {"entry": e.to_dict()}
+
+    def _rpc_list(self, req: dict, ctx) -> dict:
+        entries = self.filer.list_entries(
+            req["directory"],
+            start_from=req.get("start_from", ""),
+            include_start=bool(req.get("inclusive_start_from", False)),
+            limit=int(req.get("limit", 1024)),
+            prefix=req.get("prefix", ""),
+        )
+        return {"entries": [e.to_dict() for e in entries]}
+
+    def _rpc_create(self, req: dict, ctx) -> dict:
+        entry = Entry.from_dict(req["entry"])
+        try:
+            self.filer.create_entry(entry, o_excl=bool(req.get("o_excl", False)))
+        except FileExistsError:
+            raise rpc.RpcFault(f"{entry.path} exists", grpc.StatusCode.ALREADY_EXISTS)
+        return {}
+
+    def _rpc_update(self, req: dict, ctx) -> dict:
+        entry = Entry.from_dict(req["entry"])
+        try:
+            self.filer.update_entry(entry)
+        except EntryNotFound:
+            raise rpc.NotFoundFault(f"{entry.path} not found")
+        return {}
+
+    def _rpc_delete(self, req: dict, ctx) -> dict:
+        try:
+            self.filer.delete_entry(
+                req["path"],
+                recursive=bool(req.get("is_recursive", False)),
+                ignore_recursive_error=bool(req.get("ignore_recursive_error", False)),
+                delete_chunks=bool(req.get("is_delete_data", True)),
+            )
+        except EntryNotFound:
+            raise rpc.NotFoundFault(f"{req['path']} not found")
+        except OSError as e:
+            raise rpc.RpcFault(str(e))
+        return {}
+
+    def _rpc_rename(self, req: dict, ctx) -> dict:
+        try:
+            self.filer.rename(req["old_path"], req["new_path"])
+        except EntryNotFound:
+            raise rpc.NotFoundFault(f"{req['old_path']} not found")
+        return {}
+
+    def _rpc_statistics(self, req: dict, ctx) -> dict:
+        return self.master.statistics()
+
+    def _rpc_kv_get(self, req: dict, ctx) -> dict:
+        v = self.filer.store.kv_get(req["key"])
+        if v is None:
+            raise rpc.NotFoundFault(f"key {req['key']} not found")
+        import base64
+
+        return {"value": base64.b64encode(v).decode()}
+
+    def _rpc_kv_put(self, req: dict, ctx) -> dict:
+        import base64
+
+        self.filer.store.kv_put(req["key"], base64.b64decode(req["value"]))
+        return {}
+
+    def _rpc_read_file(self, req: dict, ctx):
+        try:
+            e = self.filer.find_entry(req["path"])
+        except EntryNotFound:
+            raise rpc.NotFoundFault(f"{req['path']} not found")
+        yield from self.chunk_io.stream_all(e.chunks)
+
+    def _rpc_subscribe(self, req: dict, ctx):
+        """Stream MetaEvents since ts_ns; ends when the client cancels
+        (gRPC termination callback sets `stop`) or after `max_idle_s`
+        without events, so the handler thread never leaks."""
+        since = int(req.get("since_ns", 0))
+        prefix = req.get("path_prefix", "/")
+        idle_limit = float(req.get("max_idle_s", 0) or 0)
+        stop = threading.Event()
+        ctx.add_callback(stop.set)
+        for ev in self.filer.subscribe(
+            since_ns=since, prefix=prefix, stop=stop, idle_timeout=idle_limit
+        ):
+            yield ev.to_dict()
+
+
+# -- HTTP --------------------------------------------------------------------
+
+
+class _ThreadingHTTPServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    filer_server: "FilerServer"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    @property
+    def fs(self) -> FilerServer:
+        return self.server.filer_server
+
+    def _pq(self) -> tuple[str, dict]:
+        u = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(u.query).items()}
+        return urllib.parse.unquote(u.path) or "/", q
+
+    def _reply(self, code: int, body: bytes, ctype="application/octet-stream", headers=None, head=False):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if not head:
+            self.wfile.write(body)
+
+    def _reply_json(self, code: int, obj, head=False):
+        self._reply(code, json.dumps(obj).encode(), "application/json", head=head)
+
+    def _serve_get(self, head: bool) -> None:
+        stats.FilerRequestCounter.labels("get").inc()
+        path, q = self._pq()
+        try:
+            entry = self.fs.filer.find_entry(path)
+        except EntryNotFound:
+            self._reply_json(404, {"error": f"{path} not found"}, head=head)
+            return
+        if entry.is_directory:
+            entries = self.fs.filer.list_entries(
+                path,
+                start_from=q.get("lastFileName", ""),
+                limit=int(q.get("limit", 1024)),
+                prefix=q.get("prefix", ""),
+            )
+            self._reply_json(
+                200,
+                {
+                    "Path": path,
+                    "Entries": [e.to_dict() for e in entries],
+                    "LastFileName": entries[-1].name if entries else "",
+                },
+                head=head,
+            )
+            return
+        mime = entry.attributes.mime or "application/octet-stream"
+        etag = etag_of(entry.chunks, entry.attributes.md5)
+        base_headers = {
+            "ETag": f'"{etag}"',
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attributes.mtime)
+            ),
+            "Accept-Ranges": "bytes",
+            **{k: v for k, v in entry.extended.items() if k.lower().startswith("x-")},
+        }
+        if head:
+            base_headers["Content-Length"] = str(entry.size)
+            self.send_response(200)
+            self.send_header("Content-Type", mime)
+            for k, v in base_headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            return
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            try:
+                lo_s, hi_s = rng[len("bytes=") :].split("-", 1)
+                size = entry.size
+                if lo_s == "":  # suffix range: last N bytes
+                    n = int(hi_s)
+                    lo, hi = max(0, size - n), size - 1
+                else:
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s else size - 1
+                hi = min(hi, size - 1)
+                if lo > hi or lo >= size:
+                    self._reply_json(416, {"error": "bad range"})
+                    return
+                body = self.fs.chunk_io.read_range(entry.chunks, lo, hi - lo + 1)
+                base_headers["Content-Range"] = f"bytes {lo}-{hi}/{size}"
+                self._reply(206, body, mime, headers=base_headers)
+                return
+            except ValueError:
+                pass
+        body = self.fs.read_file(entry)
+        self._reply(200, body, mime, headers=base_headers)
+
+    def do_GET(self):
+        self._serve_get(head=False)
+
+    def do_HEAD(self):
+        self._serve_get(head=True)
+
+    def do_PUT(self):
+        stats.FilerRequestCounter.labels("put").inc()
+        path, q = self._pq()
+        if "mv.from" in q:
+            try:
+                self.fs.filer.rename(q["mv.from"], path)
+            except EntryNotFound:
+                self._reply_json(404, {"error": f"{q['mv.from']} not found"})
+                return
+            self._reply_json(200, {"path": path})
+            return
+        if path.endswith("/") or q.get("op") == "mkdir":
+            self.fs.filer.mkdirs(path.rstrip("/") or "/")
+            self._reply_json(201, {"path": path})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        extended = {
+            k: v for k, v in self.headers.items() if k.lower().startswith("x-amz-")
+        }
+        entry = self.fs.write_file(
+            path,
+            io.BytesIO(body),
+            mime=self.headers.get("Content-Type", ""),
+            collection=q.get("collection", ""),
+            replication=q.get("replication", ""),
+            ttl=q.get("ttl", ""),
+            extended=extended,
+        )
+        self._reply_json(
+            201,
+            {
+                "name": entry.name,
+                "size": entry.size,
+                "etag": etag_of(entry.chunks, entry.attributes.md5),
+            },
+        )
+
+    do_POST = do_PUT
+
+    def do_DELETE(self):
+        stats.FilerRequestCounter.labels("delete").inc()
+        path, q = self._pq()
+        try:
+            self.fs.filer.delete_entry(
+                path,
+                recursive=q.get("recursive") == "true",
+                ignore_recursive_error=q.get("ignoreRecursiveError") == "true",
+            )
+        except EntryNotFound:
+            self._reply_json(404, {"error": f"{path} not found"})
+            return
+        except OSError as e:
+            self._reply_json(409, {"error": str(e)})
+            return
+        # 204 must carry no body (RFC 9110) or keep-alive clients desync
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()

@@ -31,6 +31,8 @@ from typing import Optional
 
 MASTER_SERVICE = "weedtpu.Master"
 VOLUME_SERVICE = "weedtpu.VolumeServer"
+FILER_SERVICE = "weedtpu.Filer"
+MQ_SERVICE = "weedtpu.MessageQueue"
 
 
 @dataclass

@@ -258,6 +258,12 @@ EcReconstructSeconds = REGISTRY.histogram(
 VolumeServerVolumeGauge = REGISTRY.gauge(
     "weedtpu_volume_server_volumes", "volumes hosted", ("type",)
 )
+FilerRequestCounter = REGISTRY.counter(
+    "weedtpu_filer_request_total", "filer http requests", ("type",)
+)
+S3RequestCounter = REGISTRY.counter(
+    "weedtpu_s3_request_total", "s3 gateway requests", ("action",)
+)
 
 
 def start_metrics_server(port: int, host: str = "127.0.0.1"):
