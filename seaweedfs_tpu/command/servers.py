@@ -208,6 +208,104 @@ def _filer_run(args: argparse.Namespace) -> int:
 register(Command("filer", "run a filer (namespace) server", _filer_conf, _filer_run))
 
 
+def _s3_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="127.0.0.1:8888", help="filer http host:port")
+    p.add_argument("-filerGrpc", default="", help="filer grpc host:port (default: ask filer)")
+    p.add_argument("-config", default="", help="identities JSON file (reference -s3.config shape)")
+    p.add_argument("-metricsPort", type=int, default=0)
+
+
+def _s3_run(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from seaweedfs_tpu.s3api import Iam, S3ApiServer
+
+    iam = Iam()
+    if args.config:
+        with open(args.config, encoding="utf-8") as f:
+            iam = Iam.from_config(_json.load(f))
+    grpc_addr = args.filerGrpc
+    if not iam.identities and grpc_addr:
+        # no static config: pick up identities the IAM API persisted in
+        # the filer KV store (and _auth re-reads on unknown access keys)
+        from seaweedfs_tpu.filer.client import FilerClient
+        from seaweedfs_tpu.s3api.auth import load_identities
+
+        try:
+            with FilerClient(grpc_addr) as fc:
+                stored = load_identities(fc)
+            if stored is not None:
+                iam = stored
+        except Exception:  # noqa: BLE001 — filer may not be up yet
+            pass
+    if not grpc_addr:
+        # filer grpc defaults to the http port + 10000 convention is the
+        # reference's; here we require it explicitly unless colocated
+        raise SystemExit("-filerGrpc is required")
+    s3 = S3ApiServer(
+        args.filer, grpc_addr, port=args.port, host=args.ip, iam=iam
+    )
+    s3.start()
+    _maybe_metrics(args.metricsPort)
+    print(f"s3 gateway on {s3.url} -> filer {args.filer}")
+    _wait_forever()
+    s3.stop()
+    return 0
+
+
+register(Command("s3", "run an S3-compatible gateway against a filer", _s3_conf, _s3_run))
+
+
+def _webdav_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-filerGrpc", default="")
+    p.add_argument("-root", default="/", help="filer directory to expose")
+
+
+def _webdav_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.webdav import WebDavServer
+
+    if not args.filerGrpc:
+        raise SystemExit("-filerGrpc is required")
+    w = WebDavServer(
+        args.filer, args.filerGrpc, port=args.port, host=args.ip, root=args.root
+    )
+    w.start()
+    print(f"webdav on {w.url} -> filer {args.filer}")
+    _wait_forever()
+    w.stop()
+    return 0
+
+
+register(Command("webdav", "run a WebDAV gateway against a filer", _webdav_conf, _webdav_run))
+
+
+def _iam_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=8111)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filerGrpc", default="", help="filer grpc host:port")
+
+
+def _iam_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.iamapi import IamApiServer
+
+    if not args.filerGrpc:
+        raise SystemExit("-filerGrpc is required")
+    srv = IamApiServer(args.filerGrpc, port=args.port, host=args.ip)
+    srv.start()
+    print(f"iam api on {srv.url}")
+    _wait_forever()
+    srv.stop()
+    return 0
+
+
+register(Command("iam", "run an AWS-IAM-compatible identity API", _iam_conf, _iam_run))
+
+
 def _shell_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-c", dest="script", default="", help="run `;`-separated commands and exit")

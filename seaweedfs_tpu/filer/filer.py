@@ -215,6 +215,9 @@ class Filer:
                 old = self.store.find(entry.path)
                 if o_excl:
                     raise FileExistsError(entry.path)
+                if old.is_directory and not entry.is_directory:
+                    # replacing a dir with a file would orphan its children
+                    raise IsADirectoryError(entry.path)
             except EntryNotFound:
                 pass
             if (
@@ -318,6 +321,8 @@ class Filer:
             entry = self.store.find(old_path)
             try:
                 target = self.store.find(new_path)
+                if target.is_directory and not entry.is_directory:
+                    raise IsADirectoryError(new_path)
                 # overwrite: reclaim the displaced file's chunks
                 if target.chunks and self.chunk_io is not None:
                     self.chunk_io.delete_chunks(target.chunks)

@@ -18,9 +18,7 @@ SubscribeMetadata (server stream of MetaEvent JSON frames).
 
 from __future__ import annotations
 
-import http.server
 import json
-import socketserver
 import threading
 import urllib.parse
 from typing import Optional
@@ -28,6 +26,7 @@ from typing import Optional
 import grpc
 
 from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.utils import httpd
 from seaweedfs_tpu.cluster.client import MasterClient
 from seaweedfs_tpu.filer.chunks import ChunkIO, DEFAULT_CHUNK_SIZE, etag_of
 from seaweedfs_tpu.filer.entry import Attributes, Entry, normalize_path
@@ -257,18 +256,11 @@ class FilerServer:
 # -- HTTP --------------------------------------------------------------------
 
 
-class _ThreadingHTTPServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
-    daemon_threads = True
-    allow_reuse_address = True
+class _ThreadingHTTPServer(httpd.ThreadingHTTPServer):
     filer_server: "FilerServer"
 
 
-class _Handler(http.server.BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-
-    def log_message(self, fmt, *args):  # quiet
-        pass
-
+class _Handler(httpd.QuietHandler):
     @property
     def fs(self) -> FilerServer:
         return self.server.filer_server
@@ -279,14 +271,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         return urllib.parse.unquote(u.path) or "/", q
 
     def _reply(self, code: int, body: bytes, ctype="application/octet-stream", headers=None, head=False):
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        if not head:
-            self.wfile.write(body)
+        self.send_reply(code, body, ctype, headers=headers, head=head)
 
     def _reply_json(self, code: int, obj, head=False):
         self._reply(code, json.dumps(obj).encode(), "application/json", head=head)
@@ -303,7 +288,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             entries = self.fs.filer.list_entries(
                 path,
                 start_from=q.get("lastFileName", ""),
-                limit=int(q.get("limit", 1024)),
+                limit=httpd.safe_int(q.get("limit"), 1024),
                 prefix=q.get("prefix", ""),
             )
             self._reply_json(
@@ -373,26 +358,35 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             except EntryNotFound:
                 self._reply_json(404, {"error": f"{q['mv.from']} not found"})
                 return
+            except IsADirectoryError:
+                self._reply_json(409, {"error": f"{path} is a directory"})
+                return
             self._reply_json(200, {"path": path})
             return
         if path.endswith("/") or q.get("op") == "mkdir":
             self.fs.filer.mkdirs(path.rstrip("/") or "/")
             self._reply_json(201, {"path": path})
             return
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length)
+        body = self.read_body()
+        if body is None:
+            self.reply_length_required()
+            return
         extended = {
             k: v for k, v in self.headers.items() if k.lower().startswith("x-amz-")
         }
-        entry = self.fs.write_file(
-            path,
-            io.BytesIO(body),
-            mime=self.headers.get("Content-Type", ""),
-            collection=q.get("collection", ""),
-            replication=q.get("replication", ""),
-            ttl=q.get("ttl", ""),
-            extended=extended,
-        )
+        try:
+            entry = self.fs.write_file(
+                path,
+                io.BytesIO(body),
+                mime=self.headers.get("Content-Type", ""),
+                collection=q.get("collection", ""),
+                replication=q.get("replication", ""),
+                ttl=q.get("ttl", ""),
+                extended=extended,
+            )
+        except IsADirectoryError:
+            self._reply_json(409, {"error": f"{path} is a directory"})
+            return
         self._reply_json(
             201,
             {
@@ -419,7 +413,4 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except OSError as e:
             self._reply_json(409, {"error": str(e)})
             return
-        # 204 must carry no body (RFC 9110) or keep-alive clients desync
-        self.send_response(204)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        self.send_reply(204)

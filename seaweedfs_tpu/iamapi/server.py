@@ -1,0 +1,245 @@
+"""IAM query API server — weed/iamapi/iamapi_handlers.go analog [VERIFY:
+mount empty; SURVEY.md §2.1]. AWS IAM protocol subset: ListUsers,
+GetUser, CreateUser, DeleteUser, CreateAccessKey, DeleteAccessKey,
+PutUserPolicy (policy statements mapped onto the gateway's action list,
+as the reference's iamapi does).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from seaweedfs_tpu.filer.client import FilerClient
+from seaweedfs_tpu.s3api.auth import (
+    Iam,
+    Identity,
+    load_identities,
+    save_identities,
+)
+from seaweedfs_tpu.utils import httpd
+
+_MUTATING = {
+    "CreateUser",
+    "DeleteUser",
+    "CreateAccessKey",
+    "DeleteAccessKey",
+    "PutUserPolicy",
+}
+
+
+# policy Action string -> gateway action (auth_credentials.go mapping)
+_POLICY_ACTIONS = {
+    "s3:*": "Admin",
+    "s3:GetObject": "Read",
+    "s3:PutObject": "Write",
+    "s3:ListBucket": "List",
+    "s3:ListAllMyBuckets": "List",
+    "s3:DeleteObject": "Write",
+}
+
+
+class IamApiServer:
+    def __init__(
+        self,
+        filer_grpc_address: str,
+        iam: Optional[Iam] = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.filer = FilerClient(filer_grpc_address)
+        self.iam = iam if iam is not None else (load_identities(self.filer) or Iam())
+        self.host = host
+        self.lock = threading.Lock()  # identities list is shared state
+        self._http = _ThreadingHTTPServer((host, port), _Handler)
+        self._http.iam_server = self
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self.filer.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def persist(self) -> None:
+        save_identities(self.filer, self.iam)
+
+
+class _ThreadingHTTPServer(httpd.ThreadingHTTPServer):
+    iam_server: "IamApiServer"
+
+
+def _resp(action: str, inner: Optional[ET.Element] = None) -> bytes:
+    root = ET.Element(f"{action}Response")
+    root.set("xmlns", "https://iam.amazonaws.com/doc/2010-05-08/")
+    if inner is not None:
+        result = ET.SubElement(root, f"{action}Result")
+        result.append(inner)
+    meta = ET.SubElement(root, "ResponseMetadata")
+    rid = ET.SubElement(meta, "RequestId")
+    rid.text = uuid.uuid4().hex
+    return b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+def _error(code: int, iam_code: str, msg: str = "") -> tuple[int, bytes]:
+    root = ET.Element("ErrorResponse")
+    err = ET.SubElement(root, "Error")
+    ET.SubElement(err, "Code").text = iam_code
+    ET.SubElement(err, "Message").text = msg or iam_code
+    return code, b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+class _Handler(httpd.QuietHandler):
+    @property
+    def srv(self) -> IamApiServer:
+        return self.server.iam_server
+
+    def do_POST(self):
+        raw = self.read_body()
+        if raw is None:
+            self.reply_length_required()
+            return
+        form = {
+            k: v[0] for k, v in urllib.parse.parse_qs(raw.decode()).items()
+        }
+        action = form.get("Action", "")
+        handler = getattr(self, f"_do_{action}", None)
+        if handler is None:
+            code, body = _error(400, "InvalidAction", action)
+        else:
+            with self.srv.lock:
+                code, body = handler(form)
+                if code == 200 and action in _MUTATING:
+                    self.srv.persist()
+        self.send_reply(code, body, "text/xml")
+
+    # -- actions --------------------------------------------------------------
+
+    def _find_by_name(self, name: str) -> list[Identity]:
+        return [i for i in self.srv.iam.identities if i.name == name]
+
+    def _do_ListUsers(self, form):
+        users = ET.Element("Users")
+        seen = set()
+        for i in self.srv.iam.identities:
+            if i.name in seen:
+                continue
+            seen.add(i.name)
+            m = ET.SubElement(users, "member")
+            ET.SubElement(m, "UserName").text = i.name
+        return 200, _resp("ListUsers", users)
+
+    def _do_GetUser(self, form):
+        name = form.get("UserName", "")
+        if not self._find_by_name(name):
+            return _error(404, "NoSuchEntity", name)
+        user = ET.Element("User")
+        ET.SubElement(user, "UserName").text = name
+        return 200, _resp("GetUser", user)
+
+    def _do_CreateUser(self, form):
+        name = form.get("UserName", "")
+        if not name:
+            return _error(400, "InvalidInput")
+        if self._find_by_name(name):
+            return _error(409, "EntityAlreadyExists", name)
+        self.srv.iam.identities.append(Identity(name, "", "", []))
+        user = ET.Element("User")
+        ET.SubElement(user, "UserName").text = name
+        return 200, _resp("CreateUser", user)
+
+    def _do_DeleteUser(self, form):
+        name = form.get("UserName", "")
+        if not self._find_by_name(name):
+            return _error(404, "NoSuchEntity", name)
+        self.srv.iam.identities = [
+            i for i in self.srv.iam.identities if i.name != name
+        ]
+        return 200, _resp("DeleteUser")
+
+    def _do_CreateAccessKey(self, form):
+        name = form.get("UserName", "")
+        matches = self._find_by_name(name)
+        access_key = "AKID" + secrets.token_hex(8)
+        secret_key = secrets.token_urlsafe(24)
+        if matches and not matches[0].access_key:
+            # fill the empty credential slot created by CreateUser
+            matches[0].access_key = access_key
+            matches[0].secret_key = secret_key
+        else:
+            actions = matches[0].actions if matches else []
+            self.srv.iam.identities.append(
+                Identity(name or access_key, access_key, secret_key, list(actions))
+            )
+        ak = ET.Element("AccessKey")
+        ET.SubElement(ak, "UserName").text = name
+        ET.SubElement(ak, "AccessKeyId").text = access_key
+        ET.SubElement(ak, "SecretAccessKey").text = secret_key
+        ET.SubElement(ak, "Status").text = "Active"
+        return 200, _resp("CreateAccessKey", ak)
+
+    def _do_DeleteAccessKey(self, form):
+        key = form.get("AccessKeyId", "")
+        # revoke the credential but keep the user (AWS semantics)
+        for i in self.srv.iam.identities:
+            if i.access_key == key:
+                i.access_key = ""
+                i.secret_key = ""
+        return 200, _resp("DeleteAccessKey")
+
+    def _do_PutUserPolicy(self, form):
+        name = form.get("UserName", "")
+        matches = self._find_by_name(name)
+        if not matches:
+            return _error(404, "NoSuchEntity", name)
+        try:
+            doc = json.loads(form.get("PolicyDocument", "{}"))
+        except ValueError:
+            return _error(400, "MalformedPolicyDocument")
+        actions: list[str] = []
+        for st in doc.get("Statement", []):
+            if st.get("Effect") != "Allow":
+                continue
+            acts = st.get("Action", [])
+            if isinstance(acts, str):
+                acts = [acts]
+            resources = st.get("Resource", [])
+            if isinstance(resources, str):
+                resources = [resources]
+            buckets = set()
+            for r in resources:
+                # arn:aws:s3:::bucket/key or *
+                tail = r.rsplit(":::", 1)[-1]
+                bucket = tail.split("/", 1)[0]
+                if bucket and bucket != "*":
+                    buckets.add(bucket)
+            for a in acts:
+                mapped = _POLICY_ACTIONS.get(a)
+                if mapped is None:
+                    continue
+                if buckets:
+                    actions.extend(f"{mapped}:{b}" for b in sorted(buckets))
+                else:
+                    actions.append(mapped)
+        for i in matches:
+            i.actions = sorted(set(actions))
+        return 200, _resp("PutUserPolicy")

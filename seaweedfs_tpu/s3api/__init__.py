@@ -1,0 +1,19 @@
+"""S3 gateway — S3-compatible REST API over the filer, mirror of
+weed/s3api/ [VERIFY: mount empty; SURVEY.md §2.1 "S3 gateway" row, §1 L6].
+
+  auth.py   — AWS Signature V4 verification + identity/action access
+              control (s3api/auth_credentials.go, auth_signature_v4.go)
+  server.py — S3ApiServer: bucket/object/multipart REST handlers
+              (s3api/s3api_server.go, s3api_bucket_handlers.go,
+              s3api_object_handlers.go, filer_multipart.go)
+
+Buckets live under /buckets/<name> in the filer namespace, as in the
+reference. Object data flows through the filer HTTP API (which chunks to
+the volume tier); metadata ops (listings, multipart assembly by
+chunk-list splicing) go over the filer RPC service.
+"""
+
+from seaweedfs_tpu.s3api.auth import Iam, Identity, sign_request
+from seaweedfs_tpu.s3api.server import S3ApiServer
+
+__all__ = ["Iam", "Identity", "sign_request", "S3ApiServer"]

@@ -1,0 +1,684 @@
+"""S3ApiServer — bucket/object/multipart REST handlers over the filer,
+mirror of weed/s3api/s3api_server.go, s3api_bucket_handlers.go,
+s3api_object_handlers.go, s3api_object_handlers_multipart.go,
+filer_multipart.go [VERIFY: mount empty; SURVEY.md §2.1 "S3 gateway"].
+
+Wire layout matches the reference: buckets are filer directories under
+/buckets/<name>; multipart uploads stage parts under
+/buckets/.uploads/<bucket>/<uploadId>/ and Complete splices the parts'
+chunk lists into the final entry WITHOUT copying data (the reference
+does the same chunk-list surgery in filer_multipart.go).
+
+Data plane: proxied through the filer HTTP API (chunking to the volume
+tier happens there). Metadata plane: filer RPC.
+
+Supported: ListBuckets, Create/Delete/HeadBucket, ListObjectsV1/V2
+(prefix, delimiter, marker/continuation, max-keys), Put/Get/Head/Delete
+Object (+Range), CopyObject, DeleteObjects (bulk XML), multipart
+lifecycle (initiate/uploadPart/complete/abort/listParts), SigV4 auth.
+
+Listing order note: keys stream in directory-DFS order (names sorted per
+directory), which differs from strict full-key lexicographic order only
+when a sibling name extends a directory name with a byte < '/'.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Iterator, Optional
+
+from seaweedfs_tpu import stats
+from seaweedfs_tpu.filer.client import FilerClient
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.s3api.auth import (
+    ACTION_LIST,
+    ACTION_READ,
+    ACTION_WRITE,
+    ACTION_ADMIN,
+    Iam,
+    load_identities,
+)
+from seaweedfs_tpu.utils import httpd
+
+BUCKETS_ROOT = "/buckets"
+UPLOADS_ROOT = "/buckets/.uploads"
+_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+class S3ApiServer:
+    def __init__(
+        self,
+        filer_http_address: str,
+        filer_grpc_address: str,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        iam: Optional[Iam] = None,
+    ):
+        self.filer_http = filer_http_address
+        self.filer = FilerClient(filer_grpc_address)
+        self.iam = iam or Iam()
+        self.host = host
+        self._http = _ThreadingHTTPServer((host, port), _Handler)
+        self._http.s3_server = self
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        # ensure the buckets root exists
+        from seaweedfs_tpu.filer.entry import Entry as _E
+
+        if self.filer.lookup(BUCKETS_ROOT) is None:
+            self.filer.create(_E(path=BUCKETS_ROOT, is_directory=True))
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self.filer.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- filer helpers --------------------------------------------------------
+
+    def bucket_path(self, bucket: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}"
+
+    def object_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}/{key}"
+
+    def filer_url(self, path: str, query: str = "") -> str:
+        enc = urllib.parse.quote(path)
+        return f"http://{self.filer_http}{enc}" + (f"?{query}" if query else "")
+
+    def walk_keys(self, bucket: str, prefix: str = "") -> Iterator[Entry]:
+        """Yield file entries under the bucket whose key starts with
+        prefix, in directory-DFS order."""
+        root = self.bucket_path(bucket)
+
+        def rec(dir_path: str) -> Iterator[Entry]:
+            start = ""
+            while True:
+                batch = self.filer.list(dir_path, start_from=start, limit=256)
+                if not batch:
+                    return
+                for e in batch:
+                    key = e.path[len(root) + 1 :]
+                    if e.is_directory:
+                        probe = key + "/"
+                        # descend only where the subtree can match prefix
+                        if probe.startswith(prefix) or prefix.startswith(probe):
+                            yield from rec(e.path)
+                    elif key.startswith(prefix):
+                        yield e
+                start = batch[-1].name
+
+        yield from rec(root)
+
+
+# -- HTTP --------------------------------------------------------------------
+
+
+class _ThreadingHTTPServer(httpd.ThreadingHTTPServer):
+    s3_server: "S3ApiServer"
+
+
+def _xml(tag: str, ns: bool = True) -> ET.Element:
+    e = ET.Element(tag)
+    if ns:
+        e.set("xmlns", _XMLNS)
+    return e
+
+
+def _sub(parent: ET.Element, tag: str, text: Optional[str] = None) -> ET.Element:
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = text
+    return e
+
+
+def _render(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+class _Handler(httpd.QuietHandler):
+    @property
+    def s3(self) -> S3ApiServer:
+        return self.server.s3_server
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _parse(self) -> tuple[str, str, dict]:
+        u = urllib.parse.urlparse(self.path)
+        parts = urllib.parse.unquote(u.path).lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(u.query, keep_blank_values=True).items()}
+        return bucket, key, q
+
+    def _body(self) -> Optional[bytes]:
+        body = self.read_body()
+        if body is None:
+            self.reply_length_required()
+        return body
+
+    def _reply(self, code: int, body: bytes = b"", ctype="application/xml", headers=None):
+        self.send_reply(code, body, ctype, headers=headers)
+
+    def _error(self, code: int, s3_code: str, message: str = ""):
+        root = _xml("Error", ns=False)
+        _sub(root, "Code", s3_code)
+        _sub(root, "Message", message or s3_code)
+        self._reply(code, _render(root))
+
+    def _auth(self, action: str, bucket: str, payload: bytes) -> bool:
+        u = urllib.parse.urlparse(self.path)
+        headers = {k.lower(): v for k, v in self.headers.items()}
+        path = urllib.parse.unquote(u.path) or "/"
+        identity, err = self.s3.iam.authenticate(
+            self.command, path, u.query, headers, payload
+        )
+        if identity is None and err == "InvalidAccessKeyId":
+            # the IAM API may have minted new credentials since start:
+            # reload the persisted identity set once and retry
+            fresh = load_identities(self.s3.filer)
+            if fresh is not None and fresh.identities:
+                self.s3.iam.identities = fresh.identities
+                identity, err = self.s3.iam.authenticate(
+                    self.command, path, u.query, headers, payload
+                )
+        if identity is None:
+            self._error(403, err)
+            return False
+        if not identity.can_do(action, bucket):
+            self._error(403, "AccessDenied", f"no {action} on {bucket}")
+            return False
+        return True
+
+    # -- dispatch -------------------------------------------------------------
+
+    def do_GET(self):
+        bucket, key, q = self._parse()
+        if not bucket:
+            stats.S3RequestCounter.labels("ListBuckets").inc()
+            if self._auth(ACTION_LIST, "", b""):
+                self._list_buckets()
+            return
+        if not key:
+            if "uploadId" in q:
+                self._error(404, "NoSuchUpload")
+                return
+            stats.S3RequestCounter.labels("ListObjects").inc()
+            if self._auth(ACTION_LIST, bucket, b""):
+                self._list_objects(bucket, q)
+            return
+        if "uploadId" in q:
+            stats.S3RequestCounter.labels("ListParts").inc()
+            if self._auth(ACTION_READ, bucket, b""):
+                self._list_parts(bucket, key, q["uploadId"])
+            return
+        stats.S3RequestCounter.labels("GetObject").inc()
+        if self._auth(ACTION_READ, bucket, b""):
+            self._get_object(bucket, key, head=False)
+
+    def do_HEAD(self):
+        bucket, key, q = self._parse()
+        if not key:
+            if self._auth(ACTION_READ, bucket, b""):
+                if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+                    self._reply(404)
+                else:
+                    self._reply(200)
+            return
+        if self._auth(ACTION_READ, bucket, b""):
+            self._get_object(bucket, key, head=True)
+
+    def do_PUT(self):
+        bucket, key, q = self._parse()
+        body = self._body()
+        if body is None:
+            return
+        if not key:
+            stats.S3RequestCounter.labels("CreateBucket").inc()
+            if self._auth(ACTION_ADMIN, bucket, body):
+                self._create_bucket(bucket)
+            return
+        if "partNumber" in q and "uploadId" in q:
+            stats.S3RequestCounter.labels("UploadPart").inc()
+            if self._auth(ACTION_WRITE, bucket, body):
+                self._upload_part(bucket, key, q, body)
+            return
+        stats.S3RequestCounter.labels("PutObject").inc()
+        if not self._auth(ACTION_WRITE, bucket, body):
+            return
+        src = self.headers.get("x-amz-copy-source", "")
+        if src:
+            self._copy_object(bucket, key, src)
+        else:
+            self._put_object(bucket, key, body)
+
+    def do_POST(self):
+        bucket, key, q = self._parse()
+        body = self._body()
+        if body is None:
+            return
+        if not key and "delete" in q:
+            stats.S3RequestCounter.labels("DeleteObjects").inc()
+            if self._auth(ACTION_WRITE, bucket, body):
+                self._delete_objects(bucket, body)
+            return
+        if key and "uploads" in q:
+            stats.S3RequestCounter.labels("CreateMultipartUpload").inc()
+            if self._auth(ACTION_WRITE, bucket, body):
+                self._initiate_multipart(bucket, key)
+            return
+        if key and "uploadId" in q:
+            stats.S3RequestCounter.labels("CompleteMultipartUpload").inc()
+            if self._auth(ACTION_WRITE, bucket, body):
+                self._complete_multipart(bucket, key, q["uploadId"], body)
+            return
+        self._error(400, "InvalidRequest")
+
+    def do_DELETE(self):
+        bucket, key, q = self._parse()
+        if not key:
+            stats.S3RequestCounter.labels("DeleteBucket").inc()
+            if self._auth(ACTION_ADMIN, bucket, b""):
+                self._delete_bucket(bucket)
+            return
+        if "uploadId" in q:
+            stats.S3RequestCounter.labels("AbortMultipartUpload").inc()
+            if self._auth(ACTION_WRITE, bucket, b""):
+                self._abort_multipart(bucket, key, q["uploadId"])
+            return
+        stats.S3RequestCounter.labels("DeleteObject").inc()
+        if self._auth(ACTION_WRITE, bucket, b""):
+            self._delete_object(bucket, key)
+
+    # -- buckets --------------------------------------------------------------
+
+    def _list_buckets(self):
+        root = _xml("ListAllMyBucketsResult")
+        owner = _sub(root, "Owner")
+        _sub(owner, "ID", "weedtpu")
+        buckets = _sub(root, "Buckets")
+        for e in self.s3.filer.list(BUCKETS_ROOT, limit=10000):
+            if not e.is_directory or e.name.startswith("."):
+                continue
+            b = _sub(buckets, "Bucket")
+            _sub(b, "Name", e.name)
+            _sub(b, "CreationDate", _iso(e.attributes.crtime))
+        self._reply(200, _render(root))
+
+    def _create_bucket(self, bucket):
+        from seaweedfs_tpu.filer.entry import Entry as _E
+
+        if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is not None:
+            self._error(409, "BucketAlreadyExists")
+            return
+        self.s3.filer.create(_E(path=self.s3.bucket_path(bucket), is_directory=True))
+        self._reply(200, headers={"Location": f"/{bucket}"})
+
+    def _delete_bucket(self, bucket):
+        path = self.s3.bucket_path(bucket)
+        if self.s3.filer.lookup(path) is None:
+            self._error(404, "NoSuchBucket")
+            return
+        if self.s3.filer.list(path, limit=1):
+            self._error(409, "BucketNotEmpty")
+            return
+        self.s3.filer.delete(path, recursive=True)
+        self._reply(204)
+
+    # -- listing --------------------------------------------------------------
+
+    def _list_objects(self, bucket, q):
+        if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+            self._error(404, "NoSuchBucket")
+            return
+        v2 = q.get("list-type") == "2"
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = httpd.safe_int(q.get("max-keys"), 1000)
+        after = q.get("start-after", "") or q.get("marker", "")
+        token = q.get("continuation-token", "")
+        if token:
+            after = token
+
+        contents: list[Entry] = []
+        common: list[str] = []
+        seen_common = set()
+        truncated = False
+        next_after = ""
+        for e in self.s3.walk_keys(bucket, prefix):
+            key = e.path[len(self.s3.bucket_path(bucket)) + 1 :]
+            if after and key <= after:
+                continue
+            if delimiter:
+                rest = key[len(prefix) :]
+                d = rest.find(delimiter)
+                if d >= 0:
+                    cp = prefix + rest[: d + len(delimiter)]
+                    if cp not in seen_common:
+                        if len(contents) + len(seen_common) >= max_keys:
+                            truncated = True
+                            break
+                        seen_common.add(cp)
+                        common.append(cp)
+                        next_after = key
+                    continue
+            if len(contents) + len(seen_common) >= max_keys:
+                truncated = True
+                break
+            contents.append(e)
+            next_after = key
+
+        root = _xml("ListBucketResult")
+        _sub(root, "Name", bucket)
+        _sub(root, "Prefix", prefix)
+        _sub(root, "MaxKeys", str(max_keys))
+        _sub(root, "IsTruncated", "true" if truncated else "false")
+        if delimiter:
+            _sub(root, "Delimiter", delimiter)
+        if v2:
+            _sub(root, "KeyCount", str(len(contents) + len(common)))
+            if truncated:
+                _sub(root, "NextContinuationToken", next_after)
+        elif truncated:
+            _sub(root, "NextMarker", next_after)
+        for e in contents:
+            key = e.path[len(self.s3.bucket_path(bucket)) + 1 :]
+            c = _sub(root, "Contents")
+            _sub(c, "Key", key)
+            _sub(c, "LastModified", _iso(e.attributes.mtime))
+            _sub(c, "ETag", f'"{e.attributes.md5 or ""}"')
+            _sub(c, "Size", str(e.size))
+            _sub(c, "StorageClass", "STANDARD")
+        for cp in common:
+            p = _sub(root, "CommonPrefixes")
+            _sub(p, "Prefix", cp)
+        self._reply(200, _render(root))
+
+    # -- objects --------------------------------------------------------------
+
+    def _put_object(self, bucket, key, body):
+        if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+            self._error(404, "NoSuchBucket")
+            return
+        headers = {
+            "Content-Type": self.headers.get("Content-Type", "application/octet-stream")
+        }
+        for k, v in self.headers.items():
+            if k.lower().startswith("x-amz-meta-"):
+                headers[k] = v
+        req = urllib.request.Request(
+            self.s3.filer_url(self.s3.object_path(bucket, key)),
+            data=body,
+            method="PUT",
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                meta = json.loads(r.read())
+        except urllib.error.URLError as e:
+            self._error(500, "InternalError", str(e))
+            return
+        self._reply(200, headers={"ETag": f'"{meta.get("etag", "")}"'})
+
+    def _get_object(self, bucket, key, head: bool):
+        entry = self.s3.filer.lookup(self.s3.object_path(bucket, key))
+        if entry is None or entry.is_directory:
+            if head:
+                self._reply(404)
+            else:
+                self._error(404, "NoSuchKey", key)
+            return
+        fwd = {}
+        rng = self.headers.get("Range", "")
+        if rng and not head:
+            fwd["Range"] = rng
+        req = urllib.request.Request(
+            self.s3.filer_url(self.s3.object_path(bucket, key)),
+            headers=fwd,
+            method="HEAD" if head else "GET",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = b"" if head else r.read()
+                out_headers = {
+                    "ETag": r.headers.get("ETag", ""),
+                    "Last-Modified": r.headers.get("Last-Modified", ""),
+                    "Accept-Ranges": "bytes",
+                }
+                for k, v in r.headers.items():
+                    if k.lower().startswith("x-amz-meta-"):
+                        out_headers[k] = v
+                if r.headers.get("Content-Range"):
+                    out_headers["Content-Range"] = r.headers["Content-Range"]
+                if head:
+                    out_headers["Content-Length"] = r.headers.get("Content-Length", "0")
+                    self.send_response(r.status)
+                    self.send_header(
+                        "Content-Type", r.headers.get("Content-Type", "application/octet-stream")
+                    )
+                    for k, v in out_headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    return
+                self._reply(
+                    r.status,
+                    body,
+                    r.headers.get("Content-Type", "application/octet-stream"),
+                    headers=out_headers,
+                )
+        except urllib.error.HTTPError as e:
+            if e.code == 416:
+                self._error(416, "InvalidRange")
+            else:
+                self._error(404, "NoSuchKey", key)
+
+    def _copy_object(self, bucket, key, src):
+        src = urllib.parse.unquote(src)
+        if src.startswith("/"):
+            src = src[1:]
+        s_bucket, _, s_key = src.partition("/")
+        s_entry = self.s3.filer.lookup(self.s3.object_path(s_bucket, s_key))
+        if s_entry is None:
+            self._error(404, "NoSuchKey", src)
+            return
+        # stream through the filer: read source, write dest (fresh needles,
+        # so source delete can never orphan the copy)
+        try:
+            with urllib.request.urlopen(
+                self.s3.filer_url(self.s3.object_path(s_bucket, s_key)), timeout=60
+            ) as r:
+                data = r.read()
+                ctype = r.headers.get("Content-Type", "application/octet-stream")
+        except urllib.error.URLError as e:
+            self._error(500, "InternalError", str(e))
+            return
+        req = urllib.request.Request(
+            self.s3.filer_url(self.s3.object_path(bucket, key)),
+            data=data,
+            method="PUT",
+            headers={"Content-Type": ctype},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            meta = json.loads(r.read())
+        root = _xml("CopyObjectResult")
+        _sub(root, "ETag", f'"{meta.get("etag", "")}"')
+        _sub(root, "LastModified", _iso(time.time()))
+        self._reply(200, _render(root))
+
+    def _delete_object(self, bucket, key):
+        try:
+            self.s3.filer.delete(self.s3.object_path(bucket, key))
+        except Exception:  # noqa: BLE001 — S3 delete is idempotent
+            pass
+        self._reply(204)
+
+    def _delete_objects(self, bucket, body):
+        try:
+            tree = ET.fromstring(body)
+        except ET.ParseError:
+            self._error(400, "MalformedXML")
+            return
+        ns = ""
+        if tree.tag.startswith("{"):
+            ns = tree.tag[: tree.tag.index("}") + 1]
+        root = _xml("DeleteResult")
+        for obj in tree.findall(f"{ns}Object"):
+            key_el = obj.find(f"{ns}Key")
+            if key_el is None or not key_el.text:
+                continue
+            try:
+                self.s3.filer.delete(self.s3.object_path(bucket, key_el.text))
+            except Exception:  # noqa: BLE001
+                pass
+            d = _sub(root, "Deleted")
+            _sub(d, "Key", key_el.text)
+        self._reply(200, _render(root))
+
+    # -- multipart ------------------------------------------------------------
+
+    def _upload_dir(self, bucket, upload_id):
+        return f"{UPLOADS_ROOT}/{bucket}/{upload_id}"
+
+    def _initiate_multipart(self, bucket, key):
+        from seaweedfs_tpu.filer.entry import Entry as _E
+
+        upload_id = uuid.uuid4().hex
+        meta = {
+            "key": key,
+            "content_type": self.headers.get("Content-Type", "application/octet-stream"),
+            **{
+                k.lower(): v
+                for k, v in self.headers.items()
+                if k.lower().startswith("x-amz-meta-")
+            },
+        }
+        e = _E(path=self._upload_dir(bucket, upload_id), is_directory=True)
+        e.extended = {"s3": json.dumps(meta)}
+        self.s3.filer.create(e)
+        root = _xml("InitiateMultipartUploadResult")
+        _sub(root, "Bucket", bucket)
+        _sub(root, "Key", key)
+        _sub(root, "UploadId", upload_id)
+        self._reply(200, _render(root))
+
+    def _upload_part(self, bucket, key, q, body):
+        part = httpd.safe_int(q.get("partNumber"), -1)
+        if not 1 <= part <= 10000:
+            self._error(400, "InvalidArgument", "bad partNumber")
+            return
+        upload_id = q["uploadId"]
+        if self.s3.filer.lookup(self._upload_dir(bucket, upload_id)) is None:
+            self._error(404, "NoSuchUpload")
+            return
+        path = f"{self._upload_dir(bucket, upload_id)}/part{part:05d}"
+        req = urllib.request.Request(
+            self.s3.filer_url(path), data=body, method="PUT"
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            meta = json.loads(r.read())
+        self._reply(200, headers={"ETag": f'"{meta.get("etag", "")}"'})
+
+    def _list_parts(self, bucket, key, upload_id):
+        d = self._upload_dir(bucket, upload_id)
+        if self.s3.filer.lookup(d) is None:
+            self._error(404, "NoSuchUpload")
+            return
+        root = _xml("ListPartsResult")
+        _sub(root, "Bucket", bucket)
+        _sub(root, "Key", key)
+        _sub(root, "UploadId", upload_id)
+        for e in self.s3.filer.list(d, limit=10000):
+            p = _sub(root, "Part")
+            _sub(p, "PartNumber", str(int(e.name[4:])))
+            _sub(p, "ETag", f'"{e.attributes.md5}"')
+            _sub(p, "Size", str(e.size))
+            _sub(p, "LastModified", _iso(e.attributes.mtime))
+        self._reply(200, _render(root))
+
+    def _complete_multipart(self, bucket, key, upload_id, body):
+        from seaweedfs_tpu.filer.entry import Attributes, Entry as _E, FileChunk
+
+        d = self._upload_dir(bucket, upload_id)
+        dir_entry = self.s3.filer.lookup(d)
+        if dir_entry is None:
+            self._error(404, "NoSuchUpload")
+            return
+        parts = sorted(
+            (e for e in self.s3.filer.list(d, limit=10000) if e.name.startswith("part")),
+            key=lambda e: e.name,
+        )
+        if not parts:
+            self._error(400, "InvalidPart")
+            return
+        # splice part chunk lists; no data copy (filer_multipart.go pattern)
+        chunks: list[FileChunk] = []
+        offset = 0
+        etag_md5 = hashlib.md5()
+        for p in parts:
+            for c in sorted(p.chunks, key=lambda c: c.offset):
+                chunks.append(
+                    FileChunk(
+                        fid=c.fid,
+                        offset=offset + c.offset,
+                        size=c.size,
+                        mtime_ns=c.mtime_ns,
+                        etag=c.etag,
+                        is_chunk_manifest=c.is_chunk_manifest,
+                    )
+                )
+            offset += p.size
+            etag_md5.update(bytes.fromhex(p.attributes.md5))
+        meta = json.loads(dir_entry.extended.get("s3", "{}"))
+        etag = f"{etag_md5.hexdigest()}-{len(parts)}"
+        entry = _E(
+            path=self.s3.object_path(bucket, key),
+            attributes=Attributes(
+                mtime=time.time(),
+                mime=meta.get("content_type", "application/octet-stream"),
+                md5=etag,
+                file_size=offset,
+            ),
+            chunks=chunks,
+            extended={k: v for k, v in meta.items() if k.startswith("x-amz-meta-")},
+        )
+        self.s3.filer.create(entry)
+        # drop the staging entries but keep the needles (now owned by the
+        # final object)
+        self.s3.filer.delete(d, recursive=True, delete_data=False)
+        root = _xml("CompleteMultipartUploadResult")
+        _sub(root, "Location", f"http://{self.s3.url}/{bucket}/{key}")
+        _sub(root, "Bucket", bucket)
+        _sub(root, "Key", key)
+        _sub(root, "ETag", f'"{etag}"')
+        self._reply(200, _render(root))
+
+    def _abort_multipart(self, bucket, key, upload_id):
+        d = self._upload_dir(bucket, upload_id)
+        if self.s3.filer.lookup(d) is not None:
+            self.s3.filer.delete(d, recursive=True)
+        self._reply(204)

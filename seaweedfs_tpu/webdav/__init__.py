@@ -1,0 +1,12 @@
+"""WebDAV gateway — mirror of weed/server/webdav_server.go (golang.org/x/
+net/webdav backed by the filer) [VERIFY: mount empty; SURVEY.md §2.1
+"Gateways" L6 row: "S3 REST, POSIX/FUSE, WebDAV"].
+
+Class-1 WebDAV on the filer namespace: OPTIONS, PROPFIND (Depth 0/1),
+MKCOL, GET/HEAD/PUT/DELETE, MOVE, COPY. Data flows through the filer
+HTTP API; namespace ops over filer RPC.
+"""
+
+from seaweedfs_tpu.webdav.server import WebDavServer
+
+__all__ = ["WebDavServer"]

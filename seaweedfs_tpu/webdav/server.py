@@ -1,0 +1,273 @@
+"""WebDAV server on the filer — weed/server/webdav_server.go analog
+[VERIFY: mount empty; SURVEY.md §2.1 "Gateways"]. See package docstring
+for the supported method set."""
+
+from __future__ import annotations
+
+import posixpath
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from seaweedfs_tpu.filer.client import FilerClient
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.utils import httpd
+
+_DAV = "DAV:"
+
+
+class WebDavServer:
+    def __init__(
+        self,
+        filer_http_address: str,
+        filer_grpc_address: str,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        root: str = "/",
+    ):
+        self.filer_http = filer_http_address
+        self.filer = FilerClient(filer_grpc_address)
+        self.root = root.rstrip("/") or ""
+        self.host = host
+        self._http = _ThreadingHTTPServer((host, port), _Handler)
+        self._http.dav_server = self
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self.filer.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def fpath(self, dav_path: str) -> str:
+        p = posixpath.normpath("/" + dav_path.lstrip("/"))
+        return (self.root + p) if p != "/" else (self.root or "/")
+
+    def filer_url(self, path: str) -> str:
+        return f"http://{self.filer_http}{urllib.parse.quote(path)}"
+
+
+class _ThreadingHTTPServer(httpd.ThreadingHTTPServer):
+    dav_server: "WebDavServer"
+
+
+def _http_date(ts: float) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+class _Handler(httpd.QuietHandler):
+    @property
+    def dav(self) -> WebDavServer:
+        return self.server.dav_server
+
+    def _path(self) -> str:
+        return urllib.parse.unquote(urllib.parse.urlparse(self.path).path) or "/"
+
+    def _reply(self, code: int, body: bytes = b"", ctype="text/xml; charset=utf-8", headers=None):
+        self.send_reply(code, body, ctype, headers=headers)
+
+    # -- methods --------------------------------------------------------------
+
+    def do_OPTIONS(self):
+        self._reply(
+            200,
+            headers={
+                "DAV": "1,2",
+                "Allow": "OPTIONS, PROPFIND, MKCOL, GET, HEAD, PUT, DELETE, MOVE, COPY",
+                "MS-Author-Via": "DAV",
+            },
+        )
+
+    def _prop_response(self, ms: ET.Element, dav_path: str, entry: Entry) -> None:
+        resp = ET.SubElement(ms, f"{{{_DAV}}}response")
+        href = ET.SubElement(resp, f"{{{_DAV}}}href")
+        href.text = urllib.parse.quote(dav_path + ("/" if entry.is_directory and dav_path != "/" else ""))
+        propstat = ET.SubElement(resp, f"{{{_DAV}}}propstat")
+        prop = ET.SubElement(propstat, f"{{{_DAV}}}prop")
+        ET.SubElement(prop, f"{{{_DAV}}}displayname").text = (
+            posixpath.basename(dav_path) or "/"
+        )
+        ET.SubElement(prop, f"{{{_DAV}}}getlastmodified").text = _http_date(
+            entry.attributes.mtime
+        )
+        rt = ET.SubElement(prop, f"{{{_DAV}}}resourcetype")
+        if entry.is_directory:
+            ET.SubElement(rt, f"{{{_DAV}}}collection")
+        else:
+            ET.SubElement(prop, f"{{{_DAV}}}getcontentlength").text = str(entry.size)
+            ET.SubElement(prop, f"{{{_DAV}}}getcontenttype").text = (
+                entry.attributes.mime or "application/octet-stream"
+            )
+        status = ET.SubElement(propstat, f"{{{_DAV}}}status")
+        status.text = "HTTP/1.1 200 OK"
+
+    def do_PROPFIND(self):
+        self.read_body()  # drain; we return the standard prop set regardless
+        dav_path = self._path()
+        fpath = self.dav.fpath(dav_path)
+        entry = self.dav.filer.lookup(fpath)
+        if entry is None:
+            self._reply(404)
+            return
+        depth = self.headers.get("Depth", "1")
+        ET.register_namespace("D", _DAV)
+        ms = ET.Element(f"{{{_DAV}}}multistatus")
+        self._prop_response(ms, dav_path, entry)
+        if entry.is_directory and depth != "0":
+            for child in self.dav.filer.list(fpath, limit=10000):
+                self._prop_response(
+                    ms, posixpath.join(dav_path, child.name), child
+                )
+        body = b'<?xml version="1.0" encoding="utf-8"?>\n' + ET.tostring(ms)
+        self._reply(207, body)
+
+    def do_MKCOL(self):
+        fpath = self.dav.fpath(self._path())
+        if self.dav.filer.lookup(fpath) is not None:
+            self._reply(405)
+            return
+        self.dav.filer.create(Entry(path=fpath, is_directory=True))
+        self._reply(201)
+
+    def _serve_get(self, head: bool):
+        fpath = self.dav.fpath(self._path())
+        entry = self.dav.filer.lookup(fpath)
+        if entry is None:
+            self._reply(404)
+            return
+        if entry.is_directory:
+            self._reply(405)
+            return
+        if head:
+            self._reply(
+                200,
+                headers={
+                    "Content-Length": str(entry.size),
+                    "Last-Modified": _http_date(entry.attributes.mtime),
+                },
+            )
+            return
+        fwd = {}
+        if self.headers.get("Range"):
+            fwd["Range"] = self.headers["Range"]
+        try:
+            req = urllib.request.Request(self.dav.filer_url(fpath), headers=fwd)
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = r.read()
+                headers = {"Last-Modified": r.headers.get("Last-Modified", "")}
+                if r.headers.get("Content-Range"):
+                    headers["Content-Range"] = r.headers["Content-Range"]
+                self._reply(
+                    r.status, body,
+                    r.headers.get("Content-Type", "application/octet-stream"),
+                    headers=headers,
+                )
+        except urllib.error.URLError:
+            self._reply(404)
+
+    def do_GET(self):
+        self._serve_get(head=False)
+
+    def do_HEAD(self):
+        self._serve_get(head=True)
+
+    def do_PUT(self):
+        fpath = self.dav.fpath(self._path())
+        body = self.read_body()
+        if body is None:
+            self.reply_length_required()
+            return
+        req = urllib.request.Request(
+            self.dav.filer_url(fpath),
+            data=body,
+            method="PUT",
+            headers={"Content-Type": self.headers.get("Content-Type", "application/octet-stream")},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+        except urllib.error.URLError as e:
+            self._reply(500, str(e).encode(), "text/plain")
+            return
+        self._reply(201)
+
+    def do_DELETE(self):
+        fpath = self.dav.fpath(self._path())
+        if self.dav.filer.lookup(fpath) is None:
+            self._reply(404)
+            return
+        self.dav.filer.delete(fpath, recursive=True)
+        self._reply(204)
+
+    def _dest_path(self) -> Optional[str]:
+        dest = self.headers.get("Destination", "")
+        if not dest:
+            return None
+        u = urllib.parse.urlparse(dest)
+        return self.dav.fpath(urllib.parse.unquote(u.path))
+
+    def do_MOVE(self):
+        src = self.dav.fpath(self._path())
+        dst = self._dest_path()
+        if dst is None:
+            self._reply(400)
+            return
+        if self.dav.filer.lookup(src) is None:
+            self._reply(404)
+            return
+        overwrote = self.dav.filer.lookup(dst) is not None
+        if overwrote and self.headers.get("Overwrite", "T") == "F":
+            self._reply(412)
+            return
+        self.dav.filer.rename(src, dst)
+        self._reply(204 if overwrote else 201)
+
+    def do_COPY(self):
+        src = self.dav.fpath(self._path())
+        dst = self._dest_path()
+        if dst is None:
+            self._reply(400)
+            return
+        entry = self.dav.filer.lookup(src)
+        if entry is None:
+            self._reply(404)
+            return
+        if entry.is_directory:
+            self._reply(501)  # collection COPY not supported (reference parity gap)
+            return
+        overwrote = self.dav.filer.lookup(dst) is not None
+        if overwrote and self.headers.get("Overwrite", "T") == "F":
+            self._reply(412)
+            return
+        try:
+            with urllib.request.urlopen(self.dav.filer_url(src), timeout=60) as r:
+                data = r.read()
+                ctype = r.headers.get("Content-Type", "application/octet-stream")
+            req = urllib.request.Request(
+                self.dav.filer_url(dst), data=data, method="PUT",
+                headers={"Content-Type": ctype},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+        except urllib.error.URLError as e:
+            self._reply(500, str(e).encode(), "text/plain")
+            return
+        self._reply(204 if overwrote else 201)

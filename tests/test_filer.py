@@ -103,6 +103,17 @@ def test_filer_mkdirs_create_delete_rename():
     assert len(evs) >= 5
 
 
+def test_filer_refuses_file_over_directory():
+    f = Filer(MemoryStore())
+    f.create_entry(Entry(path="/d/child"))
+    with pytest.raises(IsADirectoryError):
+        f.create_entry(Entry(path="/d"))
+    f.create_entry(Entry(path="/plain"))
+    with pytest.raises(IsADirectoryError):
+        f.rename("/plain", "/d")
+    assert f.exists("/d/child")
+
+
 def test_filer_rename_subtree():
     f = Filer(MemoryStore())
     for p in ("/src/a/f1", "/src/a/f2", "/src/f3"):

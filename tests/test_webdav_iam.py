@@ -1,0 +1,144 @@
+"""WebDAV + IAM gateway tests over a real loopback stack (SURVEY.md §4
+in-process integration pattern)."""
+
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.iamapi import IamApiServer, load_identities
+from seaweedfs_tpu.s3api import Iam
+from seaweedfs_tpu.webdav import WebDavServer
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("davstack")
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    (tmp / "vol").mkdir()
+    vs = VolumeServer([str(tmp / "vol")], master.address, heartbeat_interval=0.4)
+    vs.start()
+    fs = FilerServer(master.address)
+    fs.start()
+    dav = WebDavServer(fs.url, fs.grpc_address)
+    dav.start()
+    iam = IamApiServer(fs.grpc_address, iam=Iam([]))
+    iam.start()
+    yield fs, dav, iam
+    iam.stop()
+    dav.stop()
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _req(base, method, path, body=None, headers=None):
+    req = urllib.request.Request(
+        base + path, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def test_webdav_lifecycle(stack):
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    code, headers, _ = _req(base, "OPTIONS", "/")
+    assert code == 200 and "PROPFIND" in headers["Allow"]
+    # MKCOL + PUT + GET
+    assert _req(base, "MKCOL", "/davdir")[0] == 201
+    assert _req(base, "MKCOL", "/davdir")[0] == 405  # exists
+    code, _, _ = _req(base, "PUT", "/davdir/note.txt", b"dav content",
+                      {"Content-Type": "text/plain"})
+    assert code == 201
+    code, _, got = _req(base, "GET", "/davdir/note.txt")
+    assert code == 200 and got == b"dav content"
+    code, headers, _ = _req(base, "HEAD", "/davdir/note.txt")
+    assert code == 200 and headers["Content-Length"] == "11"
+    # PROPFIND depth 1 on the collection
+    code, _, body = _req(base, "PROPFIND", "/davdir", headers={"Depth": "1"})
+    assert code == 207
+    ms = ET.fromstring(body)
+    hrefs = [h.text for h in ms.findall(".//{DAV:}href")]
+    assert "/davdir/" in hrefs and "/davdir/note.txt" in hrefs
+    lengths = [e.text for e in ms.findall(".//{DAV:}getcontentlength")]
+    assert "11" in lengths
+    # COPY then MOVE
+    code, _, _ = _req(base, "COPY", "/davdir/note.txt",
+                      headers={"Destination": f"http://{dav.url}/davdir/copy.txt"})
+    assert code == 201
+    code, _, _ = _req(base, "MOVE", "/davdir/copy.txt",
+                      headers={"Destination": f"http://{dav.url}/davdir/moved.txt"})
+    assert code == 201
+    assert _req(base, "GET", "/davdir/moved.txt")[2] == b"dav content"
+    assert _req(base, "GET", "/davdir/copy.txt")[0] == 404
+    # Overwrite: F refuses to clobber
+    code, _, _ = _req(base, "MOVE", "/davdir/moved.txt",
+                      headers={"Destination": f"http://{dav.url}/davdir/note.txt",
+                               "Overwrite": "F"})
+    assert code == 412
+    # DELETE collection
+    assert _req(base, "DELETE", "/davdir")[0] == 204
+    assert _req(base, "PROPFIND", "/davdir")[0] == 404
+
+
+def _iam_call(url, **form):
+    data = urllib.parse.urlencode(form).encode()
+    req = urllib.request.Request(url, data=data, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_iam_user_and_key_lifecycle(stack):
+    fs, _, iam = stack
+    url = f"http://{iam.url}/"
+    code, body = _iam_call(url, Action="CreateUser", UserName="alice")
+    assert code == 200 and b"alice" in body
+    code, body = _iam_call(url, Action="CreateAccessKey", UserName="alice")
+    assert code == 200
+    ns = "{https://iam.amazonaws.com/doc/2010-05-08/}"
+    root = ET.fromstring(body)
+    ak = root.find(f".//{ns}AccessKeyId").text
+    sk = root.find(f".//{ns}SecretAccessKey").text
+    assert ak and sk
+    # policy -> action mapping
+    policy = (
+        '{"Statement": [{"Effect": "Allow", "Action": ["s3:GetObject", '
+        '"s3:ListBucket"], "Resource": "arn:aws:s3:::mybucket/*"}]}'
+    )
+    code, _ = _iam_call(url, Action="PutUserPolicy", UserName="alice",
+                        PolicyDocument=policy)
+    assert code == 200
+    ident = iam.iam.lookup(ak)
+    assert ident is not None
+    assert ident.actions == ["List:mybucket", "Read:mybucket"]
+    assert ident.can_do("Read", "mybucket") and not ident.can_do("Read", "other")
+    # identities persisted to filer kv: reload sees alice
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    with FilerClient(fs.grpc_address) as fc:
+        loaded = load_identities(fc)
+    assert loaded is not None and loaded.lookup(ak) is not None
+    # list/get/delete
+    code, body = _iam_call(url, Action="ListUsers")
+    assert b"alice" in body
+    code, _ = _iam_call(url, Action="DeleteAccessKey", AccessKeyId=ak)
+    assert code == 200
+    code, _ = _iam_call(url, Action="DeleteUser", UserName="alice")
+    assert code == 200
+    code, _ = _iam_call(url, Action="GetUser", UserName="alice")
+    assert code == 404
+    code, _ = _iam_call(url, Action="BogusAction")
+    assert code == 400
