@@ -30,5 +30,6 @@ def commands() -> dict[str, Command]:
     # import for side effect of registration
     from seaweedfs_tpu.command import local  # noqa: F401
     from seaweedfs_tpu.command import servers  # noqa: F401
+    from seaweedfs_tpu.command import sync  # noqa: F401
 
     return dict(_REGISTRY)

@@ -306,6 +306,53 @@ def _iam_run(args: argparse.Namespace) -> int:
 register(Command("iam", "run an AWS-IAM-compatible identity API", _iam_conf, _iam_run))
 
 
+def _mount_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-filerGrpc", default="", help="filer grpc host:port")
+    p.add_argument("-dir", default="", help="mountpoint directory")
+
+
+def _mount_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.mount.fuse_adapter import fuse_available, mount_and_serve
+
+    if not args.filerGrpc or not args.dir:
+        raise SystemExit("-filerGrpc and -dir are required")
+    if not fuse_available():
+        print(
+            "kernel FUSE unavailable (no fusepy//dev/fuse); use the WFS API "
+            "(seaweedfs_tpu.mount.WFS) for in-process access",
+            file=sys.stderr,
+        )
+        return 2
+    mount_and_serve(args.filerGrpc, args.dir)
+    return 0
+
+
+register(Command("mount", "mount the filer as a FUSE filesystem", _mount_conf, _mount_run))
+
+
+def _mq_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-port", type=int, default=17777)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-filerGrpc", default="")
+
+
+def _mq_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.mq import Broker
+
+    if not args.filerGrpc:
+        raise SystemExit("-filerGrpc is required")
+    b = Broker(args.filer, args.filerGrpc, port=args.port, host=args.ip)
+    b.start()
+    print(f"mq broker on {b.address} -> filer {args.filer}")
+    _wait_forever()
+    b.stop()
+    return 0
+
+
+register(Command("mq.broker", "run a message-queue broker on the filer", _mq_conf, _mq_run))
+
+
 def _shell_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-c", dest="script", default="", help="run `;`-separated commands and exit")

@@ -1,0 +1,69 @@
+"""Sync/backup commands — mirror of weed/command/filer_sync.go,
+filer_backup.go [VERIFY: mount empty; SURVEY.md §2.1 "Replication/sync"].
+
+  filer.sync   — continuous one-way replication filer A -> filer B
+  filer.backup — drain pending metadata events into a local directory
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from seaweedfs_tpu.command import Command, register
+
+
+def _sync_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-from", dest="src_grpc", required=True, help="source filer grpc host:port")
+    p.add_argument("-to", dest="dst_http", required=True, help="target filer http host:port")
+    p.add_argument("-prefix", default="/", help="only sync this subtree")
+    p.add_argument("-targetPath", default="/", help="root on the target filer")
+    p.add_argument("-id", default="", help="checkpoint id (default: sink kind)")
+
+
+def _sync_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.replication import FilerSink, Replicator
+
+    sink = FilerSink(args.dst_http, target_root=args.targetPath)
+    rep = Replicator(
+        args.src_grpc, sink, prefix=args.prefix,
+        sink_id=args.id or f"filer.{args.dst_http}",
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            break
+    print(f"filer.sync {args.src_grpc} -> {args.dst_http} (prefix {args.prefix})")
+    rep.run(stop)
+    rep.close()
+    return 0
+
+
+register(Command("filer.sync", "continuously replicate one filer into another", _sync_conf, _sync_run))
+
+
+def _backup_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-filerGrpc", required=True, help="source filer grpc host:port")
+    p.add_argument("-dir", required=True, help="local backup directory")
+    p.add_argument("-prefix", default="/")
+    p.add_argument("-id", default="", help="checkpoint id (default: local.<dir>)")
+
+
+def _backup_run(args: argparse.Namespace) -> int:
+    from seaweedfs_tpu.replication import LocalSink, Replicator
+
+    sink = LocalSink(args.dir)
+    rep = Replicator(
+        args.filerGrpc, sink, prefix=args.prefix,
+        sink_id=args.id or f"local.{args.dir}",
+    )
+    n = rep.run_once()
+    print(f"applied {n} events into {args.dir}")
+    rep.close()
+    return 0
+
+
+register(Command("filer.backup", "apply pending filer events to a local directory", _backup_conf, _backup_run))

@@ -43,7 +43,12 @@ class FilerClient:
         return Entry.from_dict(resp["entry"])
 
     def list(
-        self, directory: str, start_from: str = "", limit: int = 1024, prefix: str = ""
+        self,
+        directory: str,
+        start_from: str = "",
+        limit: int = 1024,
+        prefix: str = "",
+        include_start: bool = False,
     ) -> list[Entry]:
         resp = self._rpc.call(
             FILER_SERVICE,
@@ -51,6 +56,7 @@ class FilerClient:
             {
                 "directory": directory,
                 "start_from": start_from,
+                "inclusive_start_from": include_start,
                 "limit": limit,
                 "prefix": prefix,
             },
@@ -58,9 +64,18 @@ class FilerClient:
         return [Entry.from_dict(d) for d in resp["entries"]]
 
     def create(self, entry: Entry, o_excl: bool = False) -> None:
-        self._rpc.call(
-            FILER_SERVICE, "CreateEntry", {"entry": entry.to_dict(), "o_excl": o_excl}
-        )
+        import grpc as _grpc
+
+        try:
+            self._rpc.call(
+                FILER_SERVICE, "CreateEntry", {"entry": entry.to_dict(), "o_excl": o_excl}
+            )
+        except _grpc.RpcError as e:
+            if e.code() == _grpc.StatusCode.FAILED_PRECONDITION:
+                raise IsADirectoryError(entry.path) from None
+            if e.code() == _grpc.StatusCode.ALREADY_EXISTS:
+                raise FileExistsError(entry.path) from None
+            raise
 
     def update(self, entry: Entry) -> None:
         self._rpc.call(FILER_SERVICE, "UpdateEntry", {"entry": entry.to_dict()})
@@ -75,12 +90,35 @@ class FilerClient:
         )
 
     def rename(self, old_path: str, new_path: str) -> None:
-        self._rpc.call(
-            FILER_SERVICE, "AtomicRenameEntry", {"old_path": old_path, "new_path": new_path}
-        )
+        import grpc as _grpc
+
+        try:
+            self._rpc.call(
+                FILER_SERVICE,
+                "AtomicRenameEntry",
+                {"old_path": old_path, "new_path": new_path},
+            )
+        except _grpc.RpcError as e:
+            if e.code() == _grpc.StatusCode.FAILED_PRECONDITION:
+                raise IsADirectoryError(new_path) from None
+            if e.code() == _grpc.StatusCode.NOT_FOUND:
+                raise FileNotFoundError(old_path) from None
+            raise
 
     def read_file(self, path: str) -> bytes:
         return b"".join(self._rpc.stream(FILER_SERVICE, "ReadFile", {"path": path}))
+
+    def read_range(self, path: str, offset: int, size: int) -> bytes:
+        return b"".join(
+            self._rpc.stream(
+                FILER_SERVICE,
+                "ReadFileRange",
+                {"path": path, "offset": offset, "size": size},
+            )
+        )
+
+    def configuration(self) -> dict:
+        return self._rpc.call(FILER_SERVICE, "GetFilerConfiguration", {})
 
     def kv_get(self, key: str) -> Optional[bytes]:
         import grpc as _grpc

@@ -73,9 +73,17 @@ class Filer:
         store: FilerStore,
         chunk_io: Optional[ChunkIO] = None,
         log_dir: str = "",
+        notification_queue=None,
     ):
         self.store = store
         self.chunk_io = chunk_io
+        self.notification_queue = notification_queue
+        # notifications dispatch off-thread: send_message may do I/O and
+        # _notify runs under the filer lock on every mutation
+        self._notif_buf: deque = deque()
+        self._notif_cv = threading.Condition()
+        self._notif_stop = threading.Event()
+        self._notif_thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
         self._events: deque[MetaEvent] = deque(maxlen=_META_RING)
         self._event_cv = threading.Condition()
@@ -86,7 +94,28 @@ class Filer:
                 os.path.join(log_dir, "filer.meta.log"), "a", encoding="utf-8"
             )
 
+    def _notif_loop(self) -> None:
+        while True:
+            with self._notif_cv:
+                while not self._notif_buf:
+                    if self._notif_stop.is_set():
+                        return
+                    self._notif_cv.wait(0.5)
+                key, ev = self._notif_buf.popleft()
+            q = self.notification_queue
+            if q is not None:
+                try:
+                    q.send_message(key, ev)
+                except Exception:  # noqa: BLE001 — never fail writes for it
+                    pass
+
     def close(self) -> None:
+        self._notif_stop.set()
+        t = self._notif_thread
+        if t is not None:
+            with self._notif_cv:
+                self._notif_cv.notify_all()
+            t.join(timeout=2.0)
         if self._log_file:
             self._log_file.close()
             self._log_file = None
@@ -108,6 +137,16 @@ class Filer:
                 self._log_file.write(json.dumps(ev.to_dict()) + "\n")
                 self._log_file.flush()
             self._event_cv.notify_all()
+        if self.notification_queue is not None:
+            key = (new or old).path if (new or old) else "/"
+            with self._notif_cv:
+                self._notif_buf.append((key, ev.to_dict()))
+                if self._notif_thread is None:
+                    self._notif_thread = threading.Thread(
+                        target=self._notif_loop, daemon=True
+                    )
+                    self._notif_thread.start()
+                self._notif_cv.notify()
 
     def subscribe(
         self,

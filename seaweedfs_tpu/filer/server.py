@@ -157,7 +157,9 @@ class FilerServer:
         add("KvGet", self._rpc_kv_get)
         add("KvPut", self._rpc_kv_put)
         add("ReadFile", self._rpc_read_file, kind="unary_stream", resp_format="bytes")
+        add("ReadFileRange", self._rpc_read_file_range, kind="unary_stream", resp_format="bytes")
         add("SubscribeMetadata", self._rpc_subscribe, kind="unary_stream", resp_format="json")
+        add("GetFilerConfiguration", self._rpc_configuration)
         return svc
 
     def _rpc_lookup(self, req: dict, ctx) -> dict:
@@ -183,6 +185,10 @@ class FilerServer:
             self.filer.create_entry(entry, o_excl=bool(req.get("o_excl", False)))
         except FileExistsError:
             raise rpc.RpcFault(f"{entry.path} exists", grpc.StatusCode.ALREADY_EXISTS)
+        except IsADirectoryError:
+            raise rpc.RpcFault(
+                f"{entry.path} is a directory", grpc.StatusCode.FAILED_PRECONDITION
+            )
         return {}
 
     def _rpc_update(self, req: dict, ctx) -> dict:
@@ -212,6 +218,10 @@ class FilerServer:
             self.filer.rename(req["old_path"], req["new_path"])
         except EntryNotFound:
             raise rpc.NotFoundFault(f"{req['old_path']} not found")
+        except IsADirectoryError:
+            raise rpc.RpcFault(
+                f"{req['new_path']} is a directory", grpc.StatusCode.FAILED_PRECONDITION
+            )
         return {}
 
     def _rpc_statistics(self, req: dict, ctx) -> dict:
@@ -237,6 +247,29 @@ class FilerServer:
         except EntryNotFound:
             raise rpc.NotFoundFault(f"{req['path']} not found")
         yield from self.chunk_io.stream_all(e.chunks)
+
+    def _rpc_read_file_range(self, req: dict, ctx):
+        """Random-access read for mount clients: only overlapping chunks
+        are fetched (ChunkIO.read_range)."""
+        try:
+            e = self.filer.find_entry(req["path"])
+        except EntryNotFound:
+            raise rpc.NotFoundFault(f"{req['path']} not found")
+        offset = int(req.get("offset", 0))
+        size = int(req.get("size", 0))
+        size = max(0, min(size, e.size - offset))
+        if size > 0:
+            yield self.chunk_io.read_range(e.chunks, offset, size)
+
+    def _rpc_configuration(self, req: dict, ctx) -> dict:
+        """Mount/sync clients discover the cluster through the filer, as
+        the reference's GetFilerConfiguration does."""
+        return {
+            "masters": [self.master_address],
+            "chunk_size": self.chunk_io.chunk_size,
+            "collection": self.collection,
+            "replication": self.replication,
+        }
 
     def _rpc_subscribe(self, req: dict, ctx):
         """Stream MetaEvents since ts_ns; ends when the client cancels
@@ -386,6 +419,10 @@ class _Handler(httpd.QuietHandler):
             )
         except IsADirectoryError:
             self._reply_json(409, {"error": f"{path} is a directory"})
+            return
+        except Exception as e:  # noqa: BLE001 — e.g. no writable volumes:
+            # answer 500 instead of killing the keep-alive connection
+            self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
         self._reply_json(
             201,

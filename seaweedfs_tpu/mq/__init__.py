@@ -1,0 +1,17 @@
+"""Message queue — mirror of weed/mq/ (log-structured topic broker on
+the filer) [VERIFY: mount empty; SURVEY.md §2.1 "Messaging" row].
+
+Topics are partitioned append-only logs. Hot tails live in LogBuffers
+(weed/util/log_buffer analog, seaweedfs_tpu.utils.log_buffer); full
+segments persist as filer files under
+
+    /topics/<namespace>/<topic>/<partition>/<first_ts_ns>.seg
+
+so the broker is stateless across restarts: subscribers seeking back in
+time read flushed segments from the filer, then continue on the live
+buffer — the reference broker's read path shape.
+"""
+
+from seaweedfs_tpu.mq.broker import Broker, BrokerClient
+
+__all__ = ["Broker", "BrokerClient"]

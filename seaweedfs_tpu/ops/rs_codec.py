@@ -214,8 +214,11 @@ def new_encoder(
         try:
             import jax
 
-            from seaweedfs_tpu.utils.devices import is_tpu_device
+            from seaweedfs_tpu.utils.devices import honor_platform_env, is_tpu_device
 
+            # JAX_PLATFORMS=cpu must win over the axon sitecustomize or a
+            # cpu-pinned server process blocks on the one-client TPU tunnel
+            honor_platform_env()
             d = jax.devices()[0]
             if is_tpu_device(d):
                 backend = "pallas"

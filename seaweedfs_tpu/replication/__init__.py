@@ -1,0 +1,26 @@
+"""Replication — mirror of weed/replication/ (Replicator + sink wall:
+filer/s3/gcs/azure/b2/local) driven by the filer metadata event log
+[VERIFY: mount empty; SURVEY.md §2.1 "Replication/sync" row, §5].
+
+  sinks.py      — ReplicationSink interface + LocalSink (directory),
+                  FilerSink (another filer), S3Sink (any S3 endpoint,
+                  including this framework's own gateway)
+  replicator.py — tails a source filer's metadata subscription and
+                  applies each event to a sink; resumes from a
+                  checkpoint stored in the source filer's KV store
+                  (SURVEY.md §5 checkpoint/resume).
+
+Drives `filer.sync` (continuous filer->filer) and `filer.backup`
+(filer->local directory), the command/filer_sync.go / filer_backup.go
+analogs.
+"""
+
+from seaweedfs_tpu.replication.sinks import (
+    FilerSink,
+    LocalSink,
+    ReplicationSink,
+    S3Sink,
+)
+from seaweedfs_tpu.replication.replicator import Replicator
+
+__all__ = ["ReplicationSink", "LocalSink", "FilerSink", "S3Sink", "Replicator"]

@@ -138,9 +138,11 @@ class Iam:
         if abs(time.time() - req_ts) > _MAX_SKEW_S:  # replayed/stale request
             return None, "RequestTimeTooSkewed"
         payload_hash = headers.get("x-amz-content-sha256", "")
-        if payload_hash not in ("", "UNSIGNED-PAYLOAD") and not payload_hash.startswith(
-            "STREAMING-"
-        ):
+        if payload_hash.startswith("STREAMING-"):
+            # aws-chunked framing is not decoded here; accepting it would
+            # store the chunk-signature framing bytes as object data
+            return None, "NotImplemented"
+        if payload_hash not in ("", "UNSIGNED-PAYLOAD"):
             if hashlib.sha256(payload).hexdigest() != payload_hash:
                 return None, "XAmzContentSHA256Mismatch"
         want = _signature(

@@ -65,6 +65,7 @@ class S3ApiServer:
         self.filer_http = filer_http_address
         self.filer = FilerClient(filer_grpc_address)
         self.iam = iam or Iam()
+        self._iam_checked_at = 0.0
         self.host = host
         self._http = _ThreadingHTTPServer((host, port), _Handler)
         self._http.s3_server = self
@@ -107,29 +108,43 @@ class S3ApiServer:
         enc = urllib.parse.quote(path)
         return f"http://{self.filer_http}{enc}" + (f"?{query}" if query else "")
 
-    def walk_keys(self, bucket: str, prefix: str = "") -> Iterator[Entry]:
+    def walk_keys(
+        self, bucket: str, prefix: str = "", after: str = ""
+    ) -> Iterator[Entry]:
         """Yield file entries under the bucket whose key starts with
-        prefix, in directory-DFS order."""
+        `prefix` and sorts after `after`, in directory-DFS order. The
+        `after` marker is pushed down into per-directory listings so a
+        paginated walk costs O(depth × page), not a full re-walk."""
         root = self.bucket_path(bucket)
 
-        def rec(dir_path: str) -> Iterator[Entry]:
-            start = ""
+        def rec(dir_path: str, base: str) -> Iterator[Entry]:
+            # base = key prefix of this directory ("" at the bucket root,
+            # else "a/b/"). Resume the listing at the marker's component.
+            start, include = "", False
+            if after and after.startswith(base) and len(after) > len(base):
+                start = after[len(base) :].split("/", 1)[0]
+                include = True
             while True:
-                batch = self.filer.list(dir_path, start_from=start, limit=256)
+                batch = self.filer.list(
+                    dir_path, start_from=start, include_start=include, limit=256
+                )
+                include = False
                 if not batch:
                     return
                 for e in batch:
                     key = e.path[len(root) + 1 :]
                     if e.is_directory:
                         probe = key + "/"
+                        if after and after > probe and not after.startswith(probe):
+                            continue  # whole subtree sorts before the marker
                         # descend only where the subtree can match prefix
                         if probe.startswith(prefix) or prefix.startswith(probe):
-                            yield from rec(e.path)
-                    elif key.startswith(prefix):
+                            yield from rec(e.path, probe)
+                    elif key.startswith(prefix) and (not after or key > after):
                         yield e
                 start = batch[-1].name
 
-        yield from rec(root)
+        yield from rec(root, "")
 
 
 # -- HTTP --------------------------------------------------------------------
@@ -195,9 +210,21 @@ class _Handler(httpd.QuietHandler):
         u = urllib.parse.urlparse(self.path)
         headers = {k.lower(): v for k, v in self.headers.items()}
         path = urllib.parse.unquote(u.path) or "/"
+        if self.s3.iam.open:
+            # an open gateway must notice identities minted via the IAM
+            # API and start enforcing auth (throttled KV poll)
+            now = time.monotonic()
+            if now - self.s3._iam_checked_at > 5.0:
+                self.s3._iam_checked_at = now
+                fresh = load_identities(self.s3.filer)
+                if fresh is not None and fresh.identities:
+                    self.s3.iam.identities = fresh.identities
         identity, err = self.s3.iam.authenticate(
             self.command, path, u.query, headers, payload
         )
+        if identity is None and err == "NotImplemented":
+            self._error(501, "NotImplemented", "aws-chunked (STREAMING-*) uploads not supported")
+            return False
         if identity is None and err == "InvalidAccessKeyId":
             # the IAM API may have minted new credentials since start:
             # reload the persisted identity set once and retry
@@ -370,9 +397,18 @@ class _Handler(httpd.QuietHandler):
         seen_common = set()
         truncated = False
         next_after = ""
-        for e in self.s3.walk_keys(bucket, prefix):
+        # a continuation token can point INSIDE a prefix group already
+        # emitted on the previous page — skip the rest of that group or
+        # the CommonPrefix would repeat across pages
+        skip_group = ""
+        if after and delimiter and after.startswith(prefix):
+            rest = after[len(prefix) :]
+            d = rest.find(delimiter)
+            if d >= 0:
+                skip_group = prefix + rest[: d + len(delimiter)]
+        for e in self.s3.walk_keys(bucket, prefix, after=after):
             key = e.path[len(self.s3.bucket_path(bucket)) + 1 :]
-            if after and key <= after:
+            if skip_group and key.startswith(skip_group):
                 continue
             if delimiter:
                 rest = key[len(prefix) :]

@@ -2,6 +2,29 @@
 
 from __future__ import annotations
 
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-assert the user's JAX_PLATFORMS env over site-level overrides.
+
+    The axon sitecustomize calls jax.config.update("jax_platforms",
+    "axon,cpu") at interpreter start, which OUTRANKS the env var — so a
+    server launched with JAX_PLATFORMS=cpu would still initialize the
+    (single-client) TPU tunnel backend and can hang when another process
+    holds it. Call this right after `import jax`, before any backend
+    touch, wherever the framework imports jax in a server process."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:  # noqa: BLE001 — config attr shape varies by version
+        jax.config.update("jax_platforms", want)
+
 
 def is_tpu_device(d) -> bool:
     """True for real TPUs and for the axon tunnel (platform=="axon",

@@ -237,7 +237,11 @@ class _Handler(httpd.QuietHandler):
         if overwrote and self.headers.get("Overwrite", "T") == "F":
             self._reply(412)
             return
-        self.dav.filer.rename(src, dst)
+        try:
+            self.dav.filer.rename(src, dst)
+        except (IsADirectoryError, FileNotFoundError):
+            self._reply(412)
+            return
         self._reply(204 if overwrote else 201)
 
     def do_COPY(self):

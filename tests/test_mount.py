@@ -1,0 +1,139 @@
+"""Mount (WFS) tests — page-writer merge semantics as pure-unit tests,
+then the full filesystem op set against a real master+volume+filer stack
+(SURVEY.md §4 loopback pattern)."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.mount import WFS, DirtyPages
+
+
+# -- page writer (pure) -------------------------------------------------------
+
+
+def test_dirty_pages_merge_and_overlay():
+    dp = DirtyPages()
+    dp.write(0, b"aaaa")
+    dp.write(10, b"bbbb")
+    assert dp.byte_count == 8 and dp.max_extent() == 14
+    # bridge the gap: everything coalesces into one run
+    dp.write(4, b"cccccc")
+    assert len(dp._runs) == 1 and dp._runs[0] == (0, bytearray(b"aaaaccccccbbbb"))
+    # overlap: latest write wins
+    dp.write(2, b"XX")
+    buf = bytearray(14)
+    dp.read_overlay(0, buf)
+    assert bytes(buf[:10]) == b"aaXXcccccc"
+    runs = dp.drain()
+    assert not dp.dirty
+    assert runs[0][0] == 0 and runs[0][1][:10] == b"aaXXcccccc"
+
+
+def test_dirty_pages_adjacent_coalesce():
+    dp = DirtyPages()
+    dp.write(0, b"1111")
+    dp.write(4, b"2222")  # adjacent -> single run
+    assert len(dp._runs) == 1 and dp._runs[0][1] == bytearray(b"11112222")
+    dp.truncate(6)
+    assert dp.max_extent() == 6
+
+
+# -- WFS over a live stack ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def wfs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mnt")
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    (tmp / "vol").mkdir()
+    vs = VolumeServer([str(tmp / "vol")], master.address, heartbeat_interval=0.4)
+    vs.start()
+    fs = FilerServer(master.address, chunk_size=64 * 1024)
+    fs.start()
+    w = WFS(fs.grpc_address, auto_flush_bytes=256 * 1024)
+    yield w
+    w.close()
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_wfs_create_write_read(wfs):
+    fh = wfs.create("/docs/hello.txt")
+    fh.write(0, b"hello ")
+    fh.write(6, b"world")
+    assert fh.read(0, 100) == b"hello world"  # read-your-writes pre-flush
+    fh.flush()
+    fh.release()
+    a = wfs.getattr("/docs/hello.txt")
+    assert a is not None and a.size == 11 and not a.is_dir
+    fh2 = wfs.open("/docs/hello.txt")
+    assert fh2.read(0, 11) == b"hello world"
+    assert fh2.read(6, 5) == b"world"
+    fh2.release()
+
+
+def test_wfs_random_writes_and_big_file(wfs):
+    payload = bytearray(os.urandom(300 * 1024))  # crosses chunk + autoflush
+    fh = wfs.create("/docs/big.bin")
+    for off in range(0, len(payload), 50 * 1024):
+        fh.write(off, bytes(payload[off : off + 50 * 1024]))
+    # overwrite a window in the middle (random write)
+    patch = os.urandom(10_000)
+    payload[123_456 : 123_456 + len(patch)] = patch
+    fh.write(123_456, patch)
+    fh.flush()
+    fh.release()
+    fh = wfs.open("/docs/big.bin")
+    assert fh.size == len(payload)
+    got = fh.read(0, len(payload))
+    assert got == bytes(payload)
+    assert fh.read(123_000, 11_000) == bytes(payload[123_000:134_000])
+    fh.release()
+
+
+def test_wfs_truncate(wfs):
+    fh = wfs.create("/docs/trunc.bin")
+    fh.write(0, b"0123456789")
+    fh.flush()
+    fh.truncate(4)
+    fh.flush()
+    fh.release()
+    fh = wfs.open("/docs/trunc.bin")
+    assert fh.size == 4 and fh.read(0, 10) == b"0123"
+    # extend-past-truncate via sparse write
+    fh.write(8, b"ZZ")
+    fh.flush()
+    assert fh.read(0, 10) == b"0123\x00\x00\x00\x00ZZ"
+    fh.release()
+
+
+def test_wfs_dirs_and_rename(wfs):
+    wfs.mkdir("/d1")
+    fh = wfs.create("/d1/f.txt")
+    fh.write(0, b"x")
+    fh.release()
+    names = [a.path for a in wfs.readdir("/d1")]
+    assert names == ["/d1/f.txt"]
+    with pytest.raises(OSError):
+        wfs.rmdir("/d1")  # not empty
+    wfs.rename("/d1/f.txt", "/d1/g.txt")
+    assert wfs.getattr("/d1/f.txt") is None
+    assert wfs.open("/d1/g.txt").read(0, 1) == b"x"
+    wfs.unlink("/d1/g.txt")
+    wfs.rmdir("/d1")
+    assert wfs.getattr("/d1") is None
+
+
+def test_wfs_open_semantics(wfs):
+    with pytest.raises(FileNotFoundError):
+        wfs.open("/nope")
+    wfs.mkdir("/adir")
+    with pytest.raises(IsADirectoryError):
+        wfs.open("/adir")
+    wfs.rmdir("/adir")

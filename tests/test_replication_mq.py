@@ -1,0 +1,229 @@
+"""Replication, notification, and mq broker tests over real loopback
+stacks (SURVEY.md §4)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import FilerServer
+from seaweedfs_tpu.mq import Broker, BrokerClient
+from seaweedfs_tpu.notification import LogFileQueue, MemoryQueue, make_queue
+from seaweedfs_tpu.replication import LocalSink, FilerSink, Replicator
+from seaweedfs_tpu.utils.log_buffer import LogBuffer
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    (tmp_path / "vol").mkdir()
+    vs = VolumeServer([str(tmp_path / "vol")], master.address, heartbeat_interval=0.4)
+    vs.start()
+    fs = FilerServer(master.address)
+    fs.start()
+    yield master, vs, fs
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def _put(fs, path, data: bytes):
+    import io
+
+    return fs.write_file(path, io.BytesIO(data))
+
+
+# -- log buffer (pure) --------------------------------------------------------
+
+
+def test_log_buffer_flush_and_tail():
+    flushed = []
+    lb = LogBuffer(
+        lambda f, l, recs: flushed.append(recs), max_bytes=200, flush_interval_s=3600
+    )
+    ts0 = lb.add(b"k1", b"v" * 50)
+    assert lb.read_since(0)[0].key == b"k1"
+    assert lb.read_since(ts0) == []
+    lb.add(b"k2", b"v" * 200)  # crosses max_bytes -> flush
+    assert len(flushed) == 1 and [r.key for r in flushed[0]] == [b"k1", b"k2"]
+    assert lb.read_since(0) == []
+    lb.add(b"k3", b"x")
+    lb.close()  # close flushes the tail
+    assert [r.key for r in flushed[1]] == [b"k3"]
+
+
+def test_log_buffer_monotonic_ts():
+    lb = LogBuffer(lambda *a: None, flush_interval_s=3600)
+    ts = [lb.add(b"", b"x", ts_ns=123) for _ in range(3)]
+    assert ts == sorted(ts) and len(set(ts)) == 3
+    lb.close()
+
+
+# -- notification -------------------------------------------------------------
+
+
+def test_notification_queues(tmp_path):
+    mq = MemoryQueue()
+    got = []
+    mq.subscribe(lambda k, m: got.append(k))
+    mq.send_message("/a", {"x": 1})
+    assert mq.messages[0][0] == "/a" and got == ["/a"]
+    lq = LogFileQueue(str(tmp_path / "events.jsonl"))
+    lq.send_message("/b", {"y": 2})
+    lq.close()
+    lines = open(tmp_path / "events.jsonl", encoding="utf-8").read().splitlines()
+    assert json.loads(lines[0])["key"] == "/b"
+    assert make_queue("none") is None
+
+
+def test_filer_notification_wiring(stack):
+    _, _, fs = stack
+    q = MemoryQueue()
+    fs.filer.notification_queue = q
+    _put(fs, "/notify/f.txt", b"data")
+    deadline = time.monotonic() + 5.0  # dispatch is off-thread
+    while time.monotonic() < deadline:
+        if "/notify/f.txt" in [k for k, _ in q.messages]:
+            break
+        time.sleep(0.05)
+    assert "/notify/f.txt" in [k for k, _ in q.messages]
+
+
+# -- replication --------------------------------------------------------------
+
+
+def test_replicate_to_local_sink(stack, tmp_path):
+    _, _, fs = stack
+    _put(fs, "/site/a/x.txt", b"xx")
+    _put(fs, "/site/y.txt", b"yy")
+    sink_dir = tmp_path / "backup"
+    rep = Replicator(fs.grpc_address, LocalSink(str(sink_dir)), prefix="/site")
+    n = rep.run_once(max_idle_s=0.5)
+    assert n >= 3  # dirs + files
+    assert (sink_dir / "a" / "x.txt").read_bytes() == b"xx"
+    assert (sink_dir / "y.txt").read_bytes() == b"yy"
+    # incremental: only new events apply after checkpoint
+    _put(fs, "/site/z.txt", b"zz")
+    fs.filer.delete_entry("/site/y.txt")
+    n2 = rep.run_once(max_idle_s=0.5)
+    assert (sink_dir / "z.txt").read_bytes() == b"zz"
+    assert not (sink_dir / "y.txt").exists()
+    # events outside the prefix are ignored
+    _put(fs, "/other/o.txt", b"oo")
+    rep.run_once(max_idle_s=0.5)
+    assert not (sink_dir / "o.txt").exists() and not (sink_dir / "other").exists()
+    rep.close()
+
+
+def test_replicate_filer_to_filer(stack, tmp_path):
+    master, vs, fs = stack
+    fs2 = FilerServer(master.address)
+    fs2.start()
+    try:
+        _put(fs, "/data/doc.bin", os.urandom(2048))
+        rep = Replicator(
+            fs.grpc_address, FilerSink(fs2.url, target_root="/mirror"), prefix="/data"
+        )
+        rep.run_once(max_idle_s=0.5)
+        got = fs2.read_file(fs2.filer.find_entry("/mirror/doc.bin"))
+        assert got == fs.read_file(fs.filer.find_entry("/data/doc.bin"))
+        # rename on source -> delete+create on sink
+        fs.filer.rename("/data/doc.bin", "/data/doc2.bin")
+        rep.run_once(max_idle_s=0.5)
+        assert not fs2.filer.exists("/mirror/doc.bin")
+        assert fs2.filer.exists("/mirror/doc2.bin")
+        rep.close()
+    finally:
+        fs2.stop()
+
+
+def test_replicate_history_with_renamed_source(stack, tmp_path):
+    """A create event whose path was later renamed away must not poison
+    the replay — the rename's own events reconcile the sink."""
+    _, _, fs = stack
+    _put(fs, "/hist/orig.bin", b"abc")
+    fs.filer.rename("/hist/orig.bin", "/hist/final.bin")
+    sink_dir = tmp_path / "hist-sink"
+    rep = Replicator(fs.grpc_address, LocalSink(str(sink_dir)), prefix="/hist")
+    rep.run_once(max_idle_s=0.5)
+    assert (sink_dir / "final.bin").read_bytes() == b"abc"
+    assert not (sink_dir / "orig.bin").exists()
+    rep.close()
+
+
+# -- mq broker ----------------------------------------------------------------
+
+
+def test_mq_publish_subscribe(stack):
+    _, _, fs = stack
+    with Broker(fs.url, fs.grpc_address) as broker:
+        with BrokerClient(broker.address) as c:
+            c.configure_topic("events", partition_count=2)
+            assert c.list_topics()[0]["topic"] == "events"
+            parts = set()
+            for i in range(20):
+                r = c.publish("events", f"k{i}".encode(), f"v{i}".encode())
+                parts.add(r["partition"])
+            assert parts == {0, 1}  # key hashing spreads partitions
+            got = []
+            for p in (0, 1):
+                got.extend(
+                    (r.key.decode(), r.value.decode())
+                    for r in c.subscribe("events", partition=p, max_idle_s=0.5)
+                )
+            assert sorted(got) == sorted((f"k{i}", f"v{i}") for i in range(20))
+
+
+def test_mq_durability_across_restart(stack):
+    _, _, fs = stack
+    broker = Broker(fs.url, fs.grpc_address)
+    broker.start()
+    with BrokerClient(broker.address) as c:
+        c.configure_topic("persist", partition_count=1)
+        for i in range(5):
+            c.publish("persist", b"", f"m{i}".encode(), partition=0)
+    broker.stop()  # flushes segments to the filer
+    # the segments are filer files now
+    segs = fs.filer.list_entries("/topics/default/persist/0000")
+    assert segs and segs[0].name.endswith(".seg")
+    broker2 = Broker(fs.url, fs.grpc_address)
+    broker2.start()
+    try:
+        with BrokerClient(broker2.address) as c:
+            vals = [
+                r.value.decode()
+                for r in c.subscribe("persist", partition=0, max_idle_s=0.5)
+            ]
+            assert vals == [f"m{i}" for i in range(5)]
+    finally:
+        broker2.stop()
+
+
+def test_mq_live_subscription(stack):
+    _, _, fs = stack
+    with Broker(fs.url, fs.grpc_address) as broker:
+        with BrokerClient(broker.address) as c:
+            c.configure_topic("live", partition_count=1)
+            received = []
+            done = threading.Event()
+
+            def consume():
+                with BrokerClient(broker.address) as sub:
+                    for r in sub.subscribe("live", partition=0, max_idle_s=5.0):
+                        received.append(r.value)
+                        if len(received) >= 3:
+                            break
+                done.set()
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            for i in range(3):
+                c.publish("live", b"", f"msg{i}".encode(), partition=0)
+            assert done.wait(10.0)
+            assert received == [b"msg0", b"msg1", b"msg2"]

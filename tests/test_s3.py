@@ -167,6 +167,30 @@ def test_list_objects_v2(stack):
     assert len(page2) == 2 and root.find("s3:IsTruncated", ns).text == "false"
 
 
+def test_list_objects_delimiter_pagination_dedup(stack):
+    """CommonPrefixes must not repeat across pages when the continuation
+    token lands inside a prefix group."""
+    s3 = stack
+    ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+    _req(s3, "PUT", "/pagi")
+    for k in ("a/1", "a/2", "b/1", "c"):
+        _req(s3, "PUT", f"/pagi/{k}", b"x")
+    seen_prefixes, seen_keys, token = [], [], ""
+    for _ in range(10):
+        q = "list-type=2&delimiter=%2F&max-keys=1"
+        if token:
+            q += f"&continuation-token={urllib.parse.quote(token)}"
+        _, _, body = _req(s3, "GET", "/pagi", query=q)
+        root = _xml(body)
+        seen_prefixes += [e.text for e in root.findall("s3:CommonPrefixes/s3:Prefix", ns)]
+        seen_keys += [e.text for e in root.findall("s3:Contents/s3:Key", ns)]
+        if root.find("s3:IsTruncated", ns).text != "true":
+            break
+        token = root.find("s3:NextContinuationToken", ns).text
+    assert seen_keys == ["c"]
+    assert seen_prefixes == ["a/", "b/"]  # no duplicates across pages
+
+
 def test_delete_objects_bulk(stack):
     s3 = stack
     _req(s3, "PUT", "/bulk")
