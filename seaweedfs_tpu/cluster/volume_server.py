@@ -230,6 +230,8 @@ class VolumeServer:
         add("VolumeEcBlobDelete", self._rpc_ec_blob_delete)
         add("VolumeEcShardsToVolume", self._rpc_ec_to_volume)
         add("VolumeEcShardsDelete", self._rpc_ec_delete)
+        add("VolumeTierMove", self._rpc_tier_move)
+        add("VolumeTierFetch", self._rpc_tier_fetch)
         return svc
 
     # volume admin
@@ -316,6 +318,62 @@ class VolumeServer:
         loc.volumes[vid] = v
         self.heartbeat_once()
         return {"size": os.path.getsize(base + ".dat")}
+
+    def _rpc_tier_move(self, req: dict, ctx) -> dict:
+        """VolumeTierMove: upload the .dat to remote storage and reopen
+        the volume through the remote backend (tiering, SURVEY.md §2.1
+        'Remote storage tiering')."""
+        from seaweedfs_tpu.remote_storage import make_remote_client
+        from seaweedfs_tpu.remote_storage.tier import tier_move
+        from seaweedfs_tpu.storage.volume import Volume
+
+        vid = int(req["volume_id"])
+        v = self.store.get_volume(vid)
+        if v is None:
+            raise rpc.NotFoundFault(f"volume {vid} not found")
+        if v.tiered:
+            raise rpc.RpcFault(f"volume {vid} is already tiered")
+        client = make_remote_client(req["destination"])
+        was_read_only = v.read_only
+        v.read_only = True  # freeze writes; READS keep serving during upload
+        try:
+            info = tier_move(
+                v.base_path,
+                client,
+                key_prefix=req.get("key_prefix", "volumes/"),
+                keep_local=True,
+            )
+        except Exception:
+            v.read_only = was_read_only
+            raise
+        # upload verified: swap to the remote backend (the only offline
+        # window is this close/remove/reopen, not the upload itself)
+        v.close()
+        os.remove(v.base_path + ".dat")
+        for loc in self.store.locations:
+            if loc.volumes.get(vid) is v:
+                loc.volumes[vid] = Volume(loc.directory, vid, v.collection)
+        self.heartbeat_once()
+        return {"size": info["size"], "key": info["key"]}
+
+    def _rpc_tier_fetch(self, req: dict, ctx) -> dict:
+        """VolumeTierFetch: bring a tiered .dat back to local disk."""
+        from seaweedfs_tpu.remote_storage.tier import tier_fetch
+        from seaweedfs_tpu.storage.volume import Volume
+
+        vid = int(req["volume_id"])
+        v = self.store.get_volume(vid)
+        if v is None:
+            raise rpc.NotFoundFault(f"volume {vid} not found")
+        if not v.tiered:
+            raise rpc.RpcFault(f"volume {vid} is not tiered")
+        v.close()
+        tier_fetch(v.base_path)
+        for loc in self.store.locations:
+            if loc.volumes.get(vid) is v:
+                loc.volumes[vid] = Volume(loc.directory, vid, v.collection)
+        self.heartbeat_once()
+        return {"size": os.path.getsize(v.base_path + ".dat")}
 
     def _rpc_volume_status(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])

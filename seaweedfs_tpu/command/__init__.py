@@ -28,6 +28,7 @@ def register(cmd: Command) -> Command:
 
 def commands() -> dict[str, Command]:
     # import for side effect of registration
+    from seaweedfs_tpu.command import bench_tools  # noqa: F401
     from seaweedfs_tpu.command import local  # noqa: F401
     from seaweedfs_tpu.command import servers  # noqa: F401
     from seaweedfs_tpu.command import sync  # noqa: F401

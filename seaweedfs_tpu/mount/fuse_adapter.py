@@ -39,6 +39,21 @@ def mount_and_serve(filer_grpc_address: str, mountpoint: str, foreground: bool =
     wfs = WFS(filer_grpc_address, watch=True)
 
     class _Ops(fuse.Operations):
+        def __init__(self):
+            import threading
+
+            self._handles = {}
+            self._next_fh = 0
+            self._h_lock = threading.Lock()
+
+        def _register(self, handle) -> int:
+            # callbacks run concurrently (nothreads=False): allocation
+            # must be atomic or two opens share an fh
+            with self._h_lock:
+                self._next_fh += 1
+                self._handles[self._next_fh] = handle
+                return self._next_fh
+
         def _attr_dict(self, a):
             mode = a.mode
             if a.is_dir:
@@ -81,16 +96,10 @@ def mount_and_serve(filer_grpc_address: str, mountpoint: str, foreground: bool =
             wfs.rename(old, new)
 
         def create(self, path, mode, fi=None):
-            self._handles = getattr(self, "_handles", {})
-            fh = max(self._handles, default=0) + 1
-            self._handles[fh] = wfs.create(path, mode)
-            return fh
+            return self._register(wfs.create(path, mode))
 
         def open(self, path, flags):
-            self._handles = getattr(self, "_handles", {})
-            fh = max(self._handles, default=0) + 1
-            self._handles[fh] = wfs.open(path)
-            return fh
+            return self._register(wfs.open(path))
 
         def read(self, path, size, offset, fh):
             return self._handles[fh].read(offset, size)
@@ -99,7 +108,7 @@ def mount_and_serve(filer_grpc_address: str, mountpoint: str, foreground: bool =
             return self._handles[fh].write(offset, data)
 
         def truncate(self, path, length, fh=None):
-            if fh and fh in getattr(self, "_handles", {}):
+            if fh and fh in self._handles:
                 self._handles[fh].truncate(length)
             else:
                 h = wfs.open(path)
@@ -110,7 +119,8 @@ def mount_and_serve(filer_grpc_address: str, mountpoint: str, foreground: bool =
             self._handles[fh].flush()
 
         def release(self, path, fh):
-            h = self._handles.pop(fh, None)
+            with self._h_lock:
+                h = self._handles.pop(fh, None)
             if h:
                 h.release()
 

@@ -55,13 +55,21 @@ class _Partition:
         self.flush_seq += 1
 
     def read_flushed(self, since_ns: int) -> list[LogRecord]:
+        segs = sorted(
+            (
+                e
+                for e in self.broker.filer.list(self.dir, limit=1 << 20)
+                if e.name.endswith(".seg")
+            ),
+            key=lambda e: e.name,
+        )
+        firsts = [int(e.name[: -len(".seg")]) for e in segs]
         out: list[LogRecord] = []
-        for e in self.broker.filer.list(self.dir, limit=1 << 20):
-            if not e.name.endswith(".seg"):
+        for i, e in enumerate(segs):
+            # segment i covers [firsts[i], firsts[i+1]): skip wholly-old
+            # segments by name instead of downloading + parsing them
+            if i + 1 < len(firsts) and firsts[i + 1] <= since_ns + 1:
                 continue
-            # segment name = first ts; skip segments entirely before since
-            # only when a later segment exists that covers it — cheap
-            # filter: read any segment whose records could exceed since
             raw = self.broker.filer.read_file(e.path)
             for line in raw.decode().splitlines():
                 try:

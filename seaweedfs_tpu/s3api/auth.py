@@ -111,6 +111,11 @@ class Iam:
         """Returns (identity, "") on success or (None, error_code).
         Error codes follow S3: AccessDenied / InvalidAccessKeyId /
         SignatureDoesNotMatch / MissingSecurityHeader."""
+        payload_decl = headers.get("x-amz-content-sha256", "")
+        if payload_decl.startswith("STREAMING-"):
+            # aws-chunked framing is never decoded — reject on open
+            # gateways too, or the framing bytes get stored as data
+            return None, "NotImplemented"
         if self.open:
             return Identity("anonymous", "", "", [ACTION_ADMIN]), ""
         auth = headers.get("authorization", "")
@@ -137,11 +142,7 @@ class Iam:
             return None, "AccessDenied"
         if abs(time.time() - req_ts) > _MAX_SKEW_S:  # replayed/stale request
             return None, "RequestTimeTooSkewed"
-        payload_hash = headers.get("x-amz-content-sha256", "")
-        if payload_hash.startswith("STREAMING-"):
-            # aws-chunked framing is not decoded here; accepting it would
-            # store the chunk-signature framing bytes as object data
-            return None, "NotImplemented"
+        payload_hash = payload_decl
         if payload_hash not in ("", "UNSIGNED-PAYLOAD"):
             if hashlib.sha256(payload).hexdigest() != payload_hash:
                 return None, "XAmzContentSHA256Mismatch"

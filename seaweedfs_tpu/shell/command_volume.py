@@ -274,3 +274,89 @@ register(
         do_collection_list,
     )
 )
+
+
+def _parse_dest(dest: str) -> dict:
+    """Parse a tier destination: 'local:/path' or
+    's3:endpoint/bucket[:accessKey:secretKey]'."""
+    vendor, _, rest = dest.partition(":")
+    if vendor == "local":
+        return {"vendor": "local", "root": rest}
+    if vendor == "s3":
+        parts = rest.split(":")
+        endpoint_bucket = parts[0]
+        endpoint, _, bucket = endpoint_bucket.rpartition("/")
+        out = {"vendor": "s3", "endpoint": endpoint, "bucket": bucket}
+        if len(parts) >= 3:
+            out["access_key"], out["secret_key"] = parts[1], parts[2]
+        return out
+    raise ShellError(f"bad -dest {dest!r} (local:/path | s3:host:port/bucket[:ak:sk])")
+
+
+def do_volume_tier_move(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Move cold volumes' .dat files to remote storage
+    (command_volume_tier_move.go analog)."""
+    fl = parse_flags(args, volumeId=0, dest="", keyPrefix="volumes/")
+    if not fl.volumeId or not fl.dest:
+        raise ShellError("volume.tier.move needs -volumeId and -dest")
+    env.confirm_locked()
+    destination = _parse_dest(fl.dest)
+    for n in env.topology_nodes():
+        for v in n.get("volumes", []):
+            if int(v["id"]) != fl.volumeId:
+                continue
+            resp = env.vs_call(
+                grpc_addr(n),
+                "VolumeTierMove",
+                {
+                    "volume_id": fl.volumeId,
+                    "destination": destination,
+                    "key_prefix": fl.keyPrefix,
+                },
+            )
+            w.write(
+                f"volume.tier.move {fl.volumeId} on {n['url']}: "
+                f"{resp.get('size')} bytes -> {resp.get('key')}\n"
+            )
+            return
+    raise ShellError(f"volume {fl.volumeId} not found in the topology")
+
+
+register(
+    ShellCommand(
+        "volume.tier.move",
+        "volume.tier.move -volumeId <id> -dest local:/path|s3:host:port/bucket[:ak:sk] "
+        "[-keyPrefix volumes/]\n\tmove a volume's .dat to remote storage (reads keep working)",
+        do_volume_tier_move,
+    )
+)
+
+
+def do_volume_tier_fetch(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Bring a tiered volume's .dat back to local disk."""
+    fl = parse_flags(args, volumeId=0)
+    if not fl.volumeId:
+        raise ShellError("volume.tier.fetch needs -volumeId")
+    env.confirm_locked()
+    for n in env.topology_nodes():
+        for v in n.get("volumes", []):
+            if int(v["id"]) != fl.volumeId:
+                continue
+            resp = env.vs_call(
+                grpc_addr(n), "VolumeTierFetch", {"volume_id": fl.volumeId}
+            )
+            w.write(
+                f"volume.tier.fetch {fl.volumeId} on {n['url']}: "
+                f"{resp.get('size')} bytes local again\n"
+            )
+            return
+    raise ShellError(f"volume {fl.volumeId} not found in the topology")
+
+
+register(
+    ShellCommand(
+        "volume.tier.fetch",
+        "volume.tier.fetch -volumeId <id>\n\tdownload a tiered volume's .dat back to local disk",
+        do_volume_tier_fetch,
+    )
+)

@@ -39,8 +39,12 @@ class DiskLocation:
         self.ec_volumes: dict[int, EcVolume] = {}
 
     def load(self, encoder: Optional[Encoder] = None) -> None:
-        for dat in glob.glob(os.path.join(self.directory, "*.dat")):
-            base = os.path.basename(dat)[: -len(".dat")]
+        # tiered volumes have no local .dat — discovered via .tierinfo
+        discovered = glob.glob(os.path.join(self.directory, "*.dat")) + glob.glob(
+            os.path.join(self.directory, "*.tierinfo")
+        )
+        for path in discovered:
+            base = os.path.basename(path).rsplit(".", 1)[0]
             parsed = parse_base_name(base)
             if parsed is None:
                 continue

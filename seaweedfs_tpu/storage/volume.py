@@ -43,8 +43,21 @@ class Volume:
         self.dat_path = self.base_path + ".dat"
         self.idx_path = self.base_path + ".idx"
 
-        exists = os.path.exists(self.dat_path)
-        self._dat = open(self.dat_path, "r+b" if exists else "w+b")
+        self.tiered = False
+        if os.path.exists(self.base_path + ".tierinfo") and not os.path.exists(
+            self.dat_path
+        ):
+            # cold volume: .dat lives in remote storage (backend row,
+            # SURVEY.md §2.1); serve reads through the remote backend
+            from seaweedfs_tpu.remote_storage.tier import open_tiered_dat
+
+            self._dat = open_tiered_dat(self.base_path)
+            self.tiered = True
+            self.read_only = True
+            exists = True
+        else:
+            exists = os.path.exists(self.dat_path)
+            self._dat = open(self.dat_path, "r+b" if exists else "w+b")
         try:
             if exists:
                 self._dat.seek(0, os.SEEK_END)
@@ -55,7 +68,7 @@ class Volume:
                 else:
                     self.super_block = super_block or SuperBlock()
                     self._write_super_block()
-                if not os.path.exists(self.idx_path) and dat_size > 8:
+                if not os.path.exists(self.idx_path) and dat_size > 8 and not self.tiered:
                     # .dat has records but the index is gone (crash, manual
                     # deletion): rebuild it by scan before serving, else
                     # reads miss and a compact would wipe the volume.
@@ -178,6 +191,11 @@ class Volume:
         from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
 
         with self._lock:
+            if self.tiered:
+                raise IOError(
+                    f"volume {self.id} is tiered to remote storage — "
+                    "fetch it back (volume.tier.fetch) before compacting"
+                )
             before = self.content_size()
             idx_entries = (
                 os.path.getsize(self.idx_path)

@@ -52,6 +52,7 @@ class LogBuffer:
         self._flush_fn = flush_fn
         self._max = max_bytes
         self._records: list[LogRecord] = []
+        self._last_ts = 0  # survives drains: monotonicity across flushes
         self._bytes = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -64,9 +65,12 @@ class LogBuffer:
         rec = LogRecord(ts_ns or time.time_ns(), key, value)
         to_flush = None
         with self._lock:
-            # monotonicity within the buffer (subscribers seek by ts)
-            if self._records and rec.ts_ns <= self._records[-1].ts_ns:
-                rec.ts_ns = self._records[-1].ts_ns + 1
+            # monotonic across the whole buffer LIFETIME, not just the
+            # current batch — a record stamped <= the last flushed ts
+            # would be invisible to subscribers seeking past the flush
+            if rec.ts_ns <= self._last_ts:
+                rec.ts_ns = self._last_ts + 1
+            self._last_ts = rec.ts_ns
             self._records.append(rec)
             self._bytes += len(key) + len(value) + 16
             if self._bytes >= self._max:

@@ -141,6 +141,7 @@ def test_compact_refuses_empty_index_with_data(vol, capsys):
 
     v.dir, v.id, v.collection = os.path.dirname(base), 7, ""
     v.read_only = False
+    v.tiered = False
     v._lock = threading.RLock()
     v.nm = CompactMap()
     v.base_path, v.dat_path, v.idx_path = base, base + ".dat", base + ".idx"
