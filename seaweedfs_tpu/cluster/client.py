@@ -44,16 +44,84 @@ class MasterClient:
     ):
         """Trusted clients share the cluster's security.toml keys and mint
         their own per-fid JWTs for delete/read (the reference's clients do
-        the same; Assign only covers the freshly assigned fid)."""
-        self.master_address = master_address
+        the same; Assign only covers the freshly assigned fid).
+
+        `master_address` may be a comma-separated HA quorum list; calls
+        fail over between masters and follow raft-leader redirects."""
+        self.addresses = [a.strip() for a in master_address.split(",") if a.strip()]
+        self.master_address = self.addresses[0]
         self.signing_key = signing_key
         self.read_signing_key = read_signing_key
-        self._rpc = rpc.RpcClient(master_address)
+        self._clients: dict[str, rpc.RpcClient] = {}
+        self._current = self.addresses[0]
         self._lock = threading.Lock()
         self._vid_cache: dict[int, tuple[float, list[Location]]] = {}
 
+    def _client_for(self, address: str) -> rpc.RpcClient:
+        with self._lock:
+            c = self._clients.get(address)
+            if c is None:
+                c = rpc.RpcClient(address)
+                self._clients[address] = c
+            return c
+
+    def master_call(self, method: str, req: dict, timeout: float = 30.0) -> dict:
+        """Unary master call with quorum failover + raft-leader redirect.
+
+        Handles BOTH not-leader signals the master emits (the Assign-style
+        `{"error": "not the raft leader", "leader": ...}` dict and the
+        RpcFault FAILED_PRECONDITION used by the admin lock), so every
+        component (clients, shell, sync tools) shares this one path."""
+        import grpc as _grpc
+
+        last_err: Optional[Exception] = None
+        tried: list[str] = []
+        candidates = [self._current] + [a for a in self.addresses if a != self._current]
+        for addr in candidates:
+            if addr in tried:
+                continue
+            tried.append(addr)
+            try:
+                resp = self._client_for(addr).call(
+                    MASTER_SERVICE, method, req, timeout=timeout
+                )
+            except _grpc.RpcError as e:
+                detail = e.details() or ""
+                if (
+                    e.code() == _grpc.StatusCode.FAILED_PRECONDITION
+                    and "not the raft leader; leader is " in detail
+                ):
+                    leader = detail.rsplit("leader is ", 1)[1].strip()
+                    if leader and leader not in tried:
+                        candidates.append(leader)
+                    last_err = e
+                    continue
+                if e.code() not in (
+                    _grpc.StatusCode.UNAVAILABLE,
+                    _grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    raise  # app-level fault from a healthy master
+                last_err = e
+                continue
+            if isinstance(resp, dict) and "not the raft leader" in str(
+                resp.get("error", "")
+            ):
+                # an election may be in flight: a follower's hint can be
+                # stale/empty — follow it if fresh, else keep trying
+                leader = resp.get("leader") or ""
+                if leader and leader not in tried:
+                    candidates.append(leader)
+                last_err = ClusterError(f"{addr}: not the raft leader")
+                continue
+            self._current = addr
+            return resp
+        raise ClusterError(f"no usable master ({tried}): {last_err}")
+
     def close(self) -> None:
-        self._rpc.close()
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
 
     def __enter__(self):
         return self
@@ -71,8 +139,7 @@ class MasterClient:
         ttl: str = "",
     ) -> AssignResponse:
         resp = AssignResponse.from_dict(
-            self._rpc.call(
-                MASTER_SERVICE,
+            self.master_call(
                 "Assign",
                 {
                     "count": count,
@@ -92,9 +159,7 @@ class MasterClient:
             hit = self._vid_cache.get(vid)
             if hit and not refresh and now - hit[0] < _VID_CACHE_TTL:
                 return hit[1]
-        resp = self._rpc.call(
-            MASTER_SERVICE, "Lookup", {"volume_or_file_ids": [str(vid)]}
-        )
+        resp = self.master_call("Lookup", {"volume_or_file_ids": [str(vid)]})
         entries = resp.get("volume_id_locations", [])
         locations = []
         if entries and not entries[0].get("error"):
@@ -104,17 +169,17 @@ class MasterClient:
         return locations
 
     def lookup_ec(self, vid: int) -> dict[int, list[Location]]:
-        resp = self._rpc.call(MASTER_SERVICE, "LookupEcVolume", {"volume_id": vid})
+        resp = self.master_call("LookupEcVolume", {"volume_id": vid})
         return {
             e["shard_id"]: [Location.from_dict(d) for d in e["locations"]]
             for e in resp.get("shard_id_locations", [])
         }
 
     def volume_list(self) -> dict:
-        return self._rpc.call(MASTER_SERVICE, "VolumeList", {})
+        return self.master_call("VolumeList", {})
 
     def statistics(self) -> dict:
-        return self._rpc.call(MASTER_SERVICE, "Statistics", {})
+        return self.master_call("Statistics", {})
 
     # -- data ops (weed/operation analogs) ------------------------------------
 

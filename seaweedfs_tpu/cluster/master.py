@@ -36,6 +36,9 @@ class MasterServer:
         sequencer=None,
         reap_interval: float = 30.0,
         guard=None,
+        peers: Optional[list[str]] = None,
+        raft_dir: str = "",
+        election_timeout: tuple[float, float] = (1.0, 2.0),
     ):
         self.guard = guard
         self.topology = Topology(
@@ -54,15 +57,95 @@ class MasterServer:
         self._reap_interval = reap_interval
         self._stop = threading.Event()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        # raft HA (reference: master quorum; single-master when no peers)
+        self.raft = None
+        if peers:
+            from seaweedfs_tpu.cluster.raft import RaftNode
+
+            self.raft = RaftNode(
+                me=self.address,
+                peers=peers,
+                server=self._server,
+                state_dir=raft_dir,
+                election_timeout=election_timeout,
+                payload_fn=self._raft_payload,
+                apply_fn=self._raft_apply,
+                on_leader=self._on_become_leader,
+            )
+
+    # -- raft integration -----------------------------------------------------
+
+    VID_TAKEOVER_MARGIN = 100  # vids the old leader could plausibly have
+    # allocated beyond its last replicated watermark (each grow round-trips
+    # VolumeCreate RPCs, so per heartbeat interval this is generous)
+
+    def _raft_payload(self) -> dict:
+        """Hard state the leader replicates: id watermarks + the admin
+        lock table. Topology is soft state — every master rebuilds it
+        from heartbeats."""
+        with self.topology._lock:
+            max_vid = self.topology.max_volume_id
+        now = time.monotonic()
+        with self._admin_lock_mu:
+            locks = {
+                name: [tok, max(0.0, exp - now), client]
+                for name, (tok, exp, client) in self._admin_locks.items()
+                if exp > now
+            }
+        return {
+            "max_volume_id": max_vid,
+            "sequence": self.sequencer.watermark,
+            "admin_locks": locks,
+        }
+
+    def _raft_apply(self, payload: dict) -> None:
+        with self.topology._lock:
+            self.topology.max_volume_id = max(
+                self.topology.max_volume_id, int(payload.get("max_volume_id", 0))
+            )
+        if hasattr(self.sequencer, "floor"):
+            self.sequencer.floor(int(payload.get("sequence", 0)))
+        # adopt the leader's lock table so a promoted follower honors
+        # in-flight shell operations (mutual exclusion across failover)
+        now = time.monotonic()
+        with self._admin_lock_mu:
+            self._admin_locks = {
+                name: (int(tok), now + float(ttl), client)
+                for name, (tok, ttl, client) in payload.get("admin_locks", {}).items()
+            }
+
+    def _on_become_leader(self) -> None:
+        """A fresh leader bumps both watermarks past anything the old
+        leader could have issued beyond its last replicated values."""
+        if hasattr(self.sequencer, "floor"):
+            self.sequencer.floor(self.sequencer.watermark + MemorySequencer.BATCH)
+        with self.topology._lock:
+            self.topology.max_volume_id += self.VID_TAKEOVER_MARGIN
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader
+
+    def _leader_address(self) -> str:
+        if self.raft is None or self.raft.is_leader:
+            return self.address
+        return self.raft.leader or ""
+
+    def _not_leader_response(self) -> dict:
+        return {"error": "not the raft leader", "leader": self._leader_address()}
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         self._server.start()
+        if self.raft is not None:
+            self.raft.start()
         self._reaper.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         self._server.stop()
 
     @property
@@ -104,6 +187,11 @@ class MasterServer:
     ADMIN_LOCK_TTL = 30.0
 
     def _rpc_lease_admin_token(self, req: dict, ctx) -> dict:
+        if not self.is_leader:
+            raise rpc.RpcFault(
+                f"not the raft leader; leader is {self._leader_address()}",
+                code=grpc.StatusCode.FAILED_PRECONDITION,
+            )
         name = req.get("lock_name", "admin")
         prev = int(req.get("previous_token", 0))
         now = time.monotonic()
@@ -134,12 +222,15 @@ class MasterServer:
         return {}
 
     def _rpc_heartbeat(self, req: dict, ctx) -> dict:
+        # every master ingests heartbeats (topology is soft state — a
+        # follower promoted by raft already has a live view); the reply
+        # names the current leader so volume servers can prefer it
         stats.MasterReceivedHeartbeatCounter.inc()
         hb = Heartbeat.from_dict(req)
         self.topology.process_heartbeat(hb)
         return {
             "volume_size_limit": self.topology.volume_size_limit,
-            "leader": self.address,
+            "leader": self._leader_address() or self.address,
         }
 
     def _rpc_leave(self, req: dict, ctx) -> dict:
@@ -147,6 +238,9 @@ class MasterServer:
         return {}
 
     def _rpc_assign(self, req: dict, ctx) -> dict:
+        if not self.is_leader:
+            # followers redirect: only the leader allocates ids/volumes
+            return {**self._not_leader_response(), "count": 0}
         count = int(req.get("count", 1))
         collection = req.get("collection", "")
         replication = req.get("replication") or self.default_replication
@@ -254,6 +348,11 @@ class MasterServer:
             if not targets:
                 return 0
             vid = self.topology.next_volume_id()
+            if self.raft is not None:
+                # replicate the new watermark eagerly so a crash right
+                # after the creates can't lead the next leader to reissue
+                # this vid (belt; VID_TAKEOVER_MARGIN is the suspenders)
+                self.raft._broadcast_heartbeat()
             succeeded = []
             for node in targets:
                 try:

@@ -47,6 +47,21 @@ class MemorySequencer:
             self._next = end
             return first
 
+    @property
+    def watermark(self) -> int:
+        """Next id to be handed out (replicated to raft followers)."""
+        with self._lock:
+            return self._next
+
+    def floor(self, value: int) -> None:
+        """Never allocate below `value` again (applied from the raft
+        leader's watermark; a new leader floors past it plus a margin)."""
+        with self._lock:
+            if value > self._next:
+                self._next = value
+                if value > self._leased_until:
+                    self._lease(value + self.BATCH)
+
 
 class SnowflakeSequencer:
     """41-bit ms timestamp | 10-bit node id | 12-bit sequence."""

@@ -78,7 +78,13 @@ class VolumeServer:
         self.public_url = public_url or f"{host}:{self.port}"
         self._http_thread = threading.Thread(target=self._http.serve_forever, daemon=True)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
-        self._master = rpc.RpcClient(master_address)
+        # HA quorum: heartbeat every master (topology is soft state on
+        # each; a raft-promoted follower already has a live view)
+        self._master_addresses = [
+            a.strip() for a in master_address.split(",") if a.strip()
+        ]
+        self._masters = {a: rpc.RpcClient(a) for a in self._master_addresses}
+        self._master = self._masters[self._master_addresses[0]]
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -98,14 +104,16 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._master.call(MASTER_SERVICE, "LeaveCluster", {"url": self.url}, timeout=2)
-        except Exception:  # noqa: BLE001 — master may already be gone
-            pass
+        for c in self._masters.values():
+            try:
+                c.call(MASTER_SERVICE, "LeaveCluster", {"url": self.url}, timeout=2)
+            except Exception:  # noqa: BLE001 — master may already be gone
+                continue
         self._http.shutdown()
         self._http.server_close()
         self._grpc.stop()
-        self._master.close()
+        for c in self._masters.values():
+            c.close()
         self.store.close()
 
     def __enter__(self):
@@ -131,9 +139,27 @@ class VolumeServer:
         )
 
     def heartbeat_once(self) -> None:
-        self._master.call(
-            MASTER_SERVICE, "Heartbeat", self._make_heartbeat().to_dict(), timeout=10
-        )
+        hb = self._make_heartbeat().to_dict()
+        ok = 0
+        last_err: Exception | None = None
+        for c in self._masters.values():
+            try:
+                c.call(MASTER_SERVICE, "Heartbeat", hb, timeout=10)
+                ok += 1
+            except Exception as e:  # noqa: BLE001 — that master may be down
+                last_err = e
+        if not ok and last_err is not None:
+            raise last_err
+
+    def _master_query(self, method: str, req: dict, timeout: float = 5.0) -> dict:
+        """Read query against any reachable master (soft state is on all)."""
+        last_err: Exception | None = None
+        for c in self._masters.values():
+            try:
+                return c.call(MASTER_SERVICE, method, req, timeout=timeout)
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+        raise last_err  # type: ignore[misc]
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):
@@ -167,9 +193,7 @@ class VolumeServer:
 
         def read(shard_id: int, offset: int, size: int) -> Optional[bytes]:
             try:
-                resp = self._master.call(
-                    MASTER_SERVICE, "LookupEcVolume", {"volume_id": vid}, timeout=5
-                )
+                resp = self._master_query("LookupEcVolume", {"volume_id": vid})
             except Exception:  # noqa: BLE001
                 return None
             for entry in resp.get("shard_id_locations", []):
@@ -677,11 +701,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         (store_replicate.go analog). Returns an error string, or None.
         The X-Weed-Replicate header stops forwarding loops."""
         try:
-            resp = self.vs._master.call(
-                MASTER_SERVICE,
-                "Lookup",
-                {"volume_or_file_ids": [str(fid.volume_id)]},
-                timeout=5,
+            resp = self.vs._master_query(
+                "Lookup", {"volume_or_file_ids": [str(fid.volume_id)]}
             )
             entries = resp.get("volume_id_locations", [])
             locations = entries[0].get("locations", []) if entries else []

@@ -70,18 +70,23 @@ def _master_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-peers", default="", help="comma-separated master quorum (raft HA)")
+    p.add_argument("-raftDir", default="", help="raft term/vote persistence directory")
     p.add_argument("-metricsPort", type=int, default=0)
 
 
 def _master_run(args: argparse.Namespace) -> int:
     from seaweedfs_tpu.cluster.master import MasterServer
 
+    peers = [a.strip() for a in args.peers.split(",") if a.strip()]
     m = MasterServer(
         port=args.port,
         host=args.ip,
         volume_size_limit=args.volumeSizeLimitMB * 1024 * 1024,
         default_replication=args.defaultReplication,
         guard=_load_guard(),
+        peers=peers or None,
+        raft_dir=args.raftDir,
     )
     m.start()
     _maybe_metrics(args.metricsPort)

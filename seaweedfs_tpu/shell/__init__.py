@@ -62,7 +62,6 @@ class CommandEnv:
     def __init__(self, master_address: str, client_name: str = "shell"):
         self.master_address = master_address
         self.client = MasterClient(master_address)
-        self._master = rpc.RpcClient(master_address)
         self.client_name = client_name
         self._lock_token = 0
         self._renew_stop: Optional[threading.Event] = None
@@ -75,7 +74,6 @@ class CommandEnv:
             except Exception:  # noqa: BLE001 — master may be gone
                 pass
         self.client.close()
-        self._master.close()
 
     def __enter__(self):
         return self
@@ -86,7 +84,9 @@ class CommandEnv:
     # -- master helpers ------------------------------------------------------
 
     def master_call(self, method: str, req: dict, timeout: float = 30) -> dict:
-        return self._master.call(MASTER_SERVICE, method, req, timeout=timeout)
+        """Master RPC via MasterClient's single failover/redirect path
+        (thread-safe: the lock renewer calls this concurrently)."""
+        return self.client.master_call(method, req, timeout=timeout)
 
     def volume_list(self) -> dict:
         return self.master_call("VolumeList", {})
@@ -149,7 +149,7 @@ class CommandEnv:
         next confirm_locked() aborts — when the master says someone else
         holds the lock (our lease expired and was stolen)."""
         try:
-            self.master_call(
+            resp = self.master_call(
                 "LeaseAdminToken",
                 {
                     "lock_name": LOCK_NAME,
@@ -157,6 +157,10 @@ class CommandEnv:
                     "client_name": self.client_name,
                 },
             )
+            # a freshly promoted leader may reissue the token (lock table
+            # replication lags by one heartbeat): adopt it, or the next
+            # renewal's stale previous_token aborts the running command
+            self._lock_token = int(resp.get("token", self._lock_token))
             return True
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.FAILED_PRECONDITION:

@@ -1,0 +1,166 @@
+"""Master HA tests: raft leader election, failover, redirects, and id
+watermark continuity (the reference's master quorum behavior, SURVEY.md
+§1/§2.1 "Master" row)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+FAST = (0.25, 0.5)  # election timeout range for tests
+
+
+def _wait_for_leader(masters, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [m for m in masters if m.raft is not None and m.raft.is_leader]
+        if len(leaders) == 1:
+            # all followers agree on it
+            agreed = all(
+                m.raft.leader == leaders[0].address
+                for m in masters
+                if m is not leaders[0]
+            )
+            if agreed:
+                return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no stable leader elected")
+
+
+@pytest.fixture
+def quorum(tmp_path):
+    """Three masters forming a raft quorum on loopback."""
+    masters = [
+        MasterServer(port=0, reap_interval=3600, election_timeout=FAST)
+        for _ in range(3)
+    ]
+    addresses = [m.address for m in masters]
+    from seaweedfs_tpu.cluster.raft import RaftNode
+
+    for m in masters:
+        m.raft = RaftNode(
+            me=m.address,
+            peers=addresses,
+            server=m._server,
+            state_dir=str(tmp_path),
+            election_timeout=FAST,
+            payload_fn=m._raft_payload,
+            apply_fn=m._raft_apply,
+            on_leader=m._on_become_leader,
+        )
+    for m in masters:
+        m.start()
+    yield masters
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:
+            pass
+
+
+def test_single_leader_elected(quorum):
+    leader = _wait_for_leader(quorum)
+    states = sorted(m.raft.state for m in quorum)
+    assert states == ["follower", "follower", "leader"]
+    assert leader.is_leader
+
+
+def test_leader_failover_and_term_increase(quorum):
+    leader = _wait_for_leader(quorum)
+    old_term = leader.raft.term
+    leader.stop()
+    rest = [m for m in quorum if m is not leader]
+    new_leader = _wait_for_leader(rest)
+    assert new_leader is not leader
+    assert new_leader.raft.term > old_term
+
+
+def test_assign_redirect_and_failover(quorum, tmp_path):
+    leader = _wait_for_leader(quorum)
+    follower = next(m for m in quorum if m is not leader)
+    d = tmp_path / "vol"
+    d.mkdir()
+    vs = VolumeServer(
+        [str(d)],
+        ",".join(m.address for m in quorum),
+        heartbeat_interval=0.3,
+    )
+    vs.start()
+    try:
+        # client pointed ONLY at a follower: redirect must find the leader
+        client = MasterClient(follower.address)
+        a1 = client.assign()
+        assert a1.fid
+        client.upload(a1.fid, b"ha payload")
+        assert client.read(a1.fid) == b"ha payload"
+        client.close()
+        # kill the leader; a quorum-aware client keeps working
+        leader.stop()
+        survivors = [m for m in quorum if m is not leader]
+        _wait_for_leader(survivors)
+        client = MasterClient(",".join(m.address for m in survivors))
+        deadline = time.monotonic() + 10
+        a2 = None
+        while time.monotonic() < deadline:
+            try:
+                a2 = client.assign()
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert a2 is not None and a2.fid
+        # watermark continuity: the new fid never collides with the old
+        assert a2.fid != a1.fid
+        key1 = int(a1.fid.split(",")[1][:-8] or "0", 16)
+        key2 = int(a2.fid.split(",")[1][:-8] or "0", 16)
+        assert key2 > key1  # floored past the old leader's lease
+        client.upload(a2.fid, b"after failover")
+        assert client.read(a2.fid) == b"after failover"
+        client.close()
+    finally:
+        vs.stop()
+
+
+def test_partitioned_leader_steps_down(quorum):
+    """A leader that cannot reach a quorum must stop claiming leadership
+    (split-brain guard: a stale leader would keep allocating ids)."""
+    leader = _wait_for_leader(quorum)
+    # simulate partition: cut the leader's raft clients to its peers
+    for c in leader.raft._clients.values():
+        c.close()
+    leader.raft._clients.clear()
+    leader.raft.peers = ["127.0.0.1:1"]  # unreachable blackhole
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and leader.raft.is_leader:
+        time.sleep(0.05)
+    assert not leader.raft.is_leader
+    assert not leader.is_leader  # Assign would now redirect
+
+
+def test_raft_term_persistence(tmp_path):
+    """A restarted node must come back with its persisted term."""
+    from seaweedfs_tpu import rpc as rpc_mod
+    from seaweedfs_tpu.cluster.raft import RaftNode
+
+    server = rpc_mod.RpcServer(port=0)
+    node = RaftNode(
+        me="127.0.0.1:1",
+        peers=["127.0.0.1:1"],
+        server=server,
+        state_dir=str(tmp_path),
+        election_timeout=FAST,
+    )
+    node.term = 42
+    node.voted_for = "127.0.0.1:9"
+    node._save_state()
+    server2 = rpc_mod.RpcServer(port=0)
+    node2 = RaftNode(
+        me="127.0.0.1:1",
+        peers=["127.0.0.1:1"],
+        server=server2,
+        state_dir=str(tmp_path),
+        election_timeout=FAST,
+    )
+    assert node2.term == 42 and node2.voted_for == "127.0.0.1:9"
