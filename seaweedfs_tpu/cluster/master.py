@@ -213,6 +213,13 @@ class MasterServer:
             return {"token": token, "lock_ts_ns": int(now * 1e9)}
 
     def _rpc_release_admin_token(self, req: dict, ctx) -> dict:
+        if not self.is_leader:
+            # must land on the leader: a follower-local delete is lost and
+            # the replicated lock table keeps the cluster locked till TTL
+            raise rpc.RpcFault(
+                f"not the raft leader; leader is {self._leader_address()}",
+                code=grpc.StatusCode.FAILED_PRECONDITION,
+            )
         name = req.get("lock_name", "admin")
         prev = int(req.get("previous_token", 0))
         with self._admin_lock_mu:

@@ -71,6 +71,7 @@ class RaftNode:
         svc.add("AppendEntries", self._rpc_append_entries)
         server.add_service(svc)
         self._clients: dict[str, rpc.RpcClient] = {}
+        self._clients_mu = threading.Lock()
         self._ticker = threading.Thread(target=self._run, daemon=True)
 
     # -- persistence ----------------------------------------------------------
@@ -107,20 +108,22 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
-        for c in self._clients.values():
+        with self._clients_mu:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
             c.close()
-        self._clients.clear()
 
     @property
     def is_leader(self) -> bool:
         return self.state == LEADER
 
     def _client(self, peer: str) -> rpc.RpcClient:
-        c = self._clients.get(peer)
-        if c is None:
-            c = rpc.RpcClient(peer)
-            self._clients[peer] = c
-        return c
+        with self._clients_mu:
+            c = self._clients.get(peer)
+            if c is None:
+                c = rpc.RpcClient(peer)
+                self._clients[peer] = c
+            return c
 
     # -- RPC handlers ---------------------------------------------------------
 

@@ -104,11 +104,10 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
-        for c in self._masters.values():
-            try:
-                c.call(MASTER_SERVICE, "LeaveCluster", {"url": self.url}, timeout=2)
-            except Exception:  # noqa: BLE001 — master may already be gone
-                continue
+        try:
+            self._masters_fanout("LeaveCluster", {"url": self.url}, timeout=2)
+        except Exception:  # noqa: BLE001 — masters may already be gone
+            pass
         self._http.shutdown()
         self._http.server_close()
         self._grpc.stop()
@@ -138,18 +137,36 @@ class VolumeServer:
             ec_shards=[i.to_dict() for i in self.store.ec_volume_infos()],
         )
 
-    def heartbeat_once(self) -> None:
-        hb = self._make_heartbeat().to_dict()
-        ok = 0
-        last_err: Exception | None = None
-        for c in self._masters.values():
+    def _masters_fanout(self, method: str, req: dict, timeout: float) -> int:
+        """Call every master in PARALLEL (a firewalled master must not
+        stall the round by its full RPC deadline); returns success count,
+        raising the last error when none succeeded."""
+        ok = [0]
+        errs: list[Exception] = []
+        lock = threading.Lock()
+
+        def one(c: rpc.RpcClient) -> None:
             try:
-                c.call(MASTER_SERVICE, "Heartbeat", hb, timeout=10)
-                ok += 1
+                c.call(MASTER_SERVICE, method, req, timeout=timeout)
+                with lock:
+                    ok[0] += 1
             except Exception as e:  # noqa: BLE001 — that master may be down
-                last_err = e
-        if not ok and last_err is not None:
-            raise last_err
+                with lock:
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=one, args=(c,)) for c in self._masters.values()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 1.0)
+        if not ok[0] and errs:
+            raise errs[-1]
+        return ok[0]
+
+    def heartbeat_once(self) -> None:
+        self._masters_fanout("Heartbeat", self._make_heartbeat().to_dict(), timeout=10)
 
     def _master_query(self, method: str, req: dict, timeout: float = 5.0) -> dict:
         """Read query against any reachable master (soft state is on all)."""

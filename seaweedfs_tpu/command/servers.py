@@ -142,6 +142,12 @@ def _server_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-port", type=int, default=8080, help="volume server http port")
     p.add_argument("-dir", action="append", default=None)
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    p.add_argument("-filer", action="store_true", help="also run a filer")
+    p.add_argument("-filerPort", type=int, default=8888)
+    p.add_argument("-s3", action="store_true", help="also run the S3 gateway (implies -filer)")
+    p.add_argument("-s3Port", type=int, default=8333)
+    p.add_argument("-webdav", action="store_true", help="also run WebDAV (implies -filer)")
+    p.add_argument("-webdavPort", type=int, default=7333)
 
 
 def _server_run(args: argparse.Namespace) -> int:
@@ -158,8 +164,33 @@ def _server_run(args: argparse.Namespace) -> int:
         args.dir or ["./data"], m.address, port=args.port, host=args.ip
     )
     vs.start()
-    print(f"server: master {m.address}, volume http {vs.url} grpc {vs.grpc_address}")
+    parts = [f"master {m.address}", f"volume http {vs.url} grpc {vs.grpc_address}"]
+    extras = []
+    if args.filer or args.s3 or args.webdav:
+        from seaweedfs_tpu.filer import FilerServer
+
+        f = FilerServer(m.address, port=args.filerPort, host=args.ip)
+        f.start()
+        extras.append(f)
+        parts.append(f"filer http {f.url} grpc {f.grpc_address}")
+        if args.s3:
+            from seaweedfs_tpu.s3api import S3ApiServer
+
+            s3 = S3ApiServer(f.url, f.grpc_address, port=args.s3Port, host=args.ip)
+            s3.start()
+            extras.append(s3)
+            parts.append(f"s3 {s3.url}")
+        if args.webdav:
+            from seaweedfs_tpu.webdav import WebDavServer
+
+            w = WebDavServer(f.url, f.grpc_address, port=args.webdavPort, host=args.ip)
+            w.start()
+            extras.append(w)
+            parts.append(f"webdav {w.url}")
+    print("server: " + ", ".join(parts))
     _wait_forever()
+    for srv in reversed(extras):
+        srv.stop()
     vs.stop()
     m.stop()
     return 0
