@@ -1,5 +1,9 @@
 """`python -m seaweedfs_tpu <command>` — the `weed`-style single entry point
-(ref: weed/command CLI layout, SURVEY.md §2.1 [VERIFY: mount empty])."""
+(ref: weed/command CLI layout, SURVEY.md §2.1 [VERIFY: mount empty]).
+
+Every command accepts -cpuprofile/-memprofile (the reference's pprof
+flags, SURVEY.md §5): cProfile stats / tracemalloc snapshot written on
+exit."""
 
 from __future__ import annotations
 
@@ -19,16 +23,39 @@ def main(argv=None) -> int:
     for cmd in cmds.values():
         p = sub.add_parser(cmd.name, help=cmd.help)
         cmd.configure(p)
+        p.add_argument("-cpuprofile", default="", help="write cProfile stats here on exit")
+        p.add_argument("-memprofile", default="", help="write a tracemalloc snapshot here on exit")
         p.set_defaults(_run=cmd.run)
     args = parser.parse_args(argv)
     if not getattr(args, "_run", None):
         parser.print_help()
         return 2
+    profiler = None
+    if getattr(args, "cpuprofile", ""):
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+    if getattr(args, "memprofile", ""):
+        import tracemalloc
+
+        tracemalloc.start()
     try:
         return args._run(args)
     except (OSError, ValueError, KeyError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(args.cpuprofile)
+        if getattr(args, "memprofile", ""):
+            import tracemalloc
+
+            snap = tracemalloc.take_snapshot()
+            with open(args.memprofile, "w", encoding="utf-8") as f:
+                for stat in snap.statistics("lineno")[:200]:
+                    f.write(str(stat) + "\n")
 
 
 if __name__ == "__main__":

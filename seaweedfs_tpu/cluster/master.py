@@ -176,7 +176,35 @@ class MasterServer:
         svc.add("Statistics", self._rpc_statistics)
         svc.add("LeaseAdminToken", self._rpc_lease_admin_token)
         svc.add("ReleaseAdminToken", self._rpc_release_admin_token)
+        svc.add("FilerHeartbeat", self._rpc_filer_heartbeat)
+        svc.add("ListClusterNodes", self._rpc_list_cluster_nodes)
         return svc
+
+    # -- filer registry (cluster node list, master_grpc_server_cluster.go
+    # analog: filers announce themselves so shells/mounts can discover
+    # them through the master) -----------------------------------------------
+
+    FILER_TTL = 20.0
+
+    def _rpc_filer_heartbeat(self, req: dict, ctx) -> dict:
+        with self._admin_lock_mu:  # small table; reuse the mutex
+            if not hasattr(self, "_filers"):
+                self._filers = {}
+            self._filers[req["http_address"]] = (
+                req.get("grpc_address", ""),
+                time.monotonic(),
+            )
+        return {"leader": self._leader_address() or self.address}
+
+    def _rpc_list_cluster_nodes(self, req: dict, ctx) -> dict:
+        now = time.monotonic()
+        with self._admin_lock_mu:
+            filers = [
+                {"http_address": url, "grpc_address": grpc_addr}
+                for url, (grpc_addr, seen) in getattr(self, "_filers", {}).items()
+                if now - seen < self.FILER_TTL
+            ]
+        return {"filers": filers}
 
     # -- cluster exclusive lock (wdclient/exclusive_locks analog) -------------
     #

@@ -212,8 +212,28 @@ class Filer:
 
     # -- CRUD -----------------------------------------------------------------
 
+    @staticmethod
+    def _expired(e: Entry) -> bool:
+        ttl = e.attributes.ttl_sec
+        return ttl > 0 and not e.is_directory and e.attributes.mtime + ttl < time.time()
+
+    def _reap_expired(self, e: Entry) -> None:
+        """TTL'd entries are reaped lazily on access (the reference filer
+        does the same on read)."""
+        try:
+            if e.chunks and self.chunk_io is not None:
+                self.chunk_io.delete_chunks(e.chunks)
+            self.store.delete(e.path)
+            self._notify(e, None)
+        except Exception:  # noqa: BLE001 — best-effort; retried next access
+            pass
+
     def find_entry(self, path: str) -> Entry:
-        return self.store.find(path)
+        e = self.store.find(path)
+        if self._expired(e):
+            self._reap_expired(e)
+            raise EntryNotFound(path)
+        return e
 
     def exists(self, path: str) -> bool:
         try:
@@ -330,13 +350,20 @@ class Filer:
         limit: int = 1024,
         prefix: str = "",
     ) -> list[Entry]:
-        return self.store.list(
+        out = self.store.list(
             dir_path,
             start_from=start_from,
             include_start=include_start,
             limit=limit,
             prefix=prefix,
         )
+        live = []
+        for e in out:
+            if self._expired(e):
+                self._reap_expired(e)
+            else:
+                live.append(e)
+        return live
 
     def walk(self, dir_path: str = "/") -> Iterator[Entry]:
         """Depth-first traversal of the subtree (directories first)."""

@@ -71,6 +71,8 @@ class FilerServer:
         self._http.filer_server = self
         self.port = self._http.server_address[1]
         self._http_thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+        self._stop = threading.Event()
+        self._announce_thread = threading.Thread(target=self._announce_loop, daemon=True)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -85,13 +87,27 @@ class FilerServer:
     def start(self) -> None:
         self._grpc.start()
         self._http_thread.start()
+        self._announce_thread.start()
 
     def stop(self) -> None:
+        self._stop.set()
         self._http.shutdown()
         self._http.server_close()
         self._grpc.stop()
         self.master.close()
         self.filer.close()
+
+    def _announce_loop(self) -> None:
+        """Register with the master cluster-node list so shells/mounts
+        can discover filers (master_grpc_server_cluster.go analog)."""
+        req = {"http_address": self.url, "grpc_address": self.grpc_address}
+        while True:
+            try:
+                self.master.master_call("FilerHeartbeat", req, timeout=5)
+            except Exception:  # noqa: BLE001 — master down; retry
+                pass
+            if self._stop.wait(5.0):
+                return
 
     def __enter__(self):
         self.start()
@@ -122,6 +138,11 @@ class FilerServer:
         chunks = self.chunk_io.maybe_manifestize(
             chunks, collection=collection, replication=replication, ttl=ttl
         )
+        ttl_sec = 0
+        if ttl:
+            from seaweedfs_tpu.storage.super_block import TTL
+
+            ttl_sec = TTL.parse(ttl).minutes() * 60
         entry = Entry(
             path=path,
             is_directory=False,
@@ -131,6 +152,7 @@ class FilerServer:
                 mime=mime,
                 collection=collection,
                 replication=replication,
+                ttl_sec=ttl_sec,
                 md5=md5hex,
                 file_size=size,
             ),

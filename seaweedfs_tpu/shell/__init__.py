@@ -50,6 +50,7 @@ def commands() -> dict[str, ShellCommand]:
     # import for registration side effects
     from seaweedfs_tpu.shell import command_cluster  # noqa: F401
     from seaweedfs_tpu.shell import command_ec  # noqa: F401
+    from seaweedfs_tpu.shell import command_fs  # noqa: F401
     from seaweedfs_tpu.shell import command_volume  # noqa: F401
 
     return dict(_REGISTRY)
@@ -73,6 +74,9 @@ class CommandEnv:
                 self.unlock()
             except Exception:  # noqa: BLE001 — master may be gone
                 pass
+        fc = getattr(self, "_filer_client", None)
+        if fc is not None:
+            fc.close()
         self.client.close()
 
     def __enter__(self):
@@ -87,6 +91,21 @@ class CommandEnv:
         """Master RPC via MasterClient's single failover/redirect path
         (thread-safe: the lock renewer calls this concurrently)."""
         return self.client.master_call(method, req, timeout=timeout)
+
+    def filer_client(self):
+        """FilerClient for a filer discovered through the master's
+        cluster-node list (fs.* commands); cached per env."""
+        fc = getattr(self, "_filer_client", None)
+        if fc is not None:
+            return fc
+        filers = self.master_call("ListClusterNodes", {}).get("filers", [])
+        if not filers:
+            raise ShellError("no filer registered with the master")
+        from seaweedfs_tpu.filer.client import FilerClient
+
+        self._filer_client = FilerClient(filers[0]["grpc_address"])
+        self._filer_http = filers[0]["http_address"]
+        return self._filer_client
 
     def volume_list(self) -> dict:
         return self.master_call("VolumeList", {})

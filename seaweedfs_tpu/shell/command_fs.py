@@ -1,0 +1,234 @@
+"""Filesystem shell commands — fs.ls / fs.cat / fs.mkdir / fs.rm /
+fs.mv / fs.du / fs.meta.save / fs.meta.load, mirroring
+weed/shell/command_fs_*.go [VERIFY: mount empty; SURVEY.md §2.1 "Shell
+(ops)" row; fs.meta.save/load are the §5 metadata export/import
+checkpoint mechanism].
+
+The filer is discovered through the master's cluster-node list (filers
+announce themselves with FilerHeartbeat).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import TextIO
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.shell import (
+    CommandEnv,
+    ShellCommand,
+    ShellError,
+    register,
+)
+
+
+def _split(args: list[str], bools: set[str] = frozenset(), valued: set[str] = frozenset()):
+    """Split `-flag [value]` options from positional paths."""
+    flags: dict[str, object] = {b: False for b in bools}
+    flags.update({v: "" for v in valued})
+    positional: list[str] = []
+    it = iter(args)
+    for tok in it:
+        if not tok.startswith("-"):
+            positional.append(tok)
+            continue
+        name, _, inline = tok.lstrip("-").partition("=")
+        if name in bools:
+            flags[name] = True
+        elif name in valued:
+            if inline:
+                flags[name] = inline
+            else:
+                try:
+                    flags[name] = next(it)
+                except StopIteration:
+                    raise ShellError(f"flag -{name} needs a value") from None
+        else:
+            raise ShellError(f"unknown flag -{name}")
+    return flags, positional
+
+
+def _positional(args: list[str]) -> list[str]:
+    return _split(args)[1]
+
+
+def do_fs_ls(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    flags, paths = _split(args, bools={"l"})
+    paths = paths or ["/"]
+    fc = env.filer_client()
+    for path in paths:
+        entries = fc.list(path, limit=10000)
+        for e in entries:
+            if flags["l"]:
+                kind = "d" if e.is_directory else "-"
+                w.write(
+                    f"{kind} {e.size:>12} "
+                    f"{time.strftime('%Y-%m-%d %H:%M', time.localtime(e.attributes.mtime))} "
+                    f"{e.name}\n"
+                )
+            else:
+                w.write(e.name + ("/" if e.is_directory else "") + "\n")
+
+
+register(
+    ShellCommand(
+        "fs.ls",
+        "fs.ls [-l] [path ...]\n\tlist filer directory entries",
+        do_fs_ls,
+    )
+)
+
+
+def do_fs_cat(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    paths = _positional(args)
+    if not paths:
+        raise ShellError("fs.cat needs a path")
+    fc = env.filer_client()
+    for path in paths:
+        data = fc.read_file(path)
+        try:
+            w.write(data.decode())
+        except UnicodeDecodeError:
+            w.write(f"<{len(data)} binary bytes>\n")
+
+
+register(ShellCommand("fs.cat", "fs.cat <path ...>\n\tprint file contents", do_fs_cat))
+
+
+def do_fs_mkdir(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    paths = _positional(args)
+    if not paths:
+        raise ShellError("fs.mkdir needs a path")
+    fc = env.filer_client()
+    for path in paths:
+        fc.create(Entry(path=path, is_directory=True))
+        w.write(f"created {path}\n")
+
+
+register(ShellCommand("fs.mkdir", "fs.mkdir <path ...>\n\tcreate directories", do_fs_mkdir))
+
+
+def do_fs_rm(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    flags, paths = _split(args, bools={"r"})
+    if not paths:
+        raise ShellError("fs.rm needs a path")
+    fc = env.filer_client()
+    for path in paths:
+        fc.delete(path, recursive=bool(flags["r"]))
+        w.write(f"removed {path}\n")
+
+
+register(
+    ShellCommand(
+        "fs.rm", "fs.rm [-r] <path ...>\n\tdelete files/directories", do_fs_rm
+    )
+)
+
+
+def do_fs_mv(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    paths = _positional(args)
+    if len(paths) != 2:
+        raise ShellError("fs.mv needs <src> <dst>")
+    env.filer_client().rename(paths[0], paths[1])
+    w.write(f"moved {paths[0]} -> {paths[1]}\n")
+
+
+register(ShellCommand("fs.mv", "fs.mv <src> <dst>\n\tmove/rename an entry", do_fs_mv))
+
+
+def do_fs_du(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    paths = _positional(args) or ["/"]
+    fc = env.filer_client()
+
+    def walk(path: str) -> tuple[int, int]:
+        files, size = 0, 0
+        start = ""
+        while True:
+            batch = fc.list(path, start_from=start, limit=1024)
+            if not batch:
+                break
+            for e in batch:
+                if e.is_directory:
+                    f2, s2 = walk(e.path)
+                    files += f2
+                    size += s2
+                else:
+                    files += 1
+                    size += e.size
+            start = batch[-1].name
+        return files, size
+
+    for path in paths:
+        files, size = walk(path)
+        w.write(f"{path}: {files} files, {size} bytes\n")
+
+
+register(ShellCommand("fs.du", "fs.du [path ...]\n\tdisk usage of a subtree", do_fs_du))
+
+
+def do_fs_meta_save(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Export filer metadata (entries incl. chunk lists) as JSONL —
+    the §5 checkpoint/backup mechanism (fs.meta.save analog)."""
+    flags, roots = _split(args, valued={"o"})
+    if not flags["o"]:
+        raise ShellError("fs.meta.save needs -o <file>")
+    roots = roots or ["/"]
+    fc = env.filer_client()
+    count = 0
+    with open(flags["o"], "w", encoding="utf-8") as f:
+
+        def walk(path: str) -> None:
+            nonlocal count
+            start = ""
+            while True:
+                batch = fc.list(path, start_from=start, limit=1024)
+                if not batch:
+                    break
+                for e in batch:
+                    f.write(json.dumps(e.to_dict()) + "\n")
+                    count += 1
+                    if e.is_directory:
+                        walk(e.path)
+                start = batch[-1].name
+
+        for r in roots:
+            walk(r)
+    w.write(f"saved {count} entries to {flags['o']}\n")
+
+
+register(
+    ShellCommand(
+        "fs.meta.save",
+        "fs.meta.save -o <file> [root ...]\n\texport filer metadata as JSONL",
+        do_fs_meta_save,
+    )
+)
+
+
+def do_fs_meta_load(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Import metadata saved by fs.meta.save. Entries point at the SAME
+    chunk fids — a namespace restore, not a data copy (matching the
+    reference's fs.meta.load)."""
+    flags, _ = _split(args, valued={"i"})
+    if not flags["i"]:
+        raise ShellError("fs.meta.load needs -i <file>")
+    fc = env.filer_client()
+    count = 0
+    with open(flags["i"], encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            fc.create(Entry.from_dict(json.loads(line)))
+            count += 1
+    w.write(f"loaded {count} entries from {flags['i']}\n")
+
+
+register(
+    ShellCommand(
+        "fs.meta.load",
+        "fs.meta.load -i <file>\n\trestore filer metadata from a fs.meta.save dump",
+        do_fs_meta_load,
+    )
+)
