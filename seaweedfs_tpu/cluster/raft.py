@@ -147,9 +147,15 @@ class RaftNode:
         with self._lock:
             if term < self.term:
                 return {"term": self.term, "ok": False}
-            if term > self.term or self.state != FOLLOWER:
+            if term > self.term:
                 self.term = term
                 self.voted_for = None
+                self.state = FOLLOWER
+                self._save_state()
+            elif self.state != FOLLOWER:
+                # equal-term step-down (candidate lost the race): keep
+                # voted_for — clearing it would allow a second vote in the
+                # same term, breaking Raft's one-vote-per-term invariant
                 self.state = FOLLOWER
                 self._save_state()
             self.leader = leader

@@ -17,6 +17,7 @@ from typing import Optional
 
 from seaweedfs_tpu.filer.client import FilerClient
 from seaweedfs_tpu.s3api.auth import (
+    ACTION_ADMIN,
     Iam,
     Identity,
     load_identities,
@@ -118,6 +119,41 @@ class _Handler(httpd.QuietHandler):
         if raw is None:
             self.reply_length_required()
             return
+        # every IAM action requires SigV4 auth by an Admin identity —
+        # an unauthenticated caller could otherwise mint Admin
+        # credentials (PutUserPolicy s3:*) that the S3 gateway honors.
+        # Bootstrap: while NO identity has credentials yet there is
+        # nothing to sign with, so the API is open exactly long enough
+        # to create the first admin (CreateUser → PutUserPolicy s3:* →
+        # CreateAccessKey); the first minted key locks it. Before
+        # honoring the open window, re-read the filer KV: an S3 gateway
+        # may have seeded identities there after this server started.
+        if not any(i.access_key for i in self.srv.iam.identities):
+            with self.srv.lock:
+                fresh = load_identities(self.srv.filer)
+                if fresh is not None and any(i.access_key for i in fresh.identities):
+                    keys = {i.access_key for i in fresh.identities if i.access_key}
+                    names = {i.name for i in fresh.identities}
+                    self.srv.iam.identities = fresh.identities + [
+                        i
+                        for i in self.srv.iam.identities
+                        if i.access_key not in keys
+                        and (i.access_key or i.name not in names)
+                    ]
+        if any(i.access_key for i in self.srv.iam.identities):
+            u = urllib.parse.urlparse(self.path)
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            identity, err = self.srv.iam.authenticate(
+                "POST", urllib.parse.unquote(u.path) or "/", u.query, headers, raw
+            )
+            if identity is None:
+                code, body = _error(403, err or "AccessDenied")
+                self.send_reply(code, body, "text/xml")
+                return
+            if not identity.can_do(ACTION_ADMIN):
+                code, body = _error(403, "AccessDenied", "Admin privileges required")
+                self.send_reply(code, body, "text/xml")
+                return
         form = {
             k: v[0] for k, v in urllib.parse.parse_qs(raw.decode()).items()
         }

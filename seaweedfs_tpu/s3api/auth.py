@@ -32,7 +32,6 @@ ACTION_WRITE = "Write"
 ACTION_LIST = "List"
 
 _ALGO = "AWS4-HMAC-SHA256"
-_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 
 @dataclass
@@ -143,7 +142,14 @@ class Iam:
         if abs(time.time() - req_ts) > _MAX_SKEW_S:  # replayed/stale request
             return None, "RequestTimeTooSkewed"
         payload_hash = payload_decl
-        if payload_hash not in ("", "UNSIGNED-PAYLOAD"):
+        # AWS requires x-amz-content-sha256 on every signed S3 request,
+        # and it must itself be signed: an absent or unsigned header lets
+        # a captured signature be replayed with a substituted body
+        if not payload_hash:
+            return None, "MissingSecurityHeader"
+        if "x-amz-content-sha256" not in signed_headers:
+            return None, "InvalidRequest"
+        if payload_hash != "UNSIGNED-PAYLOAD":
             if hashlib.sha256(payload).hexdigest() != payload_hash:
                 return None, "XAmzContentSHA256Mismatch"
         want = _signature(
@@ -153,7 +159,7 @@ class Iam:
             query,
             headers,
             signed_headers,
-            payload_hash or _EMPTY_SHA256,
+            payload_hash,
             amz_date,
             region,
             service,
@@ -281,6 +287,9 @@ def save_identities(kv, iam: Iam) -> None:
 
 def load_identities(kv) -> Optional[Iam]:
     raw = kv.kv_get(_KV_KEY)
-    if raw is None:
+    if not raw:
         return None
-    return Iam.from_config(json.loads(raw.decode()))
+    try:
+        return Iam.from_config(json.loads(raw.decode()))
+    except ValueError:  # malformed KV must not kill auth plumbing
+        return None

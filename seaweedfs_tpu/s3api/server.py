@@ -45,12 +45,26 @@ from seaweedfs_tpu.s3api.auth import (
     ACTION_ADMIN,
     Iam,
     load_identities,
+    save_identities,
 )
 from seaweedfs_tpu.utils import httpd
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_ROOT = "/buckets/.uploads"
 _XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _valid_path(bucket: str, key: str) -> bool:
+    """Reject bucket/key pairs whose filer path would normalize outside
+    /buckets/<bucket>/ — '.'/'..'/empty segments and dot-prefixed bucket
+    names (which would collide with the .uploads staging area)."""
+    if bucket.startswith("."):
+        return False
+    segs = key.split("/") if key else []
+    if any(s in ("", ".", "..") for s in segs[:-1]):
+        return False
+    # a single trailing "" segment is a folder-marker key ("a/b/")
+    return not (segs and segs[-1] in (".", ".."))
 
 
 class S3ApiServer:
@@ -82,6 +96,17 @@ class S3ApiServer:
 
         if self.filer.lookup(BUCKETS_ROOT) is None:
             self.filer.create(_E(path=BUCKETS_ROOT, is_directory=True))
+        # seed the filer KV (the cluster-wide identity root the IAM API
+        # serves) with the file-configured identities: otherwise the IAM
+        # API sees an empty KV, stays in its open bootstrap window, and
+        # an unauthenticated caller can mint an admin this gateway would
+        # honor on its next KV reload
+        if not self.iam.open:
+            existing = load_identities(self.filer)
+            if existing is None or not any(
+                i.access_key for i in existing.identities
+            ):
+                save_identities(self.filer, self.iam)
         self._thread.start()
 
     def stop(self) -> None:
@@ -183,11 +208,18 @@ class _Handler(httpd.QuietHandler):
 
     # -- plumbing -------------------------------------------------------------
 
-    def _parse(self) -> tuple[str, str, dict]:
+    def _parse(self) -> Optional[tuple[str, str, dict]]:
+        """Parse /bucket/key?query. Returns None (after replying 400) for
+        paths with '.'/'..'/empty segments — the filer normalizes paths,
+        so an un-rejected '..' would let a bucket-scoped identity escape
+        its bucket (the reference validates object names the same way)."""
         u = urllib.parse.urlparse(self.path)
         parts = urllib.parse.unquote(u.path).lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
+        if not _valid_path(bucket, key):
+            self._error(400, "InvalidArgument", "invalid bucket or object name")
+            return None
         q = {k: v[0] for k, v in urllib.parse.parse_qs(u.query, keep_blank_values=True).items()}
         return bucket, key, q
 
@@ -245,7 +277,10 @@ class _Handler(httpd.QuietHandler):
     # -- dispatch -------------------------------------------------------------
 
     def do_GET(self):
-        bucket, key, q = self._parse()
+        parsed = self._parse()
+        if parsed is None:
+            return
+        bucket, key, q = parsed
         if not bucket:
             stats.S3RequestCounter.labels("ListBuckets").inc()
             if self._auth(ACTION_LIST, "", b""):
@@ -269,7 +304,10 @@ class _Handler(httpd.QuietHandler):
             self._get_object(bucket, key, head=False)
 
     def do_HEAD(self):
-        bucket, key, q = self._parse()
+        parsed = self._parse()
+        if parsed is None:
+            return
+        bucket, key, q = parsed
         if not key:
             if self._auth(ACTION_READ, bucket, b""):
                 if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
@@ -281,7 +319,10 @@ class _Handler(httpd.QuietHandler):
             self._get_object(bucket, key, head=True)
 
     def do_PUT(self):
-        bucket, key, q = self._parse()
+        parsed = self._parse()
+        if parsed is None:
+            return
+        bucket, key, q = parsed
         body = self._body()
         if body is None:
             return
@@ -305,7 +346,10 @@ class _Handler(httpd.QuietHandler):
             self._put_object(bucket, key, body)
 
     def do_POST(self):
-        bucket, key, q = self._parse()
+        parsed = self._parse()
+        if parsed is None:
+            return
+        bucket, key, q = parsed
         body = self._body()
         if body is None:
             return
@@ -327,7 +371,10 @@ class _Handler(httpd.QuietHandler):
         self._error(400, "InvalidRequest")
 
     def do_DELETE(self):
-        bucket, key, q = self._parse()
+        parsed = self._parse()
+        if parsed is None:
+            return
+        bucket, key, q = parsed
         if not key:
             stats.S3RequestCounter.labels("DeleteBucket").inc()
             if self._auth(ACTION_ADMIN, bucket, b""):
@@ -538,6 +585,14 @@ class _Handler(httpd.QuietHandler):
         if src.startswith("/"):
             src = src[1:]
         s_bucket, _, s_key = src.partition("/")
+        if not s_key or not _valid_path(s_bucket, s_key):
+            self._error(400, "InvalidArgument", "invalid copy source")
+            return
+        # the caller proved Write on the destination; reading the source
+        # bucket needs its own grant (copy body is empty, so re-verifying
+        # the signature against b"" matches the original request)
+        if not self._auth(ACTION_READ, s_bucket, b""):
+            return
         s_entry = self.s3.filer.lookup(self.s3.object_path(s_bucket, s_key))
         if s_entry is None:
             self._error(404, "NoSuchKey", src)
@@ -586,6 +641,11 @@ class _Handler(httpd.QuietHandler):
         for obj in tree.findall(f"{ns}Object"):
             key_el = obj.find(f"{ns}Key")
             if key_el is None or not key_el.text:
+                continue
+            if not _valid_path(bucket, key_el.text):
+                err = _sub(root, "Error")
+                _sub(err, "Key", key_el.text)
+                _sub(err, "Code", "InvalidArgument")
                 continue
             try:
                 self.s3.filer.delete(self.s3.object_path(bucket, key_el.text))
@@ -664,13 +724,38 @@ class _Handler(httpd.QuietHandler):
         if dir_entry is None:
             self._error(404, "NoSuchUpload")
             return
-        parts = sorted(
-            (e for e in self.s3.filer.list(d, limit=10000) if e.name.startswith("part")),
-            key=lambda e: e.name,
-        )
-        if not parts:
+        staged = {
+            int(e.name[4:]): e
+            for e in self.s3.filer.list(d, limit=10000)
+            if e.name.startswith("part")
+        }
+        # S3 commits exactly the parts the client lists, validating
+        # ETags and ascending order — never just "everything staged"
+        try:
+            tree = ET.fromstring(body)
+        except ET.ParseError:
+            self._error(400, "MalformedXML")
+            return
+        ns = tree.tag[: tree.tag.index("}") + 1] if tree.tag.startswith("{") else ""
+        req_parts: list[tuple[int, str]] = []
+        for pe in tree.findall(f"{ns}Part"):
+            num_el, etag_el = pe.find(f"{ns}PartNumber"), pe.find(f"{ns}ETag")
+            num = httpd.safe_int(num_el.text if num_el is not None else None, -1)
+            etag = (etag_el.text or "").strip().strip('"') if etag_el is not None else ""
+            req_parts.append((num, etag))
+        if not req_parts:
             self._error(400, "InvalidPart")
             return
+        nums = [n for n, _ in req_parts]
+        if nums != sorted(nums) or len(set(nums)) != len(nums):
+            self._error(400, "InvalidPartOrder")
+            return
+        for num, etag in req_parts:
+            e = staged.get(num)
+            if e is None or (etag and etag != e.attributes.md5):
+                self._error(400, "InvalidPart", f"part {num}")
+                return
+        parts = [staged[n] for n in nums]
         # splice part chunk lists; no data copy (filer_multipart.go pattern)
         chunks: list[FileChunk] = []
         offset = 0

@@ -217,6 +217,7 @@ def test_multipart_upload(stack):
     upload_id = _xml(body).find(
         "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId").text
     parts = [os.urandom(1024 * 1024 + 7), os.urandom(512 * 1024), os.urandom(99)]
+    etags = []
     for i, p in enumerate(parts, start=1):
         code, headers, _ = _req(
             s3, "PUT", "/mp/big.bin", p,
@@ -224,12 +225,32 @@ def test_multipart_upload(stack):
         )
         assert code == 200
         assert headers["ETag"].strip('"') == hashlib.md5(p).hexdigest()
+        etags.append(headers["ETag"])
     # list parts
     code, _, body = _req(s3, "GET", "/mp/big.bin", query=f"uploadId={upload_id}")
     assert code == 200 and body.count(b"<Part>") == 3
-    # complete
-    code, _, body = _req(s3, "POST", "/mp/big.bin", b"<CompleteMultipartUpload/>",
+    # complete validates the client's part list: wrong ETag rejected
+    bad = ("<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+           "<ETag>deadbeef</ETag></Part></CompleteMultipartUpload>").encode()
+    code, _, body = _req(s3, "POST", "/mp/big.bin", bad,
                          query=f"uploadId={upload_id}")
+    assert code == 400 and b"InvalidPart" in body
+    # out-of-order part list rejected
+    ooo = ("<CompleteMultipartUpload>"
+           f"<Part><PartNumber>2</PartNumber><ETag>{etags[1]}</ETag></Part>"
+           f"<Part><PartNumber>1</PartNumber><ETag>{etags[0]}</ETag></Part>"
+           "</CompleteMultipartUpload>").encode()
+    code, _, body = _req(s3, "POST", "/mp/big.bin", ooo,
+                         query=f"uploadId={upload_id}")
+    assert code == 400 and b"InvalidPartOrder" in body
+    payload = "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{t}</ETag></Part>"
+        for i, t in enumerate(etags, start=1)
+    )
+    code, _, body = _req(
+        s3, "POST", "/mp/big.bin",
+        f"<CompleteMultipartUpload>{payload}</CompleteMultipartUpload>".encode(),
+        query=f"uploadId={upload_id}")
     assert code == 200 and b"CompleteMultipartUploadResult" in body
     code, headers, got = _req(s3, "GET", "/mp/big.bin")
     assert code == 200 and got == b"".join(parts)
@@ -281,3 +302,57 @@ def test_action_scoping(stack):
         urllib.request.urlopen(req, timeout=10)
     assert ei.value.code == 403
     s3.iam.remove("roKey")
+
+
+def test_path_traversal_rejected(stack):
+    s3 = stack
+    _req(s3, "PUT", "/trav")
+    _req(s3, "PUT", "/trav/secret.txt", b"top secret")
+    # '.'/'..'/empty segments anywhere in bucket or key -> 400, never
+    # resolved through the filer's path normalization
+    for path in ("/trav/../trav/secret.txt", "/trav/a/../secret.txt",
+                 "/../buckets/trav/secret.txt", "/trav/..", "/trav/./x"):
+        code, _, body = _req(s3, "GET", path)
+        assert code == 400 and b"InvalidArgument" in body, path
+    code, _, _ = _req(s3, "PUT", "/trav/a//b", b"d")
+    assert code == 400
+    # dot-prefixed buckets (the .uploads staging area) are unreachable
+    code, _, _ = _req(s3, "GET", "/.uploads", query="list-type=2")
+    assert code == 400
+    # bulk delete validates keys from the XML body as well
+    xml_body = b'<Delete><Object><Key>../other/x</Key></Object></Delete>'
+    code, _, resp = _req(s3, "POST", "/trav", xml_body, query="delete=")
+    assert code == 200 and b"<Error>" in resp and b"<Deleted>" not in resp
+    # copy-source traversal rejected
+    code, _, _ = _req(s3, "PUT", "/trav/copy.txt",
+                      headers={"x-amz-copy-source": "/trav/../trav/secret.txt"})
+    assert code == 400
+    # the original object is still readable through the legitimate path
+    code, _, got = _req(s3, "GET", "/trav/secret.txt")
+    assert code == 200 and got == b"top secret"
+
+
+def test_content_sha256_required(stack):
+    s3 = stack
+    _req(s3, "PUT", "/shabkt")
+    url = f"http://{s3.url}/shabkt/f.txt"
+    body = b"payload"
+    # a signed request whose x-amz-content-sha256 header is stripped (and
+    # removed from SignedHeaders) must be rejected, not verified against
+    # the empty-payload hash
+    h = sign_request(AK, SK, "PUT", url, body)
+    h.pop("x-amz-content-sha256")
+    signed = [s for s in ("host", "x-amz-date") ]
+    # re-sign without the header so only its absence is under test
+    from seaweedfs_tpu.s3api import auth as auth_mod
+    amz_date = h["x-amz-date"]
+    sig = auth_mod._signature(SK, "PUT", "/shabkt/f.txt", "", h, signed,
+                              "UNSIGNED-PAYLOAD", amz_date, "us-east-1", "s3")
+    scope = f"{amz_date[:8]}/us-east-1/s3/aws4_request"
+    h["authorization"] = (f"AWS4-HMAC-SHA256 Credential={AK}/{scope}, "
+                          f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    req = urllib.request.Request(url, data=body, method="PUT", headers=h)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 403
+    assert b"MissingSecurityHeader" in ei.value.read()

@@ -91,9 +91,14 @@ def test_webdav_lifecycle(stack):
     assert _req(base, "PROPFIND", "/davdir")[0] == 404
 
 
-def _iam_call(url, **form):
+def _iam_call(url, creds=None, **form):
+    from seaweedfs_tpu.s3api.auth import sign_request
+
     data = urllib.parse.urlencode(form).encode()
-    req = urllib.request.Request(url, data=data, method="POST")
+    headers = {}
+    if creds:
+        headers = sign_request(creds[0], creds[1], "POST", url, data, service="iam")
+    req = urllib.request.Request(url, data=data, method="POST", headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=30) as r:
             return r.status, r.read()
@@ -104,27 +109,46 @@ def _iam_call(url, **form):
 def test_iam_user_and_key_lifecycle(stack):
     fs, _, iam = stack
     url = f"http://{iam.url}/"
-    code, body = _iam_call(url, Action="CreateUser", UserName="alice")
-    assert code == 200 and b"alice" in body
-    code, body = _iam_call(url, Action="CreateAccessKey", UserName="alice")
-    assert code == 200
     ns = "{https://iam.amazonaws.com/doc/2010-05-08/}"
-    root = ET.fromstring(body)
-    ak = root.find(f".//{ns}AccessKeyId").text
-    sk = root.find(f".//{ns}SecretAccessKey").text
+    # bootstrap window: no identity has credentials yet, so unsigned
+    # calls work exactly long enough to mint the first admin
+    code, _ = _iam_call(url, Action="CreateUser", UserName="root")
+    assert code == 200
+    code, _ = _iam_call(url, Action="PutUserPolicy", UserName="root",
+                        PolicyDocument='{"Statement": [{"Effect": "Allow", '
+                                       '"Action": "s3:*", "Resource": "*"}]}')
+    assert code == 200
+    code, body = _iam_call(url, Action="CreateAccessKey", UserName="root")
+    assert code == 200
+    root_el = ET.fromstring(body)
+    admin = (root_el.find(f".//{ns}AccessKeyId").text,
+             root_el.find(f".//{ns}SecretAccessKey").text)
+    # the first minted key locks the API: unsigned mutations now 403
+    code, _ = _iam_call(url, Action="CreateUser", UserName="eve")
+    assert code == 403
+    code, body = _iam_call(url, admin, Action="CreateUser", UserName="alice")
+    assert code == 200 and b"alice" in body
+    code, body = _iam_call(url, admin, Action="CreateAccessKey", UserName="alice")
+    assert code == 200
+    doc = ET.fromstring(body)
+    ak = doc.find(f".//{ns}AccessKeyId").text
+    sk = doc.find(f".//{ns}SecretAccessKey").text
     assert ak and sk
     # policy -> action mapping
     policy = (
         '{"Statement": [{"Effect": "Allow", "Action": ["s3:GetObject", '
         '"s3:ListBucket"], "Resource": "arn:aws:s3:::mybucket/*"}]}'
     )
-    code, _ = _iam_call(url, Action="PutUserPolicy", UserName="alice",
+    code, _ = _iam_call(url, admin, Action="PutUserPolicy", UserName="alice",
                         PolicyDocument=policy)
     assert code == 200
     ident = iam.iam.lookup(ak)
     assert ident is not None
     assert ident.actions == ["List:mybucket", "Read:mybucket"]
     assert ident.can_do("Read", "mybucket") and not ident.can_do("Read", "other")
+    # a valid signature without Admin privileges is still rejected
+    code, _ = _iam_call(url, (ak, sk), Action="CreateUser", UserName="mallory")
+    assert code == 403
     # identities persisted to filer kv: reload sees alice
     from seaweedfs_tpu.filer.client import FilerClient
 
@@ -132,13 +156,13 @@ def test_iam_user_and_key_lifecycle(stack):
         loaded = load_identities(fc)
     assert loaded is not None and loaded.lookup(ak) is not None
     # list/get/delete
-    code, body = _iam_call(url, Action="ListUsers")
+    code, body = _iam_call(url, admin, Action="ListUsers")
     assert b"alice" in body
-    code, _ = _iam_call(url, Action="DeleteAccessKey", AccessKeyId=ak)
+    code, _ = _iam_call(url, admin, Action="DeleteAccessKey", AccessKeyId=ak)
     assert code == 200
-    code, _ = _iam_call(url, Action="DeleteUser", UserName="alice")
+    code, _ = _iam_call(url, admin, Action="DeleteUser", UserName="alice")
     assert code == 200
-    code, _ = _iam_call(url, Action="GetUser", UserName="alice")
+    code, _ = _iam_call(url, admin, Action="GetUser", UserName="alice")
     assert code == 404
-    code, _ = _iam_call(url, Action="BogusAction")
+    code, _ = _iam_call(url, admin, Action="BogusAction")
     assert code == 400
