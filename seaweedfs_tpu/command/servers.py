@@ -148,6 +148,12 @@ def _server_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-s3Port", type=int, default=8333)
     p.add_argument("-webdav", action="store_true", help="also run WebDAV (implies -filer)")
     p.add_argument("-webdavPort", type=int, default=7333)
+    p.add_argument(
+        "-allowedHosts",
+        default="",
+        help="comma-separated advertised host:port names accepted as the "
+        "signed Host header by the S3 gateway besides the bind address",
+    )
 
 
 def _server_run(args: argparse.Namespace) -> int:
@@ -176,7 +182,13 @@ def _server_run(args: argparse.Namespace) -> int:
         if args.s3:
             from seaweedfs_tpu.s3api import S3ApiServer
 
-            s3 = S3ApiServer(f.url, f.grpc_address, port=args.s3Port, host=args.ip)
+            s3 = S3ApiServer(
+                f.url,
+                f.grpc_address,
+                port=args.s3Port,
+                host=args.ip,
+                extra_hosts={h.strip() for h in args.allowedHosts.split(",") if h.strip()},
+            )
             s3.start()
             extras.append(s3)
             parts.append(f"s3 {s3.url}")
@@ -251,6 +263,12 @@ def _s3_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-filerGrpc", default="", help="filer grpc host:port (default: ask filer)")
     p.add_argument("-config", default="", help="identities JSON file (reference -s3.config shape)")
     p.add_argument("-metricsPort", type=int, default=0)
+    p.add_argument(
+        "-allowedHosts",
+        default="",
+        help="comma-separated advertised host:port names (DNS/LB fronts) "
+        "accepted as the signed Host header besides the bind address",
+    )
 
 
 def _s3_run(args: argparse.Namespace) -> int:
@@ -281,7 +299,12 @@ def _s3_run(args: argparse.Namespace) -> int:
         # reference's; here we require it explicitly unless colocated
         raise SystemExit("-filerGrpc is required")
     s3 = S3ApiServer(
-        args.filer, grpc_addr, port=args.port, host=args.ip, iam=iam
+        args.filer,
+        grpc_addr,
+        port=args.port,
+        host=args.ip,
+        iam=iam,
+        extra_hosts={h.strip() for h in args.allowedHosts.split(",") if h.strip()},
     )
     s3.start()
     _maybe_metrics(args.metricsPort)
@@ -324,6 +347,19 @@ def _iam_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-port", type=int, default=8111)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-filerGrpc", default="", help="filer grpc host:port")
+    p.add_argument(
+        "-bootstrapToken",
+        default="",
+        help="pre-shared token allowing the first admin to be minted on a "
+        "fresh cluster; without it the API stays closed until identities "
+        "are seeded via config or the S3 gateway",
+    )
+    p.add_argument(
+        "-allowedHosts",
+        default="",
+        help="comma-separated advertised host:port names accepted as the "
+        "signed Host header besides the bind address",
+    )
 
 
 def _iam_run(args: argparse.Namespace) -> int:
@@ -331,7 +367,13 @@ def _iam_run(args: argparse.Namespace) -> int:
 
     if not args.filerGrpc:
         raise SystemExit("-filerGrpc is required")
-    srv = IamApiServer(args.filerGrpc, port=args.port, host=args.ip)
+    srv = IamApiServer(
+        args.filerGrpc,
+        port=args.port,
+        host=args.ip,
+        bootstrap_token=args.bootstrapToken or None,
+        extra_hosts={h.strip() for h in args.allowedHosts.split(",") if h.strip()},
+    )
     srv.start()
     print(f"iam api on {srv.url}")
     _wait_forever()

@@ -52,14 +52,25 @@ class IamApiServer:
         iam: Optional[Iam] = None,
         port: int = 0,
         host: str = "127.0.0.1",
+        bootstrap_token: Optional[str] = None,
+        extra_hosts: Optional[set[str]] = None,
     ):
         self.filer = FilerClient(filer_grpc_address)
         self.iam = iam if iam is not None else (load_identities(self.filer) or Iam())
         self.host = host
+        self.extra_hosts = set(extra_hosts or ())
+        # pre-shared secret gating the fresh-cluster bootstrap: with no
+        # credentialed identity yet, only a caller presenting this token
+        # may mint the first admin. Without a token configured the API is
+        # CLOSED until identities arrive via config/S3 seeding — never
+        # first-come-first-served (the reference has no open window at
+        # all; its identities come from config).
+        self.bootstrap_token = bootstrap_token
         self.lock = threading.Lock()  # identities list is shared state
         self._http = _ThreadingHTTPServer((host, port), _Handler)
         self._http.iam_server = self
         self.port = self._http.server_address[1]
+        self.extra_hosts |= {f"{h}:{self.port}" for h in httpd.loopback_aliases(host)}
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
 
     @property
@@ -122,13 +133,21 @@ class _Handler(httpd.QuietHandler):
         # every IAM action requires SigV4 auth by an Admin identity —
         # an unauthenticated caller could otherwise mint Admin
         # credentials (PutUserPolicy s3:*) that the S3 gateway honors.
-        # Bootstrap: while NO identity has credentials yet there is
-        # nothing to sign with, so the API is open exactly long enough
-        # to create the first admin (CreateUser → PutUserPolicy s3:* →
-        # CreateAccessKey); the first minted key locks it. Before
-        # honoring the open window, re-read the filer KV: an S3 gateway
-        # may have seeded identities there after this server started.
-        if not any(i.access_key for i in self.srv.iam.identities):
+        # The gate keys on "a credentialed ADMIN exists", not "any
+        # credential exists": bootstrapping in the AWS-natural order
+        # (CreateUser → CreateAccessKey → PutUserPolicy) mints a key
+        # with empty actions first, and gating on any-credential would
+        # close the token path at that moment with no admin to sign as —
+        # locking the API permanently. Fresh cluster: before deciding,
+        # re-read the filer KV — an S3 gateway may have seeded
+        # identities there after this server started.
+        def _has_admin() -> bool:
+            return any(
+                i.access_key and i.can_do(ACTION_ADMIN)
+                for i in self.srv.iam.identities
+            )
+
+        if not _has_admin():
             with self.srv.lock:
                 fresh = load_identities(self.srv.filer)
                 if fresh is not None and any(i.access_key for i in fresh.identities):
@@ -140,11 +159,13 @@ class _Handler(httpd.QuietHandler):
                         if i.access_key not in keys
                         and (i.access_key or i.name not in names)
                     ]
-        if any(i.access_key for i in self.srv.iam.identities):
+        if _has_admin():
             u = urllib.parse.urlparse(self.path)
             headers = {k.lower(): v for k, v in self.headers.items()}
             identity, err = self.srv.iam.authenticate(
-                "POST", urllib.parse.unquote(u.path) or "/", u.query, headers, raw
+                "POST", urllib.parse.unquote(u.path) or "/", u.query, headers, raw,
+                expect_service="iam",
+                expect_hosts={self.srv.url} | self.srv.extra_hosts,
             )
             if identity is None:
                 code, body = _error(403, err or "AccessDenied")
@@ -152,6 +173,25 @@ class _Handler(httpd.QuietHandler):
                 return
             if not identity.can_do(ACTION_ADMIN):
                 code, body = _error(403, "AccessDenied", "Admin privileges required")
+                self.send_reply(code, body, "text/xml")
+                return
+        else:
+            # bootstrap: nothing to sign with yet. Gate admin minting on
+            # the pre-shared token; with no token configured the API is
+            # closed — first-to-reach-the-port must never become Admin.
+            import hmac as _hmac
+
+            presented = self.headers.get("x-seaweedfs-bootstrap-token", "")
+            if not self.srv.bootstrap_token or not _hmac.compare_digest(
+                presented, self.srv.bootstrap_token
+            ):
+                code, body = _error(
+                    403,
+                    "AccessDenied",
+                    "no credentialed identities yet; bootstrap requires the "
+                    "pre-shared token (-iam.bootstrapToken) or config/S3-seeded "
+                    "identities",
+                )
                 self.send_reply(code, body, "text/xml")
                 return
         form = {
@@ -203,10 +243,26 @@ class _Handler(httpd.QuietHandler):
         ET.SubElement(user, "UserName").text = name
         return 200, _resp("CreateUser", user)
 
+    def _would_drop_last_admin(self, doomed) -> bool:
+        """True when removing/revoking `doomed` identities leaves no
+        credentialed Admin — which would silently re-open the bootstrap
+        gate on a live cluster."""
+        doomed_ids = {id(i) for i in doomed}
+        return not any(
+            i.access_key and i.can_do(ACTION_ADMIN)
+            for i in self.srv.iam.identities
+            if id(i) not in doomed_ids
+        )
+
     def _do_DeleteUser(self, form):
         name = form.get("UserName", "")
-        if not self._find_by_name(name):
+        matches = self._find_by_name(name)
+        if not matches:
             return _error(404, "NoSuchEntity", name)
+        if self._would_drop_last_admin(matches):
+            return _error(
+                409, "DeleteConflict", "refusing to delete the last credentialed admin"
+            )
         self.srv.iam.identities = [
             i for i in self.srv.iam.identities if i.name != name
         ]
@@ -235,11 +291,15 @@ class _Handler(httpd.QuietHandler):
 
     def _do_DeleteAccessKey(self, form):
         key = form.get("AccessKeyId", "")
+        doomed = [i for i in self.srv.iam.identities if i.access_key == key]
+        if doomed and self._would_drop_last_admin(doomed):
+            return _error(
+                409, "DeleteConflict", "refusing to revoke the last credentialed admin key"
+            )
         # revoke the credential but keep the user (AWS semantics)
-        for i in self.srv.iam.identities:
-            if i.access_key == key:
-                i.access_key = ""
-                i.secret_key = ""
+        for i in doomed:
+            i.access_key = ""
+            i.secret_key = ""
         return 200, _resp("DeleteAccessKey")
 
     def _do_PutUserPolicy(self, form):
@@ -252,7 +312,11 @@ class _Handler(httpd.QuietHandler):
         except ValueError:
             return _error(400, "MalformedPolicyDocument")
         actions: list[str] = []
+        if not isinstance(doc, dict) or not isinstance(doc.get("Statement", []), list):
+            return _error(400, "MalformedPolicyDocument")
         for st in doc.get("Statement", []):
+            if not isinstance(st, dict):
+                return _error(400, "MalformedPolicyDocument")
             if st.get("Effect") != "Allow":
                 continue
             acts = st.get("Action", [])
@@ -276,6 +340,17 @@ class _Handler(httpd.QuietHandler):
                     actions.extend(f"{mapped}:{b}" for b in sorted(buckets))
                 else:
                     actions.append(mapped)
+        new_actions = sorted(set(actions))
+        if ACTION_ADMIN not in new_actions and any(
+            i.access_key and i.can_do(ACTION_ADMIN) for i in matches
+        ):
+            # demoting the sole credentialed admin would lock the IAM API
+            # with no recovery path (the key still exists, so the
+            # bootstrap gate stays closed) — same lockout DeleteUser guards
+            if self._would_drop_last_admin(matches):
+                return _error(
+                    409, "DeleteConflict", "refusing to demote the last credentialed admin"
+                )
         for i in matches:
-            i.actions = sorted(set(actions))
+            i.actions = new_actions
         return 200, _resp("PutUserPolicy")

@@ -106,10 +106,17 @@ class Iam:
         query: str,
         headers: dict[str, str],
         payload: bytes,
+        expect_service: Optional[str] = None,
+        expect_hosts: Optional[set[str]] = None,
     ) -> tuple[Optional[Identity], str]:
         """Returns (identity, "") on success or (None, error_code).
         Error codes follow S3: AccessDenied / InvalidAccessKeyId /
-        SignatureDoesNotMatch / MissingSecurityHeader."""
+        SignatureDoesNotMatch / MissingSecurityHeader.
+
+        expect_service pins the credential scope's service field (s3/iam)
+        so a request signed for one endpoint class cannot be replayed
+        verbatim against another within the skew window; expect_hosts pins
+        the signed `host` header to the server's own advertised names."""
         payload_decl = headers.get("x-amz-content-sha256", "")
         if payload_decl.startswith("STREAMING-"):
             # aws-chunked framing is never decoded — reject on open
@@ -131,6 +138,16 @@ class Iam:
             access_key, date, region, service, _ = cred.split("/", 4)
         except (KeyError, ValueError):
             return None, "AuthorizationHeaderMalformed"
+        if expect_service is not None and service != expect_service:
+            # scope mismatch: signed for a different endpoint class
+            return None, "AccessDenied"
+        # the signature must bind the target endpoint or a captured
+        # request verifies verbatim against any other server sharing the
+        # identity set
+        if "host" not in signed_headers:
+            return None, "InvalidRequest"
+        if expect_hosts is not None and headers.get("host", "") not in expect_hosts:
+            return None, "AccessDenied"
         identity = self.lookup(access_key)
         if identity is None:
             return None, "InvalidAccessKeyId"

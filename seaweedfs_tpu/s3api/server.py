@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -53,6 +54,12 @@ BUCKETS_ROOT = "/buckets"
 UPLOADS_ROOT = "/buckets/.uploads"
 _XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
+# uploadIds are minted as uuid4().hex by _initiate_multipart; anything
+# else in the query string is attacker-controlled path material (an
+# unvalidated id containing '..' walks out of the staging area and can
+# delete a victim bucket via AbortMultipartUpload)
+_UPLOAD_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+
 
 def _valid_path(bucket: str, key: str) -> bool:
     """Reject bucket/key pairs whose filer path would normalize outside
@@ -75,15 +82,20 @@ class S3ApiServer:
         port: int = 0,
         host: str = "127.0.0.1",
         iam: Optional[Iam] = None,
+        extra_hosts: Optional[set[str]] = None,
     ):
         self.filer_http = filer_http_address
         self.filer = FilerClient(filer_grpc_address)
         self.iam = iam or Iam()
+        # additional advertised host:port names (LB/proxy fronts) accepted
+        # as the signed `host` header besides this server's own url
+        self.extra_hosts = set(extra_hosts or ())
         self._iam_checked_at = 0.0
         self.host = host
         self._http = _ThreadingHTTPServer((host, port), _Handler)
         self._http.s3_server = self
         self.port = self._http.server_address[1]
+        self.extra_hosts |= {f"{h}:{self.port}" for h in httpd.loopback_aliases(host)}
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
 
     @property
@@ -238,10 +250,15 @@ class _Handler(httpd.QuietHandler):
         _sub(root, "Message", message or s3_code)
         self._reply(code, _render(root))
 
-    def _auth(self, action: str, bucket: str, payload: bytes) -> bool:
+    def _auth(self, action: str, bucket: str, payload: bytes):
+        """Authenticate + authorize; returns the resolved Identity (truthy)
+        or None after replying 403/501 — callers needing a second
+        authorization check (CopyObject's source-bucket Read) reuse the
+        identity instead of re-verifying the signature."""
         u = urllib.parse.urlparse(self.path)
         headers = {k.lower(): v for k, v in self.headers.items()}
         path = urllib.parse.unquote(u.path) or "/"
+        expect_hosts = {self.s3.url} | self.s3.extra_hosts
         if self.s3.iam.open:
             # an open gateway must notice identities minted via the IAM
             # API and start enforcing auth (throttled KV poll)
@@ -252,11 +269,12 @@ class _Handler(httpd.QuietHandler):
                 if fresh is not None and fresh.identities:
                     self.s3.iam.identities = fresh.identities
         identity, err = self.s3.iam.authenticate(
-            self.command, path, u.query, headers, payload
+            self.command, path, u.query, headers, payload,
+            expect_service="s3", expect_hosts=expect_hosts,
         )
         if identity is None and err == "NotImplemented":
             self._error(501, "NotImplemented", "aws-chunked (STREAMING-*) uploads not supported")
-            return False
+            return None
         if identity is None and err == "InvalidAccessKeyId":
             # the IAM API may have minted new credentials since start:
             # reload the persisted identity set once and retry
@@ -264,15 +282,16 @@ class _Handler(httpd.QuietHandler):
             if fresh is not None and fresh.identities:
                 self.s3.iam.identities = fresh.identities
                 identity, err = self.s3.iam.authenticate(
-                    self.command, path, u.query, headers, payload
+                    self.command, path, u.query, headers, payload,
+                    expect_service="s3", expect_hosts=expect_hosts,
                 )
         if identity is None:
             self._error(403, err)
-            return False
+            return None
         if not identity.can_do(action, bucket):
             self._error(403, "AccessDenied", f"no {action} on {bucket}")
-            return False
-        return True
+            return None
+        return identity
 
     # -- dispatch -------------------------------------------------------------
 
@@ -337,11 +356,12 @@ class _Handler(httpd.QuietHandler):
                 self._upload_part(bucket, key, q, body)
             return
         stats.S3RequestCounter.labels("PutObject").inc()
-        if not self._auth(ACTION_WRITE, bucket, body):
+        identity = self._auth(ACTION_WRITE, bucket, body)
+        if identity is None:
             return
         src = self.headers.get("x-amz-copy-source", "")
         if src:
-            self._copy_object(bucket, key, src)
+            self._copy_object(bucket, key, src, identity)
         else:
             self._put_object(bucket, key, body)
 
@@ -580,7 +600,7 @@ class _Handler(httpd.QuietHandler):
             else:
                 self._error(404, "NoSuchKey", key)
 
-    def _copy_object(self, bucket, key, src):
+    def _copy_object(self, bucket, key, src, identity):
         src = urllib.parse.unquote(src)
         if src.startswith("/"):
             src = src[1:]
@@ -589,9 +609,11 @@ class _Handler(httpd.QuietHandler):
             self._error(400, "InvalidArgument", "invalid copy source")
             return
         # the caller proved Write on the destination; reading the source
-        # bucket needs its own grant (copy body is empty, so re-verifying
-        # the signature against b"" matches the original request)
-        if not self._auth(ACTION_READ, s_bucket, b""):
+        # bucket needs its own grant — checked on the identity do_PUT
+        # already resolved (re-verifying the signature against an empty
+        # payload would 403 any legally-signed non-empty copy request)
+        if not identity.can_do(ACTION_READ, s_bucket):
+            self._error(403, "AccessDenied", f"no Read on {s_bucket}")
             return
         s_entry = self.s3.filer.lookup(self.s3.object_path(s_bucket, s_key))
         if s_entry is None:
@@ -660,6 +682,14 @@ class _Handler(httpd.QuietHandler):
     def _upload_dir(self, bucket, upload_id):
         return f"{UPLOADS_ROOT}/{bucket}/{upload_id}"
 
+    def _valid_upload(self, upload_id) -> bool:
+        """Reject any uploadId that is not a uuid4().hex we could have
+        minted — 404 NoSuchUpload, same as an unknown id."""
+        if _UPLOAD_ID_RE.match(upload_id or ""):
+            return True
+        self._error(404, "NoSuchUpload")
+        return False
+
     def _initiate_multipart(self, bucket, key):
         from seaweedfs_tpu.filer.entry import Entry as _E
 
@@ -688,6 +718,8 @@ class _Handler(httpd.QuietHandler):
             self._error(400, "InvalidArgument", "bad partNumber")
             return
         upload_id = q["uploadId"]
+        if not self._valid_upload(upload_id):
+            return
         if self.s3.filer.lookup(self._upload_dir(bucket, upload_id)) is None:
             self._error(404, "NoSuchUpload")
             return
@@ -700,6 +732,8 @@ class _Handler(httpd.QuietHandler):
         self._reply(200, headers={"ETag": f'"{meta.get("etag", "")}"'})
 
     def _list_parts(self, bucket, key, upload_id):
+        if not self._valid_upload(upload_id):
+            return
         d = self._upload_dir(bucket, upload_id)
         if self.s3.filer.lookup(d) is None:
             self._error(404, "NoSuchUpload")
@@ -709,8 +743,11 @@ class _Handler(httpd.QuietHandler):
         _sub(root, "Key", key)
         _sub(root, "UploadId", upload_id)
         for e in self.s3.filer.list(d, limit=10000):
+            num = httpd.safe_int(e.name[4:], -1) if e.name.startswith("part") else -1
+            if num < 0:  # stray entry, not one of our staged parts
+                continue
             p = _sub(root, "Part")
-            _sub(p, "PartNumber", str(int(e.name[4:])))
+            _sub(p, "PartNumber", str(num))
             _sub(p, "ETag", f'"{e.attributes.md5}"')
             _sub(p, "Size", str(e.size))
             _sub(p, "LastModified", _iso(e.attributes.mtime))
@@ -719,16 +756,18 @@ class _Handler(httpd.QuietHandler):
     def _complete_multipart(self, bucket, key, upload_id, body):
         from seaweedfs_tpu.filer.entry import Attributes, Entry as _E, FileChunk
 
+        if not self._valid_upload(upload_id):
+            return
         d = self._upload_dir(bucket, upload_id)
         dir_entry = self.s3.filer.lookup(d)
         if dir_entry is None:
             self._error(404, "NoSuchUpload")
             return
-        staged = {
-            int(e.name[4:]): e
-            for e in self.s3.filer.list(d, limit=10000)
-            if e.name.startswith("part")
-        }
+        staged = {}
+        for e in self.s3.filer.list(d, limit=10000):
+            num = httpd.safe_int(e.name[4:], -1) if e.name.startswith("part") else -1
+            if num >= 0:
+                staged[num] = e
         # S3 commits exactly the parts the client lists, validating
         # ETags and ascending order — never just "everything staged"
         try:
@@ -799,6 +838,8 @@ class _Handler(httpd.QuietHandler):
         self._reply(200, _render(root))
 
     def _abort_multipart(self, bucket, key, upload_id):
+        if not self._valid_upload(upload_id):
+            return
         d = self._upload_dir(bucket, upload_id)
         if self.s3.filer.lookup(d) is not None:
             self.s3.filer.delete(d, recursive=True)

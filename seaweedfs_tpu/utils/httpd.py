@@ -69,3 +69,13 @@ def safe_int(value, default: int) -> int:
         return int(value)
     except (TypeError, ValueError):
         return default
+
+
+def loopback_aliases(host: str) -> set[str]:
+    """Hostnames clients may legitimately sign for when a server binds
+    loopback or a wildcard address — callers append ':port' once the bound
+    port is known. Non-local deployments behind DNS names/proxies must
+    list their advertised names explicitly (extra_hosts / -allowedHosts)."""
+    if host in ("0.0.0.0", "::", "127.0.0.1", "localhost", "::1"):
+        return {"127.0.0.1", "localhost", "[::1]"}
+    return set()

@@ -356,3 +356,54 @@ def test_content_sha256_required(stack):
         urllib.request.urlopen(req, timeout=10)
     assert ei.value.code == 403
     assert b"MissingSecurityHeader" in ei.value.read()
+
+
+def test_upload_id_traversal_rejected(stack):
+    s3 = stack
+    _req(s3, "PUT", "/victim")
+    _req(s3, "PUT", "/victim/data.txt", b"keep me")
+    _req(s3, "PUT", "/mine")
+    # an attacker with Write on their own bucket must not reach outside
+    # the staging area via a crafted uploadId (Abort recursively deletes
+    # the target path)
+    evil = urllib.parse.quote("../../victim", safe="")
+    for method, query in (
+        ("DELETE", f"uploadId={evil}"),
+        ("GET", f"uploadId={evil}"),
+        ("POST", f"uploadId={evil}"),
+        ("PUT", f"partNumber=1&uploadId={evil}"),
+    ):
+        code, _, body = _req(s3, method, "/mine/x", b"<x/>" if method == "POST" else b"",
+                             query=query)
+        assert code == 404 and b"NoSuchUpload" in body, (method, code, body)
+    # victim bucket untouched
+    code, _, got = _req(s3, "GET", "/victim/data.txt")
+    assert code == 200 and got == b"keep me"
+
+
+def test_copy_object_with_declared_body(stack):
+    s3 = stack
+    _req(s3, "PUT", "/cpbody")
+    _req(s3, "PUT", "/cpbody/src.txt", b"copy payload")
+    # a legally-signed copy request may declare a non-empty body that the
+    # server ignores; auth must not re-verify the signature against b""
+    code, _, _ = _req(s3, "PUT", "/cpbody/dst.txt", b"ignored-body",
+                      headers={"x-amz-copy-source": "/cpbody/src.txt"})
+    assert code == 200
+    code, _, got = _req(s3, "GET", "/cpbody/dst.txt")
+    assert code == 200 and got == b"copy payload"
+
+
+def test_host_binding_enforced(stack):
+    s3 = stack
+    _req(s3, "PUT", "/hostbkt")
+    # a request signed for some other endpoint's host must not verify
+    url = f"http://{s3.url}/hostbkt"
+    h = sign_request(AK, SK, "GET", f"http://other.example:9999/hostbkt", b"")
+    req = urllib.request.Request(url, method="GET", headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403

@@ -28,7 +28,7 @@ def stack(tmp_path_factory):
     fs.start()
     dav = WebDavServer(fs.url, fs.grpc_address)
     dav.start()
-    iam = IamApiServer(fs.grpc_address, iam=Iam([]))
+    iam = IamApiServer(fs.grpc_address, iam=Iam([]), bootstrap_token="boot-secret")
     iam.start()
     yield fs, dav, iam
     iam.stop()
@@ -91,13 +91,15 @@ def test_webdav_lifecycle(stack):
     assert _req(base, "PROPFIND", "/davdir")[0] == 404
 
 
-def _iam_call(url, creds=None, **form):
+def _iam_call(url, creds=None, token=None, **form):
     from seaweedfs_tpu.s3api.auth import sign_request
 
     data = urllib.parse.urlencode(form).encode()
     headers = {}
     if creds:
         headers = sign_request(creds[0], creds[1], "POST", url, data, service="iam")
+    if token:
+        headers["x-seaweedfs-bootstrap-token"] = token
     req = urllib.request.Request(url, data=data, method="POST", headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=30) as r:
@@ -110,15 +112,28 @@ def test_iam_user_and_key_lifecycle(stack):
     fs, _, iam = stack
     url = f"http://{iam.url}/"
     ns = "{https://iam.amazonaws.com/doc/2010-05-08/}"
-    # bootstrap window: no identity has credentials yet, so unsigned
-    # calls work exactly long enough to mint the first admin
-    code, _ = _iam_call(url, Action="CreateUser", UserName="root")
+    # fresh cluster: anonymous calls are rejected outright — bootstrap
+    # needs the pre-shared token (first-to-the-port must not mint Admin)
+    code, _ = _iam_call(url, Action="CreateUser", UserName="eve")
+    assert code == 403
+    code, _ = _iam_call(url, token="wrong-token", Action="CreateUser", UserName="eve")
+    assert code == 403
+    boot = "boot-secret"
+    # AWS-natural order: CreateUser → CreateAccessKey → PutUserPolicy.
+    # The key exists with empty actions mid-sequence; the token gate must
+    # stay open until a credentialed ADMIN exists, or the API self-locks.
+    code, _ = _iam_call(url, token=boot, Action="CreateUser", UserName="root")
     assert code == 200
-    code, _ = _iam_call(url, Action="PutUserPolicy", UserName="root",
+    code, body = _iam_call(url, token=boot, Action="CreateAccessKey", UserName="root")
+    assert code == 200
+    # malformed policy documents get 400, not a crashed handler thread
+    for bad in ('[]', '"x"', '{"Statement": ["x"]}', '{"Statement": 3}'):
+        code, _ = _iam_call(url, token=boot, Action="PutUserPolicy",
+                            UserName="root", PolicyDocument=bad)
+        assert code == 400, bad
+    code, _ = _iam_call(url, token=boot, Action="PutUserPolicy", UserName="root",
                         PolicyDocument='{"Statement": [{"Effect": "Allow", '
                                        '"Action": "s3:*", "Resource": "*"}]}')
-    assert code == 200
-    code, body = _iam_call(url, Action="CreateAccessKey", UserName="root")
     assert code == 200
     root_el = ET.fromstring(body)
     admin = (root_el.find(f".//{ns}AccessKeyId").text,
@@ -166,3 +181,25 @@ def test_iam_user_and_key_lifecycle(stack):
     assert code == 404
     code, _ = _iam_call(url, admin, Action="BogusAction")
     assert code == 400
+    # the last credentialed admin cannot be revoked/deleted/demoted — any
+    # of those would lock the IAM API (key exists, bootstrap gate closed)
+    code, _ = _iam_call(url, admin, Action="DeleteAccessKey", AccessKeyId=admin[0])
+    assert code == 409
+    code, _ = _iam_call(url, admin, Action="DeleteUser", UserName="root")
+    assert code == 409
+    code, _ = _iam_call(url, admin, Action="PutUserPolicy", UserName="root",
+                        PolicyDocument='{"Statement": [{"Effect": "Allow", '
+                                       '"Action": "s3:GetObject", "Resource": "*"}]}')
+    assert code == 409
+    # a signature scoped for service=s3 must not verify on the IAM endpoint
+    from seaweedfs_tpu.s3api.auth import sign_request as _sr
+
+    data = urllib.parse.urlencode({"Action": "ListUsers"}).encode()
+    h = _sr(admin[0], admin[1], "POST", url, data, service="s3")
+    req = urllib.request.Request(url, data=data, method="POST", headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403
