@@ -56,9 +56,10 @@ class VolumeServer:
         heartbeat_interval: float = 5.0,
         encoder=None,
         guard: Optional[Guard] = None,
+        needle_map_kind: str = "memory",
     ):
         self.guard = guard or Guard()
-        self.store = Store(directories, encoder=encoder)
+        self.store = Store(directories, encoder=encoder, needle_map_kind=needle_map_kind)
         self.store.load()
         self.master_address = master_address
         self.host = host

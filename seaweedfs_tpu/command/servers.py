@@ -109,6 +109,14 @@ def _volume_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-rack", default="DefaultRack")
     p.add_argument("-max", type=int, default=8, help="max volume count")
     p.add_argument("-metricsPort", type=int, default=0)
+    p.add_argument(
+        "-index",
+        default="memory",
+        choices=["memory", "sorted_file"],
+        help="needle map kind: memory rebuilds the id map in RAM each "
+        "mount; sorted_file binary-searches a persistent .sdx sidecar "
+        "(reference -index=memory|leveldb analog)",
+    )
 
 
 def _volume_run(args: argparse.Namespace) -> int:
@@ -124,6 +132,7 @@ def _volume_run(args: argparse.Namespace) -> int:
         rack=args.rack,
         max_volume_count=args.max,
         guard=_load_guard(),
+        needle_map_kind=args.index,
     )
     vs.start()
     _maybe_metrics(args.metricsPort)

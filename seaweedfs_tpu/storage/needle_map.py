@@ -1,13 +1,21 @@
 """Needle maps: id -> (offset, size) per volume.
 
-Mirror of weed/storage/needle_map (CompactMap / MemDb) [VERIFY: mount empty].
-`MemDb` is the sorted in-memory store the EC encoder uses to produce .ecx from
-.idx; `CompactMap` is the volume-serving map fed by .idx replay.
+Mirror of weed/storage/needle_map (CompactMap / MemDb / the leveldb and
+sorted-file persistent variants) [VERIFY: mount empty]. `MemDb` is the
+sorted in-memory store the EC encoder uses to produce .ecx from .idx;
+`CompactMap` is the volume-serving map fed by .idx replay;
+`SortedFileNeedleMap` is the persistent map for volumes whose needle
+population does not fit (or should not be rebuilt into) RAM on every
+mount — the role of needle_map_leveldb.go / needle_map_sorted_file.go.
 """
 
 from __future__ import annotations
 
+import json
+import os
 from typing import BinaryIO, Iterator, Optional
+
+import numpy as np
 
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types
@@ -55,3 +63,202 @@ class CompactMap(MemDb):
     """Serving-path map. Same semantics; kept as a distinct type to mirror the
     reference's needle_map.CompactMap seam (a future C++ native map can slot
     in behind this interface)."""
+
+    def close(self) -> None:  # interface parity with SortedFileNeedleMap
+        pass
+
+
+class SortedFileNeedleMap:
+    """Persistent needle map: sorted live entries in a `.sdx` sidecar,
+    binary-searched through a memory map, plus a small in-RAM overlay of
+    post-build mutations.
+
+    Mount cost is O(tail), not O(needles): the `.sdx.meta` sidecar records
+    the `.idx` byte offset the `.sdx` was built from, so a clean reopen
+    memory-maps the sorted file and replays only `.idx` entries appended
+    after that watermark. A crash between an `.idx` append and the next
+    flush loses nothing — the tail replay recovers it. Entries use the
+    same big-endian 16-byte record as `.idx`/`.ecx`.
+
+    [ref: weed/storage/needle_map_sorted_file.go,
+    needle_map_leveldb.go — mount empty, SURVEY.md §2.1 "Needle maps".]
+    """
+
+    OVERLAY_FLUSH_ENTRIES = 128 * 1024  # merge threshold, ~3 MB of dict
+
+    def __init__(self, base_path: str):
+        self.idx_path = base_path + ".idx"
+        self.sdx_path = base_path + ".sdx"
+        self.meta_path = base_path + ".sdx.meta"
+        # key -> (offset, size) live, or None meaning deleted-since-build
+        self._overlay: dict[int, Optional[tuple[int, int]]] = {}
+        self._mm: Optional[np.ndarray] = None
+        self._keys: Optional[np.ndarray] = None
+        self._count = 0
+        self.rebuilt_full = False  # diagnostics: did mount pay a full scan?
+        self.replayed_tail = 0
+        self._open()
+
+    # -- build / open --------------------------------------------------------
+
+    def _idx_size(self) -> int:
+        try:
+            return os.path.getsize(self.idx_path)
+        except OSError:
+            return 0
+
+    def _map_sdx(self) -> None:
+        size = os.path.getsize(self.sdx_path)
+        n = size // types.NEEDLE_MAP_ENTRY_SIZE
+        if n:
+            self._mm = np.memmap(self.sdx_path, dtype=idx_mod._BE_ENTRY_DTYPE,
+                                 mode="r", shape=(n,))
+            self._keys = self._mm["key"]
+        else:
+            self._mm = None
+            self._keys = None
+
+    def _open(self) -> None:
+        idx_size = self._idx_size()
+        watermark = -1
+        if os.path.exists(self.sdx_path) and os.path.exists(self.meta_path):
+            try:
+                with open(self.meta_path, encoding="utf-8") as f:
+                    watermark = int(json.load(f)["idx_size"])
+            except (ValueError, KeyError, OSError):
+                watermark = -1
+        if 0 <= watermark <= idx_size:
+            self._map_sdx()
+            self._count = 0 if self._mm is None else len(self._mm)
+            self._replay_tail(watermark, idx_size)
+        else:
+            self._rebuild(idx_size)
+
+    def _rebuild(self, idx_size: int) -> None:
+        """Full .idx replay -> fresh sorted .sdx (first mount / lost meta)."""
+        mem = MemDb()
+        if os.path.exists(self.idx_path):
+            mem.load_from_idx(self.idx_path)
+        tmp = self.sdx_path + ".tmp"
+        idx_mod.write_entries(mem.ascending_visit(), tmp)
+        os.replace(tmp, self.sdx_path)
+        self._write_meta(idx_size)
+        self._map_sdx()
+        self._count = len(mem)
+        self._overlay.clear()
+        self.rebuilt_full = True
+
+    def _replay_tail(self, watermark: int, idx_size: int) -> None:
+        """Apply .idx entries appended after the .sdx build watermark."""
+        if idx_size <= watermark:
+            return
+        with open(self.idx_path, "rb") as f:
+            f.seek(watermark)
+            buf = f.read(idx_size - watermark)
+        for key, off, size in idx_mod.walk_index_buffer(buf):
+            if off != 0 and not types.is_deleted(size):
+                self.set(key, off, size)
+            else:
+                self.delete(key)
+            self.replayed_tail += 1
+
+    def _write_meta(self, idx_size: int) -> None:
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"idx_size": idx_size}, f)
+        os.replace(tmp, self.meta_path)
+
+    # -- map interface -------------------------------------------------------
+
+    def _search_sdx(self, key: int) -> Optional[tuple[int, int]]:
+        if self._keys is None:
+            return None
+        pos = int(np.searchsorted(self._keys, np.uint64(key)))
+        if pos >= len(self._keys) or int(self._keys[pos]) != key:
+            return None
+        row = self._mm[pos]
+        return int(row["offset"]), int(row["size"])
+
+    def get(self, key: int) -> Optional[tuple[int, int]]:
+        if key in self._overlay:
+            return self._overlay[key]
+        return self._search_sdx(key)
+
+    def set(self, key: int, stored_offset: int, size: int) -> None:
+        if self.get(key) is None:
+            self._count += 1
+        self._overlay[key] = (stored_offset, size)
+        if len(self._overlay) >= self.OVERLAY_FLUSH_ENTRIES:
+            self.flush()
+
+    def delete(self, key: int) -> None:
+        if self.get(key) is not None:
+            self._count -= 1
+            self._overlay[key] = None
+
+    def __len__(self) -> int:
+        return self._count
+
+    def ascending_visit(self) -> Iterator[tuple[int, int, int]]:
+        """Merge the sorted file with the sorted overlay."""
+        overlay_keys = sorted(self._overlay)
+        oi = 0
+        rows = self._mm if self._mm is not None else ()
+        for row in rows:
+            key = int(row["key"])
+            while oi < len(overlay_keys) and overlay_keys[oi] < key:
+                ok = overlay_keys[oi]
+                if self._overlay[ok] is not None:
+                    yield ok, *self._overlay[ok]
+                oi += 1
+            if oi < len(overlay_keys) and overlay_keys[oi] == key:
+                ov = self._overlay[overlay_keys[oi]]
+                if ov is not None:
+                    yield key, *ov
+                oi += 1
+                continue
+            yield key, int(row["offset"]), int(row["size"])
+        while oi < len(overlay_keys):
+            ok = overlay_keys[oi]
+            if self._overlay[ok] is not None:
+                yield ok, *self._overlay[ok]
+            oi += 1
+
+    # -- persistence ---------------------------------------------------------
+
+    def flush(self) -> None:
+        """Merge the overlay into a fresh sorted .sdx and advance the
+        watermark to the current .idx size."""
+        if not self._overlay and os.path.exists(self.sdx_path):
+            self._write_meta(self._idx_size())
+            return
+        tmp = self.sdx_path + ".tmp"
+        idx_mod.write_entries(self.ascending_visit(), tmp)
+        # drop the old memmap handle before replacing the file under it
+        self._mm = None
+        self._keys = None
+        os.replace(tmp, self.sdx_path)
+        self._write_meta(self._idx_size())
+        self._overlay.clear()
+        self._map_sdx()
+
+    def close(self) -> None:
+        self.flush()
+        self._mm = None
+        self._keys = None
+
+    def load_from_idx(self, idx_path: str) -> None:
+        """Interface parity with MemDb (used after compaction): rebuild
+        the sidecar from the given .idx."""
+        self.idx_path = idx_path
+        self._rebuild(self._idx_size())
+
+
+def new_needle_map(kind: str, base_path: str):
+    """Factory mirroring the reference's -index flag seam
+    (memory | sorted_file)."""
+    if kind == "memory":
+        return CompactMap()
+    if kind == "sorted_file":
+        return SortedFileNeedleMap(base_path)
+    raise ValueError(f"unknown needle map kind {kind!r}")

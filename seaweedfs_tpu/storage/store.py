@@ -38,7 +38,7 @@ class DiskLocation:
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
 
-    def load(self, encoder: Optional[Encoder] = None) -> None:
+    def load(self, encoder: Optional[Encoder] = None, needle_map_kind: str = "memory") -> None:
         # tiered volumes have no local .dat — discovered via .tierinfo
         discovered = glob.glob(os.path.join(self.directory, "*.dat")) + glob.glob(
             os.path.join(self.directory, "*.tierinfo")
@@ -50,7 +50,9 @@ class DiskLocation:
                 continue
             collection, vid = parsed
             if vid not in self.volumes:
-                self.volumes[vid] = Volume(self.directory, vid, collection)
+                self.volumes[vid] = Volume(
+                    self.directory, vid, collection, needle_map_kind=needle_map_kind
+                )
         for ecx in glob.glob(os.path.join(self.directory, "*.ecx")):
             base = os.path.basename(ecx)[: -len(".ecx")]
             parsed = parse_base_name(base)
@@ -63,15 +65,23 @@ class DiskLocation:
 
 
 class Store:
-    def __init__(self, directories: list[str], encoder: Optional[Encoder] = None):
+    def __init__(
+        self,
+        directories: list[str],
+        encoder: Optional[Encoder] = None,
+        needle_map_kind: str = "memory",
+    ):
         self.encoder = encoder or new_encoder()
         self.locations = [DiskLocation(d) for d in directories]
+        # -index flag analog: memory rebuilds the id map in RAM per mount,
+        # sorted_file binary-searches a persistent .sdx sidecar
+        self.needle_map_kind = needle_map_kind
         self._lock = threading.RLock()
 
     def load(self) -> None:
         with self._lock:
             for loc in self.locations:
-                loc.load(self.encoder)
+                loc.load(self.encoder, self.needle_map_kind)
 
     def close(self) -> None:
         with self._lock:
@@ -103,7 +113,13 @@ class Store:
                 ttl=TTL.parse(ttl),
             )
             loc = self._pick_location()
-            v = Volume(loc.directory, vid, collection, super_block=sb)
+            v = Volume(
+                loc.directory,
+                vid,
+                collection,
+                super_block=sb,
+                needle_map_kind=self.needle_map_kind,
+            )
             loc.volumes[vid] = v
             return v
 

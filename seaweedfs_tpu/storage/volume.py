@@ -31,12 +31,14 @@ class Volume:
         volume_id: int,
         collection: str = "",
         super_block: Optional[SuperBlock] = None,
+        needle_map_kind: str = "memory",
     ):
         self.dir = dir_
         self.id = volume_id
         self.collection = collection
         self.read_only = False
         self._lock = threading.RLock()
+        self.needle_map_kind = needle_map_kind
         self.nm = CompactMap()
         base = f"{collection}_{volume_id}" if collection else str(volume_id)
         self.base_path = os.path.join(dir_, base)
@@ -78,11 +80,21 @@ class Volume:
                     from seaweedfs_tpu.storage.scan import rebuild_idx
 
                     rebuild_idx(self.base_path, verify_crc=False)
-                if os.path.exists(self.idx_path):
+                if needle_map_kind != "memory":
+                    # persistent map: O(tail) mount — binary-searches the
+                    # .sdx sidecar instead of rebuilding the id map in RAM
+                    from seaweedfs_tpu.storage.needle_map import new_needle_map
+
+                    self.nm = new_needle_map(needle_map_kind, self.base_path)
+                elif os.path.exists(self.idx_path):
                     self.nm.load_from_idx(self.idx_path)
             else:
                 self.super_block = super_block or SuperBlock()
                 self._write_super_block()
+                if needle_map_kind != "memory":
+                    from seaweedfs_tpu.storage.needle_map import new_needle_map
+
+                    self.nm = new_needle_map(needle_map_kind, self.base_path)
             self._idx = open(self.idx_path, "ab")
         except BaseException:
             self._dat.close()
@@ -99,6 +111,10 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            # .idx must be durable before the persistent map advances its
+            # watermark past it
+            self._idx.flush()
+            self.nm.close()
             self._dat.close()
             self._idx.close()
 
@@ -240,8 +256,18 @@ class Volume:
             self._dat = open(self.dat_path, "r+b")
             self._idx = open(self.idx_path, "ab")
             self.super_block = new_sb
-            self.nm = CompactMap()
-            self.nm.load_from_idx(self.idx_path)
+            if self.needle_map_kind != "memory":
+                from seaweedfs_tpu.storage.needle_map import new_needle_map
+
+                # sidecar watermark refers to the pre-compaction .idx; wipe
+                # it so the map rebuilds from the fresh index
+                for ext in (".sdx", ".sdx.meta"):
+                    if os.path.exists(self.base_path + ext):
+                        os.unlink(self.base_path + ext)
+                self.nm = new_needle_map(self.needle_map_kind, self.base_path)
+            else:
+                self.nm = CompactMap()
+                self.nm.load_from_idx(self.idx_path)
             return before, self.content_size()
 
     def incremental_backup_since(self, offset: int) -> bytes:
