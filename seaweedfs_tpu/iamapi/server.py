@@ -58,7 +58,8 @@ class IamApiServer:
         self.filer = FilerClient(filer_grpc_address)
         self.iam = iam if iam is not None else (load_identities(self.filer) or Iam())
         self.host = host
-        self.extra_hosts = set(extra_hosts or ())
+        # pre-lowercased: the auth host compare is a plain set lookup
+        self.extra_hosts = {h.lower() for h in (extra_hosts or ())}
         # pre-shared secret gating the fresh-cluster bootstrap: with no
         # credentialed identity yet, only a caller presenting this token
         # may mint the first admin. Without a token configured the API is
@@ -165,7 +166,7 @@ class _Handler(httpd.QuietHandler):
             identity, err = self.srv.iam.authenticate(
                 "POST", urllib.parse.unquote(u.path) or "/", u.query, headers, raw,
                 expect_service="iam",
-                expect_hosts={self.srv.url} | self.srv.extra_hosts,
+                expect_hosts={self.srv.url.lower()} | self.srv.extra_hosts,
             )
             if identity is None:
                 code, body = _error(403, err or "AccessDenied")

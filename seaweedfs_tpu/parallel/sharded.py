@@ -149,6 +149,11 @@ def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
         b, s, n = survivors.shape
         if s != n_surv:
             raise ValueError(f"want {n_surv} survivor shards, got {s}")
+        dp = mesh.shape["dp"]
+        if b % dp:
+            raise ValueError(f"batch {b} must divide evenly over dp={dp}")
+        if n % sp:
+            raise ValueError(f"shard length {n} must divide evenly over sp={sp}")
         if s_pad != s:
             survivors = np.concatenate(
                 [survivors, np.zeros((b, s_pad - s, n), dtype=np.uint8)], axis=1

@@ -146,7 +146,9 @@ class Iam:
         # identity set
         if "host" not in signed_headers:
             return None, "InvalidRequest"
-        if expect_hosts is not None and headers.get("host", "") not in expect_hosts:
+        # Host is case-insensitive per RFC 9110 §4.2.3; expect_hosts is
+        # pre-lowercased by the servers at construction
+        if expect_hosts is not None and headers.get("host", "").lower() not in expect_hosts:
             return None, "AccessDenied"
         identity = self.lookup(access_key)
         if identity is None:

@@ -88,8 +88,9 @@ class S3ApiServer:
         self.filer = FilerClient(filer_grpc_address)
         self.iam = iam or Iam()
         # additional advertised host:port names (LB/proxy fronts) accepted
-        # as the signed `host` header besides this server's own url
-        self.extra_hosts = set(extra_hosts or ())
+        # as the signed `host` header besides this server's own url;
+        # pre-lowercased here so the per-request compare is a set lookup
+        self.extra_hosts = {h.lower() for h in (extra_hosts or ())}
         self._iam_checked_at = 0.0
         self.host = host
         self._http = _ThreadingHTTPServer((host, port), _Handler)
@@ -258,7 +259,7 @@ class _Handler(httpd.QuietHandler):
         u = urllib.parse.urlparse(self.path)
         headers = {k.lower(): v for k, v in self.headers.items()}
         path = urllib.parse.unquote(u.path) or "/"
-        expect_hosts = {self.s3.url} | self.s3.extra_hosts
+        expect_hosts = {self.s3.url.lower()} | self.s3.extra_hosts
         if self.s3.iam.open:
             # an open gateway must notice identities minted via the IAM
             # API and start enforcing auth (throttled KV poll)
@@ -685,7 +686,7 @@ class _Handler(httpd.QuietHandler):
     def _valid_upload(self, upload_id) -> bool:
         """Reject any uploadId that is not a uuid4().hex we could have
         minted — 404 NoSuchUpload, same as an unknown id."""
-        if _UPLOAD_ID_RE.match(upload_id or ""):
+        if _UPLOAD_ID_RE.fullmatch(upload_id or ""):
             return True
         self._error(404, "NoSuchUpload")
         return False

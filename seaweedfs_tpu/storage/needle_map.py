@@ -97,6 +97,11 @@ class SortedFileNeedleMap:
         self._count = 0
         self.rebuilt_full = False  # diagnostics: did mount pay a full scan?
         self.replayed_tail = 0
+        # While replaying the .idx tail, the overlay only covers entries up
+        # to this byte offset; a flush during replay must not stamp the
+        # watermark past it, or a crash mid-replay would skip the rest of
+        # the tail on the next mount (lost entries / resurrected deletes).
+        self._replay_pos: Optional[int] = None
         self._open()
 
     # -- build / open --------------------------------------------------------
@@ -155,12 +160,19 @@ class SortedFileNeedleMap:
         with open(self.idx_path, "rb") as f:
             f.seek(watermark)
             buf = f.read(idx_size - watermark)
-        for key, off, size in idx_mod.walk_index_buffer(buf):
-            if off != 0 and not types.is_deleted(size):
-                self.set(key, off, size)
-            else:
-                self.delete(key)
-            self.replayed_tail += 1
+        self._replay_pos = watermark
+        try:
+            for key, off, size in idx_mod.walk_index_buffer(buf):
+                if off != 0 and not types.is_deleted(size):
+                    self.set(key, off, size)
+                else:
+                    self.delete(key)
+                self.replayed_tail += 1
+                self._replay_pos = (
+                    watermark + self.replayed_tail * types.NEEDLE_MAP_ENTRY_SIZE
+                )
+        finally:
+            self._replay_pos = None
 
     def _write_meta(self, idx_size: int) -> None:
         tmp = self.meta_path + ".tmp"
@@ -228,9 +240,11 @@ class SortedFileNeedleMap:
 
     def flush(self) -> None:
         """Merge the overlay into a fresh sorted .sdx and advance the
-        watermark to the current .idx size."""
+        watermark to the covered .idx position (the full current size,
+        or the replay cursor when flushed mid-tail-replay)."""
+        covered = self._replay_pos if self._replay_pos is not None else self._idx_size()
         if not self._overlay and os.path.exists(self.sdx_path):
-            self._write_meta(self._idx_size())
+            self._write_meta(covered)
             return
         tmp = self.sdx_path + ".tmp"
         idx_mod.write_entries(self.ascending_visit(), tmp)
@@ -238,7 +252,7 @@ class SortedFileNeedleMap:
         self._mm = None
         self._keys = None
         os.replace(tmp, self.sdx_path)
-        self._write_meta(self._idx_size())
+        self._write_meta(covered)
         self._overlay.clear()
         self._map_sdx()
 

@@ -5,6 +5,7 @@ reply rules live in a single place.
 
 from __future__ import annotations
 
+import functools
 import http.server
 import socketserver
 from typing import Optional
@@ -74,8 +75,34 @@ def safe_int(value, default: int) -> int:
 def loopback_aliases(host: str) -> set[str]:
     """Hostnames clients may legitimately sign for when a server binds
     loopback or a wildcard address — callers append ':port' once the bound
-    port is known. Non-local deployments behind DNS names/proxies must
-    list their advertised names explicitly (extra_hosts / -allowedHosts)."""
+    port is known. Wildcard binds also include the machine's own hostname
+    and addresses, so clients reaching the server via its LAN IP or DNS
+    name aren't 403'd; deployments behind proxies/LBs still must list
+    their advertised names explicitly (extra_hosts / -allowedHosts).
+    All names are lower-cased — Host comparison is case-insensitive
+    (RFC 9110 §4.2.3)."""
+    aliases: set[str] = set()
     if host in ("0.0.0.0", "::", "127.0.0.1", "localhost", "::1"):
-        return {"127.0.0.1", "localhost", "[::1]"}
-    return set()
+        aliases = {"127.0.0.1", "localhost", "[::1]"}
+    if host in ("0.0.0.0", "::"):
+        aliases |= _self_addresses()
+    return {a.lower() for a in aliases}
+
+
+@functools.lru_cache(maxsize=1)
+def _self_addresses() -> frozenset[str]:
+    """The machine's own hostname + addresses, resolved once per process —
+    getaddrinfo can block for the resolver timeout on hosts with broken
+    DNS, and every server constructor calls loopback_aliases."""
+    import socket
+
+    found: set[str] = set()
+    try:
+        name = socket.gethostname()
+        found.add(name)
+        for info in socket.getaddrinfo(name, None):
+            addr = info[4][0]
+            found.add(f"[{addr}]" if ":" in addr else addr)
+    except OSError:
+        pass
+    return frozenset(found)

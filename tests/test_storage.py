@@ -280,6 +280,37 @@ def test_sorted_file_map_crash_tail_replay(tmp_path):
     v2.close()
 
 
+def test_sorted_file_map_mid_replay_flush_watermark(tmp_path, monkeypatch):
+    """A flush triggered while the mount is still replaying the .idx tail
+    must not stamp the watermark past the replay cursor: a crash right
+    after such a flush would otherwise skip the un-replayed remainder on
+    the next mount (lost entries / resurrected deletes)."""
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage.needle_map import SortedFileNeedleMap
+
+    base = str(tmp_path / "mid")
+    m = SortedFileNeedleMap(base)
+    m.set(1, 10, 100)
+    m.close()  # sidecar built, watermark at 1 entry... but .idx is empty
+    # append a 10-entry tail directly to the .idx (writes that the sidecar
+    # has not merged), including a delete of a sidecar-resident key
+    with open(base + ".idx", "ab") as f:
+        idx_mod.write_entries(
+            [(k, k * 10, 100) for k in range(2, 11)] + [(1, 10, -1)], f
+        )
+    # force an auto-flush after every replayed entry
+    monkeypatch.setattr(SortedFileNeedleMap, "OVERLAY_FLUSH_ENTRIES", 1)
+    m2 = SortedFileNeedleMap(base)
+    assert m2.replayed_tail == 10
+    # simulate a crash immediately after the first mid-replay flush by NOT
+    # closing m2, then check the meta watermark never exceeded the cursor:
+    # a fresh mount must still see the full tail applied
+    m3 = SortedFileNeedleMap(base)
+    assert m3.get(5) == (50, 100)
+    assert m3.get(1) is None, "mid-replay flush resurrected a deleted key"
+    m3.close()
+
+
 def test_sorted_file_map_mount_reads_only_tail(tmp_path):
     """Mount cost scales with the .idx tail, not the needle population: a
     synthetic 1M-entry index mounts without a full replay and serves
