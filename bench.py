@@ -399,6 +399,22 @@ def main() -> None:
         if gbps is not None:
             result["fallback"] = {"numpy_gbps": gbps, "note": "parent inline"}
 
+    # stage 2b: TPU-lowering proof — device-free Mosaic validation of the
+    # Pallas kernel (cheap; proves the kernel compiles for the real target
+    # even when the tunnel is wedged)
+    try:
+        from seaweedfs_tpu.ops import tpu_lowering
+
+        proof = tpu_lowering.run_lowering_proof(
+            timeout=min(300, max(30, int(deadline - time.monotonic())))
+        )
+        result["tpu_lowering"] = {
+            "ok": bool(proof) and all(r.get("ok") for r in proof),
+            "shapes": {r["name"]: r.get("ok", False) for r in proof},
+        }
+    except Exception as e:  # noqa: BLE001
+        result["tpu_lowering"] = {"ok": False, "error": str(e)[:200]}
+
     # stage 1b: retry the probe — the tunnel may have unwedged mid-run
     if not device_ok and not forced_cpu and deadline - time.monotonic() > 120:
         probe2, probe2_err = _run_child("probe", timeout=60)

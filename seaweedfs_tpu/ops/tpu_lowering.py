@@ -1,0 +1,154 @@
+"""TPU-target lowering proof for the fused Pallas kernel — no device needed.
+
+The axon TPU tunnel has been wedged machine-wide since round 2, so the chip
+itself is frequently unmeasurable. What CAN be proven without a device is
+that `rs_pallas._kernel` lowers through Mosaic for the TPU target: Pallas
+TPU lowering (StableHLO + serialized Mosaic module inside a
+`tpu_custom_call`) runs at trace/lowering time via `jax.export`, and Mosaic
+rejects unsupported patterns (layouts, reshapes, dtypes) right there —
+interpret mode hides exactly this class of bug.
+
+CAVEAT (environment): `jax.export(..., platforms=["tpu"])` hangs if the
+axon PJRT plugin is importable, even under JAX_PLATFORMS=cpu — the plugin
+initializes during platform resolution and blocks on the single-client
+tunnel. Callers must run `export_fused_kernel` in a subprocess whose
+PYTHONPATH excludes the axon site dir; `run_lowering_proof` does exactly
+that. [ref: SURVEY.md §7.2; the reference's equivalent proof surface is its
+amd64 assembler unit tests — klauspost galois_gen_amd64.s, mount empty]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+# shape classes the storage engine actually hits (SURVEY §7.3.5):
+#   encode:        RS(10+4) parity generation over large stripes, tile 8192
+#   reconstruct:   4 lost shards from 10 survivors, tile 8192
+#   small-read:    one-interval degraded read, minimum 128-byte tile
+PROOF_SHAPES = (
+    {"name": "encode_10p4_tile8192", "rows": 4, "cols": 10, "tile": 8192, "batch": 4},
+    {"name": "reconstruct_4from10_tile8192", "rows": 4, "cols": 10, "tile": 8192, "batch": 1},
+    {"name": "reconstruct_10from10_tile8192", "rows": 10, "cols": 10, "tile": 8192, "batch": 1},
+    {"name": "small_read_tile128", "rows": 4, "cols": 10, "tile": 128, "batch": 1},
+)
+
+
+def export_fused_kernel(
+    rows: int, cols: int, tile: int, batch: int = 1
+) -> tuple[str, dict]:
+    """Lower `_apply_padded` for the TPU platform; return (MLIR text, meta).
+
+    Raises whatever Mosaic raises if the kernel does not lower — that
+    failure IS the signal this function exists to surface.
+    """
+    import jax
+    import jax.export
+    import jax.numpy as jnp
+    import numpy as np
+
+    from seaweedfs_tpu.ops import gf8, rs_jax, rs_pallas
+
+    m = gf8.parity_matrix(cols, rows) if rows <= cols else None
+    if m is None or m.shape != (rows, cols):
+        # reconstruct-style matrices are arbitrary (rows, cols) GF matrices;
+        # any valid GF matrix exercises the same kernel — build one
+        rng = np.random.default_rng(1)
+        m = rng.integers(1, 256, size=(rows, cols), dtype=np.uint8)
+    b_bits = rs_jax.lifted_matrix(m)
+    pack = jnp.asarray(rs_pallas._pack_matrix(rows))
+    n = tile * 2
+
+    fn = lambda b, p, d: rs_pallas._apply_padded(b, p, d, tile, False)  # noqa: E731
+    args = (
+        jax.ShapeDtypeStruct(b_bits.shape, jnp.int8),
+        jax.ShapeDtypeStruct(pack.shape, jnp.float32),
+        jax.ShapeDtypeStruct((batch, cols, n), jnp.uint8),
+    )
+    exported = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    mlir = exported.mlir_module()
+    meta = {
+        "rows": rows,
+        "cols": cols,
+        "tile": tile,
+        "batch": batch,
+        "n": n,
+        "platforms": list(exported.platforms),
+        "mlir_bytes": len(mlir),
+        "has_tpu_custom_call": "tpu_custom_call" in mlir,
+        "mlir_sha256": hashlib.sha256(mlir.encode()).hexdigest(),
+        "jax_version": jax.__version__,
+    }
+    return mlir, meta
+
+
+def _scrubbed_env() -> dict:
+    """Subprocess env with the axon site dir off PYTHONPATH and cpu pinned."""
+    env = dict(os.environ)
+    parts = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ]
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if repo_root not in parts:
+        parts.insert(0, repo_root)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+_CHILD_CODE = """
+import json, sys
+from seaweedfs_tpu.ops import tpu_lowering
+out = []
+for spec in tpu_lowering.PROOF_SHAPES:
+    name = spec["name"]
+    try:
+        mlir, meta = tpu_lowering.export_fused_kernel(
+            spec["rows"], spec["cols"], spec["tile"], spec["batch"])
+        meta["name"] = name
+        meta["ok"] = meta["has_tpu_custom_call"]
+        out.append(meta)
+        dirpath = sys.argv[1] if len(sys.argv) > 1 else ""
+        if dirpath:
+            with open(f"{dirpath}/{name}.tpu.mlir", "w") as f:
+                f.write(mlir)
+    except Exception as e:
+        out.append({"name": name, "ok": False, "error": str(e)[:500]})
+print(json.dumps(out))
+"""
+
+
+def run_lowering_proof(
+    artifact_dir: Optional[str] = None, timeout: int = 600
+) -> list[dict]:
+    """Run the full proof suite in a scrubbed subprocess; optionally write
+    the lowered .mlir artifacts to `artifact_dir`. Returns per-shape meta
+    (ok/error per shape; the subprocess itself failing yields one entry)."""
+    if artifact_dir:
+        os.makedirs(artifact_dir, exist_ok=True)
+    cmd = [sys.executable, "-c", _CHILD_CODE] + ([artifact_dir] if artifact_dir else [])
+    try:
+        proc = subprocess.run(
+            cmd,
+            env=_scrubbed_env(),
+            timeout=timeout,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+    except subprocess.TimeoutExpired:
+        return [{"name": "suite", "ok": False, "error": f"timeout after {timeout}s"}]
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("["):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    err = proc.stderr.decode(errors="replace")[-500:]
+    return [{"name": "suite", "ok": False, "error": f"exit={proc.returncode}: {err}"}]
