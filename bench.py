@@ -300,6 +300,156 @@ def mode_cpu() -> None:
 
 
 # ---------------------------------------------------------------------------
+# stage 2c: remote degraded-read ladder (child, JAX_PLATFORMS=cpu)
+# ---------------------------------------------------------------------------
+
+
+def mode_remote() -> None:
+    """Two-server remote ladder (SURVEY §3.2 end to end): master + 2 volume
+    servers on loopback; EC-encode a volume on A, hand half the shards to B,
+    then time reads through A's HTTP data path in three classes:
+      local    — every interval on A's own shards
+      remote   — >=1 interval fetched from B via pooled VolumeEcShardRead
+      reconstruct_remote — a shard deleted on BOTH nodes: A reconstructs
+                 from 13 survivors, some of them remote
+    This is the path r3 could not measure (uncached lookups + per-read dials
+    would have dominated; both are fixed in r4)."""
+    import tempfile
+    import urllib.request
+
+    import jax  # noqa: F401
+
+    from seaweedfs_tpu.utils.devices import honor_platform_env
+
+    honor_platform_env()
+
+    import numpy as np
+
+    from seaweedfs_tpu import rpc
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.ec import stripe
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    out: dict = {}
+    large, small = 64 << 10, 4 << 10
+    with tempfile.TemporaryDirectory() as td:
+        master = MasterServer(port=0, reap_interval=3600)
+        master.start()
+        servers = []
+        for i in range(2):
+            d = os.path.join(td, f"srv{i}")
+            os.makedirs(d)
+            vs = VolumeServer([d], master.address, heartbeat_interval=0.3)
+            vs.start()
+            servers.append(vs)
+        client = MasterClient(master.address)
+        try:
+            rng = np.random.default_rng(11)
+            first = client.submit(rng.integers(0, 256, 8192, dtype=np.uint8).tobytes())
+            vid = int(first.fid.split(",")[0])
+            fids = [first.fid]
+            while len(fids) < 200:
+                a = client.assign()
+                if int(a.fid.split(",")[0]) != vid:
+                    continue
+                size = int(rng.integers(512, 6000))
+                client.upload(a.fid, rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+                fids.append(a.fid)
+            owner = next(s for s in servers if s.store.get_volume(vid) is not None)
+            other = next(s for s in servers if s is not owner)
+            with rpc.RpcClient(owner.grpc_address) as oc:
+                oc.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+                oc.call(VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                        {"volume_id": vid, "large_block_size": large,
+                         "small_block_size": small})
+            with rpc.RpcClient(other.grpc_address) as tc:
+                tc.call(VOLUME_SERVICE, "VolumeEcShardsCopy",
+                        {"volume_id": vid, "shard_ids": list(range(7, 14)),
+                         "source_data_node": owner.grpc_address})
+            base = owner._base_path_for(vid)
+            with rpc.RpcClient(owner.grpc_address) as oc:
+                for s in range(7, 14):
+                    os.remove(stripe.shard_file_name(base, s))
+                oc.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
+            for vs in servers:
+                with rpc.RpcClient(vs.grpc_address) as c:
+                    c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(master.topology.lookup_ec_shards(vid)) == 14:
+                    break
+                time.sleep(0.05)
+
+            ev = owner.store.get_ec_volume(vid)
+            lost = 3  # will be deleted everywhere for the reconstruct class
+
+            from seaweedfs_tpu.storage.file_id import FileId
+
+            def shard_ids_of(fid: str) -> set:
+                nid = FileId.parse(fid).key
+                _, _, ivs = ev.locate_needle(nid)
+                return {iv.to_shard_id_and_offset(large, small)[0] for iv in ivs}
+
+            classes: dict[str, list[str]] = {"local": [], "remote": [], "reconstruct_remote": []}
+            for fid in fids:
+                try:
+                    sids = shard_ids_of(fid)
+                except Exception:  # noqa: BLE001
+                    continue
+                if lost in sids:
+                    classes["reconstruct_remote"].append(fid)
+                elif any(s >= 7 for s in sids):
+                    classes["remote"].append(fid)
+                else:
+                    classes["local"].append(fid)
+
+            def read_via_owner(fid: str) -> bytes:
+                with urllib.request.urlopen(
+                    f"http://{owner.url}/{fid}", timeout=30
+                ) as r:
+                    return r.read()
+
+            def time_class(fids_: list[str]) -> dict | None:
+                if not fids_:
+                    return None
+                for f in fids_[:2]:
+                    read_via_owner(f)  # warm compile/caches
+                ms = []
+                for _ in range(3):
+                    for f in fids_:
+                        t0 = time.perf_counter()
+                        read_via_owner(f)
+                        ms.append((time.perf_counter() - t0) * 1e3)
+                ms.sort()
+                return {
+                    "p50_ms": round(ms[len(ms) // 2], 3),
+                    "p99_ms": round(ms[min(len(ms) - 1, int(0.99 * len(ms)))], 3),
+                    "n_reads": len(ms),
+                }
+            out["local"] = time_class(classes["local"])
+            out["remote"] = time_class(classes["remote"])
+            # now lose shard 3 everywhere: reads touching it reconstruct
+            for vs in servers:
+                b = vs._base_path_for(vid)
+                p = stripe.shard_file_name(b, lost)
+                if os.path.exists(p):
+                    os.remove(p)
+                evv = vs.store.get_ec_volume(vid)
+                if evv is not None:
+                    evv.drop_local_shard(lost)
+            out["reconstruct_remote"] = time_class(classes["reconstruct_remote"])
+            out["class_sizes"] = {k: len(v) for k, v in classes.items()}
+        finally:
+            client.close()
+            for vs in servers:
+                vs.stop()
+            master.stop()
+    _emit(out)
+
+
+# ---------------------------------------------------------------------------
 # stage 3: device suite (child, default/axon platform)
 # ---------------------------------------------------------------------------
 
@@ -399,6 +549,17 @@ def main() -> None:
         if gbps is not None:
             result["fallback"] = {"numpy_gbps": gbps, "note": "parent inline"}
 
+    # stage 2c: remote degraded-read ladder (two in-process servers)
+    remote, remote_err = _run_child(
+        "remote",
+        timeout=min(300, max(30, int(deadline - time.monotonic()))),
+        extra_env={"JAX_PLATFORMS": "cpu"},
+    )
+    if remote:
+        result["remote_ladder"] = remote
+    else:
+        result["remote_ladder_error"] = remote_err
+
     # stage 2b: TPU-lowering proof — device-free Mosaic validation of the
     # Pallas kernel (cheap; proves the kernel compiles for the real target
     # even when the tunnel is wedged)
@@ -466,6 +627,8 @@ if __name__ == "__main__":
         mode_probe()
     elif mode == "cpu":
         mode_cpu()
+    elif mode == "remote":
+        mode_remote()
     elif mode == "device":
         mode_device()
     else:

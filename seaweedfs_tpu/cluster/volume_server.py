@@ -57,6 +57,7 @@ class VolumeServer:
         encoder=None,
         guard: Optional[Guard] = None,
         needle_map_kind: str = "memory",
+        ec_lookup_ttl: float = 30.0,
     ):
         self.guard = guard or Guard()
         self.store = Store(directories, encoder=encoder, needle_map_kind=needle_map_kind)
@@ -86,6 +87,14 @@ class VolumeServer:
         ]
         self._masters = {a: rpc.RpcClient(a) for a in self._master_addresses}
         self._master = self._masters[self._master_addresses[0]]
+        # degraded-read plumbing: LookupEcVolume answers are cached per vid
+        # with expiry (the reference caches ShardLocations on the EcVolume)
+        # and peer channels are pooled — an uncached lookup + fresh dial per
+        # interval read would dominate remote-reconstruct p50
+        self._peer_pool = rpc.ClientPool()
+        self._shard_locs: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._shard_locs_lock = threading.Lock()
+        self.ec_lookup_ttl = ec_lookup_ttl
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -114,6 +123,7 @@ class VolumeServer:
         self._grpc.stop()
         for c in self._masters.values():
             c.close()
+        self._peer_pool.close_all()
         self.store.close()
 
     def __enter__(self):
@@ -205,40 +215,70 @@ class VolumeServer:
         base = f"{collection}_{vid}" if collection else str(vid)
         return os.path.join(loc.directory, base)
 
+    def _lookup_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        """shard_id -> [grpc addresses], via the per-vid cache with expiry.
+        The reference caches ShardLocations on the EcVolume and refreshes on
+        an interval; an expired or missing entry pays one master round-trip,
+        every other interval read within the TTL is lookup-free."""
+        now = time.monotonic()
+        with self._shard_locs_lock:
+            hit = self._shard_locs.get(vid)
+            if hit is not None and hit[0] > now:
+                return hit[1]
+        resp = self._master_query("LookupEcVolume", {"volume_id": vid})
+        locs: dict[int, list[str]] = {}
+        for entry in resp.get("shard_id_locations", []):
+            addrs = [
+                f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
+                for locd in entry["locations"]
+                if locd["url"] != self.url  # we are not a remote for ourselves
+            ]
+            if addrs:
+                locs[int(entry["shard_id"])] = addrs
+        with self._shard_locs_lock:
+            self._shard_locs[vid] = (now + self.ec_lookup_ttl, locs)
+        return locs
+
+    def _invalidate_shard_locations(self, vid: int) -> None:
+        with self._shard_locs_lock:
+            self._shard_locs.pop(vid, None)
+
     def _remote_reader_for(self, vid: int):
-        """RemoteReader closure for EC degraded reads: master LookupEcVolume
-        -> VolumeEcShardRead on a holder (SURVEY.md §3.2)."""
+        """RemoteReader closure for EC degraded reads: cached master
+        LookupEcVolume -> pooled VolumeEcShardRead on a holder
+        (SURVEY.md §3.2)."""
 
         def read(shard_id: int, offset: int, size: int) -> Optional[bytes]:
             try:
-                resp = self._master_query("LookupEcVolume", {"volume_id": vid})
+                locs = self._lookup_shard_locations(vid)
             except Exception:  # noqa: BLE001
                 return None
-            for entry in resp.get("shard_id_locations", []):
-                if entry["shard_id"] != shard_id:
-                    continue
-                for locd in entry["locations"]:
-                    if locd["url"] == self.url:
-                        continue  # that's us; local read already failed
-                    addr = f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
+            failed = False
+            try:
+                for addr in locs.get(shard_id, ()):
                     try:
-                        with rpc.RpcClient(addr) as c:
-                            chunks = c.stream(
-                                VOLUME_SERVICE,
-                                "VolumeEcShardRead",
-                                {
-                                    "volume_id": vid,
-                                    "shard_id": shard_id,
-                                    "offset": offset,
-                                    "size": size,
-                                },
-                            )
-                            buf = b"".join(chunks)
-                            if len(buf) == size:
-                                return buf
+                        chunks = self._peer_pool.get(addr).stream(
+                            VOLUME_SERVICE,
+                            "VolumeEcShardRead",
+                            {
+                                "volume_id": vid,
+                                "shard_id": shard_id,
+                                "offset": offset,
+                                "size": size,
+                            },
+                        )
+                        buf = b"".join(chunks)
+                        if len(buf) == size:
+                            return buf
+                        failed = True  # holder answered short: stale layout
                     except Exception:  # noqa: BLE001 — try next holder
-                        continue
-            return None
+                        self._peer_pool.invalidate(addr)
+                        failed = True
+                return None
+            finally:
+                if failed:
+                    # shards may have moved; next read re-asks the master
+                    self._invalidate_shard_locations(vid)
 
         return read
 

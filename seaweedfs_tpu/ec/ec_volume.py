@@ -109,6 +109,16 @@ class EcVolume:
     def shard_ids(self) -> list[int]:
         return sorted(self._shard_files)
 
+    def drop_local_shard(self, shard_id: int) -> bool:
+        """Stop serving a shard from local disk (single-shard unmount /
+        shard-file loss): closes the handle so reads fall through to the
+        remote -> reconstruct ladder."""
+        f = self._shard_files.pop(shard_id, None)
+        if f is None:
+            return False
+        f.close()
+        return True
+
     # -- index ---------------------------------------------------------------
 
     def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:

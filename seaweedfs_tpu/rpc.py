@@ -233,3 +233,45 @@ class RpcClient:
         """Unary-stream call; defaults to raw byte frames (bulk transfer)."""
         stub = self._stub(service, method, "unary_stream", "json", resp_format)
         return stub(request if request is not None else {}, timeout=timeout)
+
+
+class ClientPool:
+    """Long-lived RpcClient per peer address — the degraded-read ladder and
+    replication fan-out dial the same few holders over and over; a fresh
+    channel per read costs a TCP+HTTP/2 setup on the latency-critical path
+    ([ref: weed/storage/erasure_coding/ec_volume.go ShardLocations +
+    grpc connection reuse in weed/operation — mount empty, SURVEY.md §3.2]).
+
+    gRPC channels are thread-safe; the pool only guards the dict. A caller
+    that sees a transport error should `invalidate(addr)` so the next use
+    redials instead of reusing a broken channel.
+    """
+
+    def __init__(self) -> None:
+        self._clients: dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(address)
+            if c is None:
+                c = self._clients[address] = RpcClient(address)
+            return c
+
+    def invalidate(self, address: str) -> None:
+        with self._lock:
+            c = self._clients.pop(address, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
