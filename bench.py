@@ -224,6 +224,8 @@ def _measure_reconstruct_latency(tmpdir: str) -> dict:
     with EcVolume(
         base, encoder=enc, large_block_size=large, small_block_size=small
     ) as ev:
+        if ev.warm_thread is not None:
+            ev.warm_thread.join(30)  # mount warmup precedes traffic (r4)
         for nid in records:
             # only reads whose intervals hit the lost shard exercise the
             # reconstruct ladder; the rest are the local-read baseline

@@ -14,9 +14,12 @@ the degraded-read path whose p50 latency is a north-star metric.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Optional
 
 import numpy as np
+
+from seaweedfs_tpu import stats
 
 from seaweedfs_tpu.ec import locate as locate_mod
 from seaweedfs_tpu.ec import stripe
@@ -52,6 +55,7 @@ class EcVolume:
         remote_reader: Optional[RemoteReader] = None,
         version: int = 3,
         shard_size: Optional[int] = None,
+        warm_on_mount: bool = True,
     ):
         self.base = base_file_name
         self.encoder = encoder or new_encoder()
@@ -93,6 +97,22 @@ class EcVolume:
             self.dat_file_size = int(info["dat_size"])
         else:
             self.dat_file_size = self.shard_size * DATA_SHARDS_COUNT
+
+        # resident hot path (SURVEY §7.3.5): pre-build the serving-path
+        # decode matrices and pre-compile the bucketed reconstruct shapes in
+        # the background so the first degraded client read is warm; join
+        # `warm_thread` to wait for it (tests/bench)
+        self.warm_thread: Optional[threading.Thread] = None
+        if warm_on_mount:
+            self.warm_thread = threading.Thread(target=self._warm, daemon=True)
+            self.warm_thread.start()
+
+    def _warm(self) -> None:
+        try:
+            self.encoder.warm_decode_matrices(local_shards=self.shard_ids)
+            self.encoder.warm_reconstruct()
+        except Exception:  # noqa: BLE001 — warmup must never break a mount
+            pass
 
     def close(self) -> None:
         for f in self._shard_files.values():
@@ -171,8 +191,6 @@ class EcVolume:
         """recoverOneRemoteEcShardInterval: read the same interval from every
         other shard and reconstruct the wanted one."""
         import time as _time
-
-        from seaweedfs_tpu import stats
 
         t0 = _time.monotonic()
         try:

@@ -55,7 +55,18 @@ class Encoder:
 
     All shards in one call must share a length (like the reference codec);
     striping/padding policy lives a layer up in `ec.stripe`.
+
+    Reconstructs on the jax/pallas backends are PAD-AND-MASKED to a fixed
+    bucket set of shard lengths: XLA caches compiles per shape, so without
+    bucketing every new interval size pays a fresh compile on the
+    degraded-read serving path (r3 bench: 26x cold/warm gap). Zero padding
+    is exact — GF matmul maps zero columns to zero columns — and the pad is
+    sliced off before returning (SURVEY.md §7.3.5).
     """
+
+    #: shard-length buckets for small-shape reconstructs (serving-path
+    #: intervals are needle records: ~KBs; block-sized reads cap at 1 MiB)
+    RECONSTRUCT_BUCKETS = (4 << 10, 64 << 10, 1 << 20)
 
     def __init__(
         self,
@@ -163,10 +174,67 @@ class Encoder:
             tuple(wanted),
         )
         stack = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in survivors])
-        out = self._apply(m, stack)
+        out = self._apply_bucketed(m, stack)
         for k, w in enumerate(wanted):
             shards[w] = out[k]
         return shards
+
+    def _bucket_for(self, n: int) -> Optional[int]:
+        if self.backend == "numpy" or n == 0:
+            return None  # numpy has no compile cache to miss
+        for b in self.RECONSTRUCT_BUCKETS:
+            if n <= b:
+                return b
+        return None
+
+    def _apply_bucketed(self, m: np.ndarray, stack: np.ndarray) -> np.ndarray:
+        n = stack.shape[-1]
+        b = self._bucket_for(n)
+        if b is None or b == n:
+            return self._apply(m, stack)
+        padded = np.zeros(stack.shape[:-1] + (b,), dtype=np.uint8)
+        padded[..., :n] = stack
+        return self._apply(m, padded)[..., :n]
+
+    def warm_reconstruct(
+        self,
+        wanted_counts: Sequence[int] = (1,),
+        buckets: Optional[Sequence[int]] = None,
+    ) -> int:
+        """Pre-compile the bucketed reconstruct shapes so the first degraded
+        read never pays an XLA compile (jit caches key on shapes only — any
+        GF matrix of the right shape covers every decode matrix). Returns
+        the number of shapes compiled (0 on the numpy backend)."""
+        if self.backend == "numpy":
+            return 0
+        count = 0
+        for L in wanted_counts:
+            m = self.gen_matrix[: max(1, L), : self.data_shards]
+            for b in buckets or self.RECONSTRUCT_BUCKETS:
+                self._apply(m, np.zeros((self.data_shards, b), dtype=np.uint8))
+                count += 1
+        return count
+
+    def warm_decode_matrices(self, local_shards: Sequence[int] = ()) -> int:
+        """Pre-build decode matrices for the dominant serving-path loss
+        patterns: one shard lost, all 13 others reachable (survivors are
+        picked in shard-id order, so the pattern per lost shard is
+        deterministic). The GF Gaussian elimination these need was the
+        bulk of r3's 4.4 ms cold reconstruct. Returns patterns built."""
+        count = 0
+        for lost in range(self.total_shards):
+            if lost in local_shards:
+                continue  # a locally-present shard never needs reconstructing
+            survivors = [s for s in range(self.total_shards) if s != lost]
+            _reconstruction_matrix(
+                self.matrix_kind,
+                self.data_shards,
+                self.parity_shards,
+                tuple(survivors[: self.data_shards]),
+                (lost,),
+            )
+            count += 1
+        return count
 
     def reconstruct_data(self, shards):
         """reedsolomon.ReconstructData: only repair data shards."""

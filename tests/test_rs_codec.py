@@ -95,3 +95,40 @@ def test_other_geometries(rng):
         got = enc.reconstruct(shards)
         for i in range(d + p):
             assert np.array_equal(got[i], orig[i])
+
+
+@pytest.mark.parametrize("backend", ["jax", "pallas"])
+@pytest.mark.parametrize("size", [999, 4096, 5000, 70_000])
+def test_bucketed_reconstruct_matches_numpy(rng, backend, size):
+    """Pad-and-mask bucketing on the accelerator backends must be exact:
+    odd interval sizes reconstruct byte-identically to the numpy oracle."""
+    data = _shards(rng, size=size)
+    gold = Encoder(10, 4, backend="numpy")
+    full = gold.encode([d.copy() for d in data])
+    enc = Encoder(10, 4, backend=backend)
+    assert enc._bucket_for(size) is not None  # the path under test
+    lost = [0, 5, 11]
+    holed = [None if i in lost else s.copy() for i, s in enumerate(full)]
+    rec = enc.reconstruct(holed)
+    for i in lost:
+        np.testing.assert_array_equal(rec[i], full[i], err_msg=f"shard {i}")
+
+
+def test_warm_reconstruct_precompiles_buckets(rng):
+    enc = Encoder(10, 4, backend="jax")
+    assert enc.warm_reconstruct() == len(Encoder.RECONSTRUCT_BUCKETS)
+    assert Encoder(10, 4, backend="numpy").warm_reconstruct() == 0
+
+
+def test_warm_decode_matrices_covers_single_loss_patterns():
+    from seaweedfs_tpu.ops import rs_codec
+
+    enc = Encoder(10, 4, backend="numpy")
+    # local shards never need reconstructing -> excluded from prewarm
+    assert enc.warm_decode_matrices(local_shards=[0, 1, 2]) == 11
+    info = rs_codec._reconstruction_matrix.cache_info()
+    # every prebuilt pattern is a cache hit when the serving path asks
+    before = info.hits
+    survivors = tuple(s for s in range(14) if s != 5)[:10]
+    rs_codec._reconstruction_matrix("vandermonde", 10, 4, survivors, (5,))
+    assert rs_codec._reconstruction_matrix.cache_info().hits == before + 1
