@@ -6,6 +6,8 @@ thread pool (errgroup analog)."""
 
 from __future__ import annotations
 
+import json
+import os
 from concurrent import futures
 from typing import TextIO
 
@@ -23,6 +25,42 @@ from seaweedfs_tpu.shell import (
 _POOL = 8
 
 
+class EncodeCheckpoint:
+    """Persisted ec.encode work-list (SURVEY §5: "encode of 10k volumes
+    resumes"): a batch over many volumes survives interruption — the rerun
+    skips completed vids. One JSON file, fsync'd after every finished
+    volume, keyed by the volume-selection criteria so a checkpoint from a
+    different selection is never misapplied.
+    [ref: weed/shell/command_ec_encode.go — mount empty; upstream restarts
+    from scratch, this is the resume SURVEY §5 calls out as required.]"""
+
+    def __init__(self, path: str, selector: dict):
+        self.path = path
+        self.selector = selector
+
+    def load_done(self) -> set[int]:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return set()
+        if data.get("selector") != self.selector:
+            return set()  # different batch criteria: ignore, will overwrite
+        return {int(v) for v in data.get("done", [])}
+
+    def mark_done(self, done: set[int]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"selector": self.selector, "done": sorted(done)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def finish(self) -> None:
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
 
 
 def _node_ec_load(node: dict) -> int:
@@ -190,6 +228,7 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
         force=False,
         largeBlockSize=0,
         smallBlockSize=0,
+        checkpoint=".ec_encode.checkpoint",
     )
     env.confirm_locked()
     topo = env.volume_list()
@@ -220,7 +259,28 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
     if not vids:
         w.write("ec.encode: no matching volumes\n")
         return
+    # batch resume: single -volumeId runs don't checkpoint (nothing to skip)
+    ckpt = None
+    done: set[int] = set()
+    if not fl.volumeId and fl.checkpoint:
+        ckpt = EncodeCheckpoint(
+            fl.checkpoint,
+            {
+                "collection": fl.collection,
+                "fullPercent": fl.fullPercent,
+                "force": bool(fl.force),
+            },
+        )
+        # no intersection with the current selection: a volume whose
+        # cut-over completed may still linger in a stale topology view —
+        # skipping it is exactly the point
+        done = ckpt.load_done()
+        if done:
+            w.write(f"ec.encode: resuming, {len(done)} volume(s) already done\n")
     for vid in vids:
+        if vid in done:
+            w.write(f"ec.encode volume {vid}: skip (checkpointed)\n")
+            continue
         _do_ec_encode(
             env,
             nodes,
@@ -230,13 +290,20 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
             large_block_size=fl.largeBlockSize,
             small_block_size=fl.smallBlockSize,
         )
+        if ckpt is not None:
+            done.add(vid)
+            ckpt.mark_done(done)
+    if ckpt is not None:
+        ckpt.finish()  # batch complete: a future batch starts fresh
 
 
 register(
     ShellCommand(
         "ec.encode",
-        "ec.encode -volumeId <id> | -collection <name> [-fullPercent 95] [-force]\n"
-        "\tencode a volume into 14 EC shards, spread them, delete the original",
+        "ec.encode -volumeId <id> | -collection <name> [-fullPercent 95] [-force]"
+        " [-checkpoint <file>]\n"
+        "\tencode a volume into 14 EC shards, spread them, delete the original;\n"
+        "\tbatch runs checkpoint per-volume progress and resume on rerun",
         do_ec_encode,
     )
 )
