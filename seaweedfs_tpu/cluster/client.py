@@ -10,6 +10,7 @@ and submit (assign+upload in one call).
 
 from __future__ import annotations
 
+import http.client
 import time
 import threading
 import urllib.error
@@ -22,6 +23,16 @@ from seaweedfs_tpu.pb import MASTER_SERVICE, AssignResponse, Location
 from seaweedfs_tpu.security.jwt import mint_file_token
 
 _VID_CACHE_TTL = 30.0
+
+# Errors that mean "this replica is unusable, try the next one". A wedged
+# server surfaces a bare TimeoutError/ConnectionError from the socket layer
+# (NOT urllib.error.URLError) — catching only URLError would abort failover.
+_FAILOVER_ERRORS = (
+    urllib.error.URLError,
+    TimeoutError,
+    ConnectionError,
+    http.client.HTTPException,
+)
 
 
 class ClusterError(Exception):
@@ -41,6 +52,7 @@ class MasterClient:
         master_address: str,
         signing_key: Optional[bytes] = None,
         read_signing_key: Optional[bytes] = None,
+        http_timeout: float = 30.0,
     ):
         """Trusted clients share the cluster's security.toml keys and mint
         their own per-fid JWTs for delete/read (the reference's clients do
@@ -52,6 +64,7 @@ class MasterClient:
         self.master_address = self.addresses[0]
         self.signing_key = signing_key
         self.read_signing_key = read_signing_key
+        self.http_timeout = http_timeout
         self._clients: dict[str, rpc.RpcClient] = {}
         self._current = self.addresses[0]
         self._lock = threading.Lock()
@@ -206,10 +219,10 @@ class MasterClient:
                     method="POST",
                     headers=headers,
                 )
-                with urllib.request.urlopen(req, timeout=30) as r:
+                with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
                     r.read()
                     return len(data)
-            except urllib.error.URLError as e:  # try a replica
+            except _FAILOVER_ERRORS as e:  # try a replica
                 last_err = e
         raise ClusterError(f"upload of {fid} failed: {last_err}")
 
@@ -230,13 +243,13 @@ class MasterClient:
             for loc in locations:
                 try:
                     req = urllib.request.Request(f"http://{loc.url}/{fid}", headers=headers)
-                    with urllib.request.urlopen(req, timeout=30) as r:
+                    with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
                         return r.read()
                 except urllib.error.HTTPError as e:
                     # 404 on one replica can be staleness (e.g. it was down
                     # during the write) — keep trying the others before failing
                     last_err = f"HTTP {e.code}"
-                except urllib.error.URLError as e:
+                except _FAILOVER_ERRORS as e:
                     last_err = e
         raise ClusterError(f"read of {fid} failed on all locations: {last_err}")
 
@@ -251,10 +264,10 @@ class MasterClient:
                 req = urllib.request.Request(
                     f"http://{loc.url}/{fid}", method="DELETE", headers=headers
                 )
-                with urllib.request.urlopen(req, timeout=30) as r:
+                with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
                     r.read()
                     ok = True
-            except urllib.error.URLError:
+            except _FAILOVER_ERRORS:
                 continue
         return ok
 

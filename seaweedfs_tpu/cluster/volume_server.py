@@ -22,6 +22,7 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
+from concurrent import futures
 from typing import Optional
 
 import time
@@ -58,8 +59,12 @@ class VolumeServer:
         guard: Optional[Guard] = None,
         needle_map_kind: str = "memory",
         ec_lookup_ttl: float = 30.0,
+        replicate_timeout: float = 5.0,
     ):
         self.guard = guard or Guard()
+        # Short per-replica timeout: the fan-out is parallel, so a dead
+        # replica costs one `replicate_timeout`, never a serial sum.
+        self.replicate_timeout = replicate_timeout
         self.store = Store(directories, encoder=encoder, needle_map_kind=needle_map_kind)
         self.store.load()
         self.master_address = master_address
@@ -766,7 +771,6 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             locations = entries[0].get("locations", []) if entries else []
         except Exception as e:  # noqa: BLE001
             return f"replica lookup failed: {e}"
-        errs = []
         # replica hop needs its own token: volume servers share the signing
         # key, so mint one here rather than forwarding the client's
         auth = {}
@@ -777,12 +781,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 "Authorization": "Bearer "
                 + mint_file_token(self.vs.guard.signing_key, str(fid))
             }
-        for locd in locations:
-            if locd["url"] == self.vs.url:
-                continue
+
+        def _push(url: str) -> Optional[str]:
             try:
                 req = urllib.request.Request(
-                    f"http://{locd['url']}/{fid}",
+                    f"http://{url}/{fid}",
                     data=data,
                     method=method,
                     headers={
@@ -791,14 +794,23 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         **({"Content-Type": ctype} if ctype else {}),
                     },
                 )
-                with urllib.request.urlopen(req, timeout=30) as r:
+                with urllib.request.urlopen(req, timeout=self.vs.replicate_timeout) as r:
                     r.read()
+                return None
             except urllib.error.HTTPError as e:
                 if method == "DELETE" and e.code == 404:
-                    continue  # already absent on the replica
-                errs.append(f"{locd['url']}: HTTP {e.code}")
+                    return None  # already absent on the replica
+                return f"{url}: HTTP {e.code}"
             except Exception as e:  # noqa: BLE001
-                errs.append(f"{locd['url']}: {e}")
+                return f"{url}: {e}"
+
+        # Parallel fan-out (store_replicate.go's DistributedOperation analog):
+        # one dead replica costs one timeout, not a serial sum of them.
+        targets = [d["url"] for d in locations if d["url"] != self.vs.url]
+        if not targets:
+            return None
+        with futures.ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+            errs = [e for e in pool.map(_push, targets) if e]
         return "; ".join(errs) or None
 
     def do_POST(self) -> None:

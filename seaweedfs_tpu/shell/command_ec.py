@@ -335,20 +335,40 @@ def _copy_missing_to(env: CommandEnv, node: dict, vid: int, collection: str,
         by_source.setdefault(grpc_addr(src), []).append(sid)
     copied: list[int] = []
     first = not local  # no local shards: also pull the index files
+    # Pull from every source in parallel (command_ec_rebuild.go's
+    # prepareDataToRecover analog): each source writes disjoint .ecNN files
+    # on the rebuilder, and the .ecx/.ecj pull rides exactly one call, so
+    # the copies are independent. Wall time = slowest source, not the sum.
+    jobs = []
     for src_addr, sids in sorted(by_source.items()):
-        env.vs_call(
-            grpc_addr(node),
-            "VolumeEcShardsCopy",
-            {
-                "volume_id": vid,
-                "collection": collection,
-                "shard_ids": sids,
-                "source_data_node": src_addr,
-                "copy_ecx_file": first,
-            },
-        )
+        jobs.append((src_addr, sids, first))
         first = False
-        copied.extend(sids)
+    errs: list[str] = []
+    with futures.ThreadPoolExecutor(max_workers=min(_POOL, max(1, len(jobs)))) as pool:
+        futs = {
+            pool.submit(
+                env.vs_call,
+                grpc_addr(node),
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "shard_ids": sids,
+                    "source_data_node": src_addr,
+                    "copy_ecx_file": with_ecx,
+                },
+            ): (src_addr, sids)
+            for src_addr, sids, with_ecx in jobs
+        }
+        for fut in futures.as_completed(futs):
+            src_addr, sids = futs[fut]
+            try:
+                fut.result()
+                copied.extend(sids)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"{src_addr}: {e}")
+    if errs:
+        raise ShellError(f"shard copies failed: {'; '.join(errs)}")
     return copied
 
 

@@ -276,6 +276,55 @@ def test_replicated_write_lands_on_all_replicas(cluster):
         ) is None
 
 
+def test_replication_fanout_is_parallel_and_timeout_bounded(cluster, monkeypatch):
+    """store_replicate.go analog: the fan-out runs replicas concurrently
+    (two slow replicas cost max(delay), not sum), and a stalled replica
+    costs `replicate_timeout`, never the old serial 30 s."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from seaweedfs_tpu.cluster import volume_server as vs_mod
+
+    master, servers, client = cluster
+    barrier = {"delay": 0.0}
+    orig = vs_mod._Handler.do_POST
+
+    def slow_replica_post(self):
+        if "X-Weed-Replicate" in self.headers and barrier["delay"]:
+            time.sleep(barrier["delay"])
+        orig(self)
+
+    monkeypatch.setattr(vs_mod._Handler, "do_POST", slow_replica_post)
+
+    # 011 -> 3 copies (1 same-rack + 1 diff-rack): primary fans out to 2 replicas.
+    barrier["delay"] = 0.4
+    t0 = time.monotonic()
+    res = client.submit(b"parallel-fanout", replication="011")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.75, f"fan-out took {elapsed:.2f}s — replicas ran serially"
+    vid = int(res.fid.split(",")[0])
+    holders = [s for s in servers if s.store.get_volume(vid) is not None]
+    assert len(holders) == 3
+    for s in holders:
+        with urllib.request.urlopen(f"http://{s.url}/{res.fid}", timeout=10) as r:
+            assert r.read() == b"parallel-fanout"
+
+    # A wedged replica: the write fails after ~replicate_timeout, not 30 s.
+    for s in servers:
+        s.replicate_timeout = 0.5
+    barrier["delay"] = 3.0
+    a = client.assign(replication="011")
+    t0 = time.monotonic()
+    with pytest.raises(ClusterError):
+        client.upload(a.fid, b"stalled-replica")
+    # the client retries every location (3), each bounded by the 0.5 s
+    # replicate_timeout — the old serial path cost 30 s per dead replica
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.5, f"dead replica stalled the write {elapsed:.2f}s"
+    barrier["delay"] = 0.0
+
+
 def test_head_request_returns_no_body(cluster):
     import http.client
 

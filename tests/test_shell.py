@@ -235,6 +235,56 @@ def test_ec_encode_batch_resume_after_interrupt(cluster, tmp_path, monkeypatch):
         assert client.read(fid) == payload, fid
 
 
+def test_rebuild_shard_copies_run_concurrently(cluster, monkeypatch):
+    """command_ec_rebuild.go's prepareDataToRecover analog: survivor shard
+    pulls overlap in time — rebuild wall time is the slowest source, not
+    the sum of copies."""
+    import threading
+    import time as _t
+
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=10)
+    vid = int(fids[0][0].split(",", 1)[0])
+    run(env, "lock")
+    run(env, f"ec.encode -volumeId {vid} -largeBlockSize {LARGE} -smallBlockSize {SMALL}")
+
+    spread = _ec_shard_spread(env, vid)
+    victim_url, victim_sids = sorted(spread.items())[0]
+    victim = next(s for s in servers if s.url == victim_url)
+    host = victim_url.rsplit(":", 1)[0]
+    env.vs_call(
+        f"{host}:{victim.grpc_port}",
+        "VolumeEcShardsDelete",
+        {"volume_id": vid, "shard_ids": victim_sids},
+    )
+
+    orig = env.vs_call
+    lock = threading.Lock()
+    state = {"cur": 0, "max": 0, "copies": 0}
+
+    def tracked(addr, method, req, timeout=300):
+        if method != "VolumeEcShardsCopy":
+            return orig(addr, method, req, timeout=timeout)
+        with lock:
+            state["cur"] += 1
+            state["copies"] += 1
+            state["max"] = max(state["max"], state["cur"])
+        _t.sleep(0.25)  # hold the slot so overlap is observable
+        try:
+            return orig(addr, method, req, timeout=timeout)
+        finally:
+            with lock:
+                state["cur"] -= 1
+
+    monkeypatch.setattr(env, "vs_call", tracked)
+    out = run(env, "ec.rebuild")
+    assert "rebuilt" in out
+    assert state["copies"] >= 2, "expected pulls from >=2 survivor sources"
+    assert state["max"] >= 2, "shard copies ran strictly serially"
+    for fid, payload in fids:
+        assert client.read(fid) == payload
+
+
 def test_volume_vacuum_and_mark(cluster):
     master, servers, client, env = cluster
     fids = _upload_some(client, n=10)
