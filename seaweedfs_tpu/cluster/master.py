@@ -49,6 +49,8 @@ class MasterServer:
         self._rng = random.Random()
         self._grow_lock = threading.Lock()
         self._admin_locks: dict[str, tuple[int, float, str]] = {}
+        self._lock_seq = 0  # bumped on every lock-table mutation; replicated
+        # so stale/reordered payloads can never roll the table back
         self._admin_lock_mu = threading.Lock()
         self._server = rpc.RpcServer(port=port, host=host)
         self._server.add_service(self._build_service())
@@ -92,10 +94,12 @@ class MasterServer:
                 for name, (tok, exp, client) in self._admin_locks.items()
                 if exp > now
             }
+            lock_seq = self._lock_seq
         return {
             "max_volume_id": max_vid,
             "sequence": self.sequencer.watermark,
             "admin_locks": locks,
+            "lock_seq": lock_seq,
         }
 
     def _raft_apply(self, payload: dict) -> None:
@@ -106,13 +110,18 @@ class MasterServer:
         if hasattr(self.sequencer, "floor"):
             self.sequencer.floor(int(payload.get("sequence", 0)))
         # adopt the leader's lock table so a promoted follower honors
-        # in-flight shell operations (mutual exclusion across failover)
+        # in-flight shell operations (mutual exclusion across failover);
+        # seq-gated so a reordered heartbeat — or a stale voter payload
+        # during election adoption — can never roll a fresher table back
         now = time.monotonic()
+        seq = int(payload.get("lock_seq", 0))
         with self._admin_lock_mu:
-            self._admin_locks = {
-                name: (int(tok), now + float(ttl), client)
-                for name, (tok, ttl, client) in payload.get("admin_locks", {}).items()
-            }
+            if seq >= self._lock_seq:
+                self._lock_seq = seq
+                self._admin_locks = {
+                    name: (int(tok), now + float(ttl), client)
+                    for name, (tok, ttl, client) in payload.get("admin_locks", {}).items()
+                }
 
     def _on_become_leader(self) -> None:
         """A fresh leader bumps both watermarks past anything the old
@@ -121,6 +130,11 @@ class MasterServer:
             self.sequencer.floor(self.sequencer.watermark + MemorySequencer.BATCH)
         with self.topology._lock:
             self.topology.max_volume_id += self.VID_TAKEOVER_MARGIN
+        # No lock-table grace is needed here: lease grants are only handed
+        # to clients after replicate_now() got a quorum ack, and RequestVote
+        # responses carry each voter's payload — the winning candidate's
+        # vote quorum intersects the ack quorum, so _raft_apply already
+        # adopted any live lease before this callback runs.
 
     @property
     def is_leader(self) -> bool:
@@ -238,7 +252,26 @@ class MasterServer:
                 now + self.ADMIN_LOCK_TTL,
                 req.get("client_name", ""),
             )
-            return {"token": token, "lock_ts_ns": int(now * 1e9)}
+            self._lock_seq += 1
+        # The lease is only durable once a quorum has seen it: replicate
+        # synchronously BEFORE handing out the token, so a leader crash can
+        # never lose a lock a client believes it holds (the new leader
+        # adopts the table from its vote quorum, which intersects the ack
+        # quorum). Replication happens outside the mutex — payload_fn locks.
+        if self.raft is not None and not self.raft.replicate_now():
+            with self._admin_lock_mu:
+                cur = self._admin_locks.get(name)
+                if cur is not None and cur[0] == token:
+                    if holder is not None:
+                        self._admin_locks[name] = holder  # restore prior lease
+                    else:
+                        del self._admin_locks[name]
+                    self._lock_seq += 1
+            raise rpc.RpcFault(
+                f"lock {name} lease not acknowledged by a master quorum",
+                code=grpc.StatusCode.UNAVAILABLE,
+            )
+        return {"token": token, "lock_ts_ns": int(now * 1e9)}
 
     def _rpc_release_admin_token(self, req: dict, ctx) -> dict:
         if not self.is_leader:
@@ -254,6 +287,9 @@ class MasterServer:
             holder = self._admin_locks.get(name)
             if holder is not None and holder[0] == prev:
                 del self._admin_locks[name]
+                self._lock_seq += 1
+        # release is best-effort: the next heartbeat replicates the removal,
+        # and the TTL bounds how long a follower could consider it held
         return {}
 
     def _rpc_heartbeat(self, req: dict, ctx) -> dict:

@@ -140,7 +140,15 @@ class RaftNode:
                 self.voted_for = candidate
                 self._last_heard = time.monotonic()
                 self._save_state()
-            return {"term": self.term, "granted": granted}
+        resp = {"term": self.term, "granted": granted}
+        if granted:
+            # piggyback this voter's applied payload: the winning candidate
+            # adopts the freshest table from its vote quorum, which must
+            # intersect any quorum that acked a replicated lease — so a
+            # quorum-acked admin lock survives leader failover even though
+            # this raft has no log up-to-dateness restriction
+            resp["payload"] = self._payload_fn()
+        return resp
 
     def _rpc_append_entries(self, req: dict, ctx) -> dict:
         term, leader = int(req["term"]), req["leader"]
@@ -204,6 +212,15 @@ class RaftNode:
             self._last_heard = time.monotonic()
         resps = self._fanout("RequestVote", {"term": term, "candidate": self.me})
         votes = 1 + sum(1 for r in resps if r.get("granted"))
+        # adopt voter payloads BEFORE taking leadership: apply_fn is
+        # seq-aware, so the freshest lock table in the vote quorum wins
+        # regardless of arrival order
+        for r in resps:
+            if r.get("granted") and r.get("payload"):
+                try:
+                    self._apply_fn(r["payload"])
+                except Exception:  # noqa: BLE001 — a bad payload must not block election
+                    pass
         higher = max((r["term"] for r in resps if r["term"] > term), default=0)
         quorum = (len(self.peers) + 1) // 2 + 1
         with self._lock:
@@ -254,7 +271,8 @@ class RaftNode:
             t.join(self._peer_timeout() + 0.5)
         return results
 
-    def _broadcast_heartbeat(self) -> None:
+    def _broadcast_heartbeat(self) -> bool:
+        """One replication round. Returns True when a quorum acked."""
         with self._lock:
             term = self.term
         payload = self._payload_fn()
@@ -265,10 +283,24 @@ class RaftNode:
         higher = max((r["term"] for r in resps if r["term"] > term), default=0)
         with self._lock:
             quorum = (len(self.peers) + 1) // 2 + 1
-            if acks + 1 >= quorum:
+            quorum_ok = acks + 1 >= quorum
+            if quorum_ok:
                 self._last_quorum_ack = time.monotonic()
             if higher > self.term:
                 self.term = higher
                 self.state = FOLLOWER
                 self.voted_for = None
                 self._save_state()
+                return False
+        return quorum_ok
+
+    def replicate_now(self) -> bool:
+        """Synchronously push the current payload to a quorum (used by the
+        master to make an admin-lock lease durable BEFORE handing the token
+        to the client). Returns False when no quorum acked — the caller
+        must treat the mutation as not committed."""
+        if not self.peers:
+            return self.is_leader  # single-node: local state is the quorum
+        if not self.is_leader:
+            return False
+        return self._broadcast_heartbeat()

@@ -56,6 +56,7 @@ class EcVolume:
         version: int = 3,
         shard_size: Optional[int] = None,
         warm_on_mount: bool = True,
+        ecj_compact_threshold: int = 1 << 20,
     ):
         self.base = base_file_name
         self.encoder = encoder or new_encoder()
@@ -70,6 +71,17 @@ class EcVolume:
         else:
             self.large = large_block_size
             self.small = small_block_size
+
+        # mount-time journal compaction: a delete-heavy volume's .ecj is
+        # folded into .ecx tombstones once it crosses the threshold, so the
+        # journal (and its replay cost) stays bounded over the volume's life
+        ecj_path = base_file_name + ".ecj"
+        if (
+            ecj_compact_threshold
+            and os.path.exists(ecj_path)
+            and os.path.getsize(ecj_path) >= ecj_compact_threshold
+        ):
+            stripe.compact_ecj(base_file_name)
 
         with open(base_file_name + ".ecx", "rb") as f:
             self._index = idx_mod.index_entries_array(f.read())

@@ -322,14 +322,19 @@ def write_dat_file(
 
 def write_idx_file_from_ec_index(base_file_name: str) -> None:
     """<base>.ecx + <base>.ecj -> <base>.idx (WriteIdxFileFromEcIndex):
-    copy sorted entries, then append a tombstone per journaled deletion."""
+    copy sorted entries, then append a tombstone per journaled deletion.
+    Entries already tombstoned in .ecx (by compact_ecj) are normalized to
+    the same (key, 0, -1) shape a journal replay would have appended."""
     with open(base_file_name + ".ecx", "rb") as f:
         ecx = f.read()
     entries = list(idx_mod.walk_index_buffer(ecx))
     deleted = read_ecj(base_file_name)
     with open(base_file_name + ".idx", "wb") as out:
         for key, off, size in entries:
-            out.write(types.pack_index_entry(key, off, size))
+            if types.is_deleted(size):
+                out.write(types.pack_index_entry(key, 0, types.TOMBSTONE_FILE_SIZE))
+            else:
+                out.write(types.pack_index_entry(key, off, size))
         for key in deleted:
             out.write(types.pack_index_entry(key, 0, types.TOMBSTONE_FILE_SIZE))
 
@@ -352,3 +357,36 @@ def read_ecj(base_file_name: str) -> list[int]:
     return [
         int.from_bytes(buf[i * 8 : i * 8 + 8], "big") for i in range(n)
     ]
+
+
+def compact_ecj(base_file_name: str) -> int:
+    """Fold the deletion journal into the index (the reference compacts the
+    .ecj on mount so a delete-heavy EC volume's journal doesn't grow without
+    bound [ref: weed/storage/erasure_coding ecj replay/compact; SURVEY §5]):
+    tombstone every journaled id in .ecx, then drop .ecj.
+
+    Crash-safe ordering: write .ecx.cpt -> fsync -> rename over .ecx ->
+    unlink .ecj. A crash before the rename leaves both files untouched; a
+    crash after it leaves a stale .ecj whose replay only re-tombstones
+    already-dead entries — idempotent either way. Returns the number of
+    journal entries folded."""
+    deleted = set(read_ecj(base_file_name))
+    if not deleted:
+        return 0
+    ecx = base_file_name + ".ecx"
+    with open(ecx, "rb") as f:
+        buf = f.read()
+    tmp = ecx + ".cpt"
+    with open(tmp, "wb") as out:
+        for key, off, size in idx_mod.walk_index_buffer(buf):
+            if key in deleted and not types.is_deleted(size):
+                size = types.TOMBSTONE_FILE_SIZE
+            out.write(types.pack_index_entry(key, off, size))
+        out.flush()
+        os.fsync(out.fileno())
+    os.replace(tmp, ecx)
+    try:
+        os.remove(base_file_name + ".ecj")
+    except FileNotFoundError:
+        pass
+    return len(deleted)

@@ -120,6 +120,74 @@ def test_unreadable_when_too_many_lost(volume):
             ev.read_needle_blob(nid)
 
 
+def test_ecj_compaction_preserves_read_behavior(volume):
+    """delete -> remount -> read must be identical before and after
+    compaction: journaled deletes become .ecx tombstones, .ecj is dropped,
+    and a second compaction is a no-op (idempotent after a crash that
+    leaves a stale journal)."""
+    base, records = volume
+    dead = [10, 999]
+    with open_vol(base) as ev:
+        for nid in dead:
+            assert ev.delete_needle(nid)
+
+    def behavior():
+        out = {}
+        with open_vol(base, ecj_compact_threshold=0) as ev:
+            for nid in list(records) + [12345]:
+                try:
+                    out[nid] = ev.read_needle_blob(nid)
+                except NeedleDeleted:
+                    out[nid] = "deleted"
+                except NeedleNotFound:
+                    out[nid] = "not-found"
+        return out
+
+    before = behavior()
+    assert before[10] == "deleted" and before[999] == "deleted"
+
+    folded = stripe.compact_ecj(base)
+    assert folded == len(dead)
+    assert not os.path.exists(base + ".ecj"), ".ecj must be dropped"
+    assert behavior() == before, "read behavior changed across compaction"
+    assert stripe.compact_ecj(base) == 0  # idempotent: nothing left to fold
+
+    # deletes after compaction start a fresh journal; a re-delete of an
+    # already-tombstoned needle reports False like any dead needle
+    with open_vol(base) as ev:
+        assert not ev.delete_needle(10)
+        assert ev.delete_needle(3)
+    assert os.path.exists(base + ".ecj")
+    after = behavior()
+    assert after[3] == "deleted"
+
+    # ec.decode's idx conversion sees the same deletions either way
+    stripe.write_idx_file_from_ec_index(base)
+    tombs = {
+        key
+        for key, _, size in idx_mod.walk_index_buffer(open(base + ".idx", "rb").read())
+        if types.is_deleted(size)
+    }
+    assert tombs == {3, 10, 999}
+
+
+def test_ecj_compaction_triggers_at_mount_threshold(volume):
+    base, records = volume
+    with open_vol(base) as ev:
+        assert ev.delete_needle(42)
+    assert os.path.exists(base + ".ecj")
+    # below threshold: journal stays
+    with open_vol(base, ecj_compact_threshold=1 << 20):
+        pass
+    assert os.path.exists(base + ".ecj")
+    # at/above threshold: mount folds it, reads unchanged
+    with open_vol(base, ecj_compact_threshold=8) as ev:
+        with pytest.raises(NeedleDeleted):
+            ev.read_needle_blob(42)
+        assert ev.read_needle_blob(3)
+    assert not os.path.exists(base + ".ecj")
+
+
 def test_truncated_shard_falls_back_to_reconstruct(volume):
     """A truncated local shard must not serve zero-padded (corrupt) data."""
     base, records = volume
