@@ -30,6 +30,17 @@ def main(argv=None) -> int:
     if not getattr(args, "_run", None):
         parser.print_help()
         return 2
+    # process-wide TLS from security.toml [grpc]: activated before any
+    # command binds a socket or dials a peer, so every server AND tool
+    # (shell, upload, sync, ...) in this process speaks TLS uniformly
+    from seaweedfs_tpu.security import tls as _tls
+    from seaweedfs_tpu.utils.config import load_configuration as _load_conf
+
+    try:
+        _tls.configure_from_conf(_load_conf("security"))
+    except (OSError, ValueError) as e:
+        print(f"security.toml tls config error: {e}", file=sys.stderr)
+        return 1
     profiler = None
     if getattr(args, "cpuprofile", ""):
         import cProfile

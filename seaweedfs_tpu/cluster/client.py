@@ -20,6 +20,7 @@ from typing import Optional
 
 from seaweedfs_tpu import rpc
 from seaweedfs_tpu.pb import MASTER_SERVICE, AssignResponse, Location
+from seaweedfs_tpu.security import tls
 from seaweedfs_tpu.security.jwt import mint_file_token
 
 _VID_CACHE_TTL = 30.0
@@ -214,12 +215,12 @@ class MasterClient:
         for loc in locations:
             try:
                 req = urllib.request.Request(
-                    f"http://{loc.url}/{fid}",
+                    f"{tls.scheme()}://{loc.url}/{fid}",
                     data=data,
                     method="POST",
                     headers=headers,
                 )
-                with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
+                with tls.urlopen(req, timeout=self.http_timeout) as r:
                     r.read()
                     return len(data)
             except _FAILOVER_ERRORS as e:  # try a replica
@@ -242,8 +243,8 @@ class MasterClient:
                 )
             for loc in locations:
                 try:
-                    req = urllib.request.Request(f"http://{loc.url}/{fid}", headers=headers)
-                    with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
+                    req = urllib.request.Request(f"{tls.scheme()}://{loc.url}/{fid}", headers=headers)
+                    with tls.urlopen(req, timeout=self.http_timeout) as r:
                         return r.read()
                 except urllib.error.HTTPError as e:
                     # 404 on one replica can be staleness (e.g. it was down
@@ -262,9 +263,9 @@ class MasterClient:
         for loc in self.lookup(vid):
             try:
                 req = urllib.request.Request(
-                    f"http://{loc.url}/{fid}", method="DELETE", headers=headers
+                    f"{tls.scheme()}://{loc.url}/{fid}", method="DELETE", headers=headers
                 )
-                with urllib.request.urlopen(req, timeout=self.http_timeout) as r:
+                with tls.urlopen(req, timeout=self.http_timeout) as r:
                     r.read()
                     ok = True
             except _FAILOVER_ERRORS:

@@ -49,8 +49,14 @@ class MasterServer:
         self._rng = random.Random()
         self._grow_lock = threading.Lock()
         self._admin_locks: dict[str, tuple[int, float, str]] = {}
-        self._lock_seq = 0  # bumped on every lock-table mutation; replicated
-        # so stale/reordered payloads can never roll the table back
+        # Lock-table version: (raft term, mutation seq), compared
+        # lexicographically on apply. The term component dominates, so a
+        # deposed leader whose local seq inflated (failed grants bump it)
+        # can never out-version the new leader's table — without it, the
+        # seq-gate itself would reject the fresher table and break mutual
+        # exclusion across failover.
+        self._lock_seq = 0
+        self._lock_term = 0
         self._admin_lock_mu = threading.Lock()
         self._server = rpc.RpcServer(port=port, host=host)
         self._server.add_service(self._build_service())
@@ -94,12 +100,13 @@ class MasterServer:
                 for name, (tok, exp, client) in self._admin_locks.items()
                 if exp > now
             }
-            lock_seq = self._lock_seq
+            lock_seq, lock_term = self._lock_seq, self._lock_term
         return {
             "max_volume_id": max_vid,
             "sequence": self.sequencer.watermark,
             "admin_locks": locks,
             "lock_seq": lock_seq,
+            "lock_term": lock_term,
         }
 
     def _raft_apply(self, payload: dict) -> None:
@@ -114,10 +121,10 @@ class MasterServer:
         # seq-gated so a reordered heartbeat — or a stale voter payload
         # during election adoption — can never roll a fresher table back
         now = time.monotonic()
-        seq = int(payload.get("lock_seq", 0))
+        version = (int(payload.get("lock_term", 0)), int(payload.get("lock_seq", 0)))
         with self._admin_lock_mu:
-            if seq >= self._lock_seq:
-                self._lock_seq = seq
+            if version >= (self._lock_term, self._lock_seq):
+                self._lock_term, self._lock_seq = version
                 self._admin_locks = {
                     name: (int(tok), now + float(ttl), client)
                     for name, (tok, ttl, client) in payload.get("admin_locks", {}).items()
@@ -226,6 +233,13 @@ class MasterServer:
     # cluster-wide exclusive lock leased from the master
     # [VERIFY: weed/wdclient/exclusive_locks/exclusive_locker.go; SURVEY.md §3.1].
 
+    def _bump_lock_version(self) -> None:
+        """Advance the lock-table version (caller holds _admin_lock_mu):
+        stamp the current raft term so this table out-versions anything a
+        deposed leader produced in an earlier term."""
+        self._lock_term = getattr(self.raft, "term", 0) if self.raft else 0
+        self._lock_seq += 1
+
     ADMIN_LOCK_TTL = 30.0
 
     def _rpc_lease_admin_token(self, req: dict, ctx) -> dict:
@@ -252,7 +266,7 @@ class MasterServer:
                 now + self.ADMIN_LOCK_TTL,
                 req.get("client_name", ""),
             )
-            self._lock_seq += 1
+            self._bump_lock_version()
         # The lease is only durable once a quorum has seen it: replicate
         # synchronously BEFORE handing out the token, so a leader crash can
         # never lose a lock a client believes it holds (the new leader
@@ -266,7 +280,7 @@ class MasterServer:
                         self._admin_locks[name] = holder  # restore prior lease
                     else:
                         del self._admin_locks[name]
-                    self._lock_seq += 1
+                    self._bump_lock_version()
             raise rpc.RpcFault(
                 f"lock {name} lease not acknowledged by a master quorum",
                 code=grpc.StatusCode.UNAVAILABLE,
@@ -287,7 +301,7 @@ class MasterServer:
             holder = self._admin_locks.get(name)
             if holder is not None and holder[0] == prev:
                 del self._admin_locks[name]
-                self._lock_seq += 1
+                self._bump_lock_version()
         # release is best-effort: the next heartbeat replicates the removal,
         # and the TTL bounds how long a follower could consider it held
         return {}

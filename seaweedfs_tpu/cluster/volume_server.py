@@ -37,6 +37,7 @@ from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import VolumeReadOnly
+from seaweedfs_tpu.security import tls
 
 _COPY_CHUNK = 1024 * 1024
 _EC_EXTS = [".ecx", ".ecj", ".eci"]
@@ -80,6 +81,7 @@ class VolumeServer:
         self.grpc_port = self._grpc.port
 
         self._http = _ThreadingHTTPServer((host, port), _Handler)
+        tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
         self._http.volume_server = self
         self.port = self._http.server_address[1]
         self.public_url = public_url or f"{host}:{self.port}"
@@ -785,7 +787,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         def _push(url: str) -> Optional[str]:
             try:
                 req = urllib.request.Request(
-                    f"http://{url}/{fid}",
+                    f"{tls.scheme()}://{url}/{fid}",
                     data=data,
                     method=method,
                     headers={
@@ -794,7 +796,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         **({"Content-Type": ctype} if ctype else {}),
                     },
                 )
-                with urllib.request.urlopen(req, timeout=self.vs.replicate_timeout) as r:
+                with tls.urlopen(req, timeout=self.vs.replicate_timeout) as r:
                     r.read()
                 return None
             except urllib.error.HTTPError as e:

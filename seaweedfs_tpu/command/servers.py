@@ -38,7 +38,9 @@ def _wait_forever() -> None:
 
 
 def _load_guard():
-    """Build a security.Guard from security.toml (None = security off)."""
+    """Build a security.Guard from security.toml (None = security off).
+    TLS is NOT loaded here — __main__ activates it process-wide from the
+    same TOML before any command runs."""
     from seaweedfs_tpu.security import Guard
     from seaweedfs_tpu.utils.config import get_nested, load_configuration
 

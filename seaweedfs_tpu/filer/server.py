@@ -33,6 +33,7 @@ from seaweedfs_tpu.filer.entry import Attributes, Entry, normalize_path
 from seaweedfs_tpu.filer.filer import Filer, MetaEvent
 from seaweedfs_tpu.filer.store import EntryNotFound, FilerStore, make_store
 from seaweedfs_tpu.pb import FILER_SERVICE
+from seaweedfs_tpu.security import tls
 
 import io
 import time
@@ -68,6 +69,7 @@ class FilerServer:
         self.grpc_port = self._grpc.port
 
         self._http = _ThreadingHTTPServer((host, port), _Handler)
+        tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
         self._http.filer_server = self
         self.port = self._http.server_address[1]
         self._http_thread = threading.Thread(target=self._http.serve_forever, daemon=True)

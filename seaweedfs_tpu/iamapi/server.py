@@ -24,6 +24,7 @@ from seaweedfs_tpu.s3api.auth import (
     save_identities,
 )
 from seaweedfs_tpu.utils import httpd
+from seaweedfs_tpu.security import tls
 
 _MUTATING = {
     "CreateUser",
@@ -69,6 +70,7 @@ class IamApiServer:
         self.bootstrap_token = bootstrap_token
         self.lock = threading.Lock()  # identities list is shared state
         self._http = _ThreadingHTTPServer((host, port), _Handler)
+        tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
         self._http.iam_server = self
         self.port = self._http.server_address[1]
         self.extra_hosts |= {f"{h}:{self.port}" for h in httpd.loopback_aliases(host)}

@@ -28,6 +28,7 @@ from seaweedfs_tpu import rpc
 from seaweedfs_tpu.filer.client import FilerClient
 from seaweedfs_tpu.pb import MQ_SERVICE
 from seaweedfs_tpu.utils.log_buffer import LogBuffer, LogRecord
+from seaweedfs_tpu.security import tls
 
 TOPICS_ROOT = "/topics"
 
@@ -45,12 +46,12 @@ class _Partition:
 
     def _flush_segment(self, first_ts: int, last_ts: int, records: list[LogRecord]) -> None:
         body = "\n".join(json.dumps(r.to_dict()) for r in records).encode()
-        url = f"http://{self.broker.filer_http}{urllib.parse.quote(self.dir)}/{first_ts:020d}.seg"
+        url = f"{tls.scheme()}://{self.broker.filer_http}{urllib.parse.quote(self.dir)}/{first_ts:020d}.seg"
         req = urllib.request.Request(
             url, data=body, method="PUT",
             headers={"Content-Type": "application/x-weedtpu-segment"},
         )
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with tls.urlopen(req, timeout=60) as r:
             r.read()
         self.flush_seq += 1
 

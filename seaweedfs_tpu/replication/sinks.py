@@ -12,6 +12,7 @@ import urllib.request
 from typing import Optional
 
 from seaweedfs_tpu.s3api.auth import sign_request
+from seaweedfs_tpu.security import tls
 
 
 class ReplicationSink:
@@ -80,7 +81,7 @@ class FilerSink(ReplicationSink):
 
     def _url(self, key: str, query: str = "") -> str:
         path = (self.root.rstrip("/") + "/" + key).replace("//", "/")
-        return f"http://{self.filer_http}{urllib.parse.quote(path)}" + (
+        return f"{tls.scheme()}://{self.filer_http}{urllib.parse.quote(path)}" + (
             f"?{query}" if query else ""
         )
 
@@ -96,7 +97,7 @@ class FilerSink(ReplicationSink):
                 method="PUT",
                 headers={"Content-Type": mime or "application/octet-stream"},
             )
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with tls.urlopen(req, timeout=60) as r:
             r.read()
 
     def delete(self, key: str, is_dir: bool = False) -> None:
@@ -104,7 +105,7 @@ class FilerSink(ReplicationSink):
             req = urllib.request.Request(
                 self._url(key, "recursive=true"), method="DELETE"
             )
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with tls.urlopen(req, timeout=60) as r:
                 r.read()
         except urllib.error.HTTPError as e:
             if e.code != 404:

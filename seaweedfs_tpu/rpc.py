@@ -27,6 +27,8 @@ from typing import Any, Callable, Iterator, Optional
 
 import grpc
 
+from seaweedfs_tpu.security import tls
+
 
 def _json_ser(obj: Any) -> bytes:
     return json.dumps(obj, separators=(",", ":")).encode()
@@ -167,7 +169,13 @@ class RpcServer:
             ],
         )
         self._server.add_generic_rpc_handlers((_GenericHandler(self._services),))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        # process-wide TLS (security.toml [grpc]) — mTLS when configured,
+        # matching the reference's per-process grpc cert wiring
+        creds = tls.server_credentials()
+        if creds is not None:
+            self.port = self._server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         self._started = False
 
     def add_service(self, svc: Service) -> None:
@@ -189,13 +197,16 @@ class RpcClient:
 
     def __init__(self, address: str):
         self.address = address
-        self._channel = grpc.insecure_channel(
-            address,
-            options=[
-                ("grpc.max_send_message_length", 64 * 1024 * 1024),
-                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
-            ],
-        )
+        options = [
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            *tls.channel_options(),
+        ]
+        creds = tls.channel_credentials()
+        if creds is not None:
+            self._channel = grpc.secure_channel(address, creds, options=options)
+        else:
+            self._channel = grpc.insecure_channel(address, options=options)
         self._lock = threading.Lock()
         self._stubs: dict[tuple, Callable] = {}
 

@@ -49,6 +49,7 @@ from seaweedfs_tpu.s3api.auth import (
     save_identities,
 )
 from seaweedfs_tpu.utils import httpd
+from seaweedfs_tpu.security import tls
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_ROOT = "/buckets/.uploads"
@@ -94,6 +95,7 @@ class S3ApiServer:
         self._iam_checked_at = 0.0
         self.host = host
         self._http = _ThreadingHTTPServer((host, port), _Handler)
+        tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
         self._http.s3_server = self
         self.port = self._http.server_address[1]
         self.extra_hosts |= {f"{h}:{self.port}" for h in httpd.loopback_aliases(host)}
@@ -144,7 +146,7 @@ class S3ApiServer:
 
     def filer_url(self, path: str, query: str = "") -> str:
         enc = urllib.parse.quote(path)
-        return f"http://{self.filer_http}{enc}" + (f"?{query}" if query else "")
+        return f"{tls.scheme()}://{self.filer_http}{enc}" + (f"?{query}" if query else "")
 
     def walk_keys(
         self, bucket: str, prefix: str = "", after: str = ""
@@ -542,7 +544,7 @@ class _Handler(httpd.QuietHandler):
             headers=headers,
         )
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with tls.urlopen(req, timeout=60) as r:
                 meta = json.loads(r.read())
         except urllib.error.URLError as e:
             self._error(500, "InternalError", str(e))
@@ -567,7 +569,7 @@ class _Handler(httpd.QuietHandler):
             method="HEAD" if head else "GET",
         )
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with tls.urlopen(req, timeout=60) as r:
                 body = b"" if head else r.read()
                 out_headers = {
                     "ETag": r.headers.get("ETag", ""),
@@ -637,7 +639,7 @@ class _Handler(httpd.QuietHandler):
             method="PUT",
             headers={"Content-Type": ctype},
         )
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with tls.urlopen(req, timeout=60) as r:
             meta = json.loads(r.read())
         root = _xml("CopyObjectResult")
         _sub(root, "ETag", f'"{meta.get("etag", "")}"')
@@ -728,7 +730,7 @@ class _Handler(httpd.QuietHandler):
         req = urllib.request.Request(
             self.s3.filer_url(path), data=body, method="PUT"
         )
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with tls.urlopen(req, timeout=60) as r:
             meta = json.loads(r.read())
         self._reply(200, headers={"ETag": f'"{meta.get("etag", "")}"'})
 
@@ -832,7 +834,7 @@ class _Handler(httpd.QuietHandler):
         # final object)
         self.s3.filer.delete(d, recursive=True, delete_data=False)
         root = _xml("CompleteMultipartUploadResult")
-        _sub(root, "Location", f"http://{self.s3.url}/{bucket}/{key}")
+        _sub(root, "Location", f"{tls.scheme()}://{self.s3.url}/{bucket}/{key}")
         _sub(root, "Bucket", bucket)
         _sub(root, "Key", key)
         _sub(root, "ETag", f'"{etag}"')

@@ -55,6 +55,22 @@ expires_after_seconds = 10
 [guard]
 # IPs allowed to bypass JWT checks
 white_list = []
+
+# TLS/mTLS for the gRPC control plane. Setting `ca` turns TLS on for every
+# server and client in the process. Generate a throwaway CA + leaf pair with
+#   python -c "from seaweedfs_tpu.security.tls import generate_self_signed; \\
+#              print(generate_self_signed('./certs'))"
+[grpc]
+ca = ""
+cert = ""
+key = ""
+require_client_auth = true    # mTLS: peers must present a CA-signed cert
+# override_authority = "weedtpu-cluster"   # when certs name the cluster, not each host
+
+# HTTPS on the HTTP data path (volume/filer/s3/webdav/iam servers); uses the
+# [grpc] cert material
+[https]
+enabled = false
 ''',
     "master": '''\
 # master.toml

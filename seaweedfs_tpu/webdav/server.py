@@ -16,6 +16,7 @@ from typing import Optional
 from seaweedfs_tpu.filer.client import FilerClient
 from seaweedfs_tpu.filer.entry import Entry
 from seaweedfs_tpu.utils import httpd
+from seaweedfs_tpu.security import tls
 
 _DAV = "DAV:"
 
@@ -34,6 +35,7 @@ class WebDavServer:
         self.root = root.rstrip("/") or ""
         self.host = host
         self._http = _ThreadingHTTPServer((host, port), _Handler)
+        tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
         self._http.dav_server = self
         self.port = self._http.server_address[1]
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
@@ -62,7 +64,7 @@ class WebDavServer:
         return (self.root + p) if p != "/" else (self.root or "/")
 
     def filer_url(self, path: str) -> str:
-        return f"http://{self.filer_http}{urllib.parse.quote(path)}"
+        return f"{tls.scheme()}://{self.filer_http}{urllib.parse.quote(path)}"
 
 
 class _ThreadingHTTPServer(httpd.ThreadingHTTPServer):
@@ -170,7 +172,7 @@ class _Handler(httpd.QuietHandler):
             fwd["Range"] = self.headers["Range"]
         try:
             req = urllib.request.Request(self.dav.filer_url(fpath), headers=fwd)
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with tls.urlopen(req, timeout=60) as r:
                 body = r.read()
                 headers = {"Last-Modified": r.headers.get("Last-Modified", "")}
                 if r.headers.get("Content-Range"):
@@ -202,7 +204,7 @@ class _Handler(httpd.QuietHandler):
             headers={"Content-Type": self.headers.get("Content-Type", "application/octet-stream")},
         )
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with tls.urlopen(req, timeout=60) as r:
                 r.read()
         except urllib.error.URLError as e:
             self._reply(500, str(e).encode(), "text/plain")
@@ -262,14 +264,14 @@ class _Handler(httpd.QuietHandler):
             self._reply(412)
             return
         try:
-            with urllib.request.urlopen(self.dav.filer_url(src), timeout=60) as r:
+            with tls.urlopen(self.dav.filer_url(src), timeout=60) as r:
                 data = r.read()
                 ctype = r.headers.get("Content-Type", "application/octet-stream")
             req = urllib.request.Request(
                 self.dav.filer_url(dst), data=data, method="PUT",
                 headers={"Content-Type": ctype},
             )
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with tls.urlopen(req, timeout=60) as r:
                 r.read()
         except urllib.error.URLError as e:
             self._reply(500, str(e).encode(), "text/plain")

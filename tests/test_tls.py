@@ -1,0 +1,220 @@
+"""TLS/mTLS cluster tests (weed/security/tls.go analog, VERDICT r3 #7):
+self-signed CA + leaf, process-wide activation, then a real master +
+volume-server cluster doing assign/upload/read/delete with the gRPC
+control plane on mTLS and the HTTP data path on HTTPS."""
+
+import ssl
+import urllib.request
+
+import grpc
+import pytest
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.security import tls
+
+
+@pytest.fixture()
+def certs(tmp_path):
+    paths = tls.generate_self_signed(str(tmp_path / "certs"))
+    yield paths
+    tls.reset()
+
+
+def test_generate_self_signed_material(certs):
+    for p in certs.values():
+        pem = open(p, "rb").read()
+        assert b"BEGIN" in pem
+
+
+def test_rpc_over_mtls_and_plaintext_rejected(certs):
+    tls.configure(
+        certs["ca"], certs["cert"], certs["key"],
+        override_authority="weedtpu-cluster",
+    )
+    server = rpc.RpcServer(port=0)
+    svc = rpc.Service("weedtpu.Test")
+    svc.add("Echo", lambda req, ctx: {"echo": req.get("x")})
+    server.add_service(svc)
+    server.start()
+    try:
+        with rpc.RpcClient(f"127.0.0.1:{server.port}") as c:
+            assert c.call("weedtpu.Test", "Echo", {"x": 42}, timeout=10) == {"echo": 42}
+        # a plaintext client must NOT get through a TLS server
+        tls.reset()
+        with rpc.RpcClient(f"127.0.0.1:{server.port}") as c:
+            with pytest.raises(grpc.RpcError):
+                c.call("weedtpu.Test", "Echo", {"x": 1}, timeout=3)
+    finally:
+        server.stop()
+
+
+def test_mtls_rejects_unauthenticated_client(certs, tmp_path):
+    tls.configure(
+        certs["ca"], certs["cert"], certs["key"],
+        override_authority="weedtpu-cluster",
+    )
+    server = rpc.RpcServer(port=0)
+    svc = rpc.Service("weedtpu.Test")
+    svc.add("Echo", lambda req, ctx: {"ok": True})
+    server.add_service(svc)
+    server.start()
+    try:
+        # client trusts the CA but presents NO certificate: the mTLS
+        # handshake must fail
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=open(certs["ca"], "rb").read()
+        )
+        ch = grpc.secure_channel(
+            f"127.0.0.1:{server.port}",
+            creds,
+            options=[("grpc.ssl_target_name_override", "weedtpu-cluster")],
+        )
+        stub = ch.unary_unary(
+            "/weedtpu.Test/Echo",
+            request_serializer=lambda o: b"{}",
+            response_deserializer=lambda b: b,
+        )
+        with pytest.raises(grpc.RpcError):
+            stub({}, timeout=3)
+        ch.close()
+    finally:
+        server.stop()
+
+
+def test_plaintext_probe_does_not_block_https_server(certs, tmp_path):
+    """The TLS handshake runs in the per-connection worker, not accept():
+    an idle/plaintext probe must not park the server's accept loop."""
+    import socket
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    tls.configure(
+        certs["ca"], certs["cert"], certs["key"],
+        https=True, override_authority="weedtpu-cluster",
+    )
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.4)
+    vs.start()
+    try:
+        # park a raw TCP connection that never handshakes
+        probe = socket.create_connection((vs.host, vs.port), timeout=5)
+        try:
+            import time
+
+            t0 = time.monotonic()
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.load_verify_locations(certs["ca"])
+            ctx.load_cert_chain(certs["cert"], certs["key"])
+            ctx.check_hostname = False
+            # a real HTTPS request on a second connection must go through
+            # promptly while the probe is still parked
+            with urllib.request.urlopen(
+                f"https://{vs.host}:{vs.port}/status", timeout=10, context=ctx
+            ) as r:
+                assert r.status == 200
+            assert time.monotonic() - t0 < 5, "probe blocked the accept loop"
+        finally:
+            probe.close()
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_filer_and_gateway_paths_over_tls(certs, tmp_path):
+    """The filer's chunked upload (filer -> master assign -> volume POST)
+    and read-back ride HTTPS end to end — the converted gateway/filer
+    urlopen sites, not just the raw volume data path."""
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer import FilerServer
+
+    tls.configure(
+        certs["ca"], certs["cert"], certs["key"],
+        https=True, override_authority="weedtpu-cluster",
+    )
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.4)
+    vs.start()
+    fs = FilerServer(master.address, chunk_size=1024, log_dir=str(tmp_path / "meta"))
+    fs.start()
+    try:
+        import os
+
+        payload = os.urandom(5000)  # > chunk_size: multi-chunk upload
+        req = urllib.request.Request(
+            f"https://{fs.url}/dir/blob.bin", data=payload, method="PUT"
+        )
+        with tls.urlopen(req, timeout=30) as r:
+            assert r.status in (200, 201)
+        with tls.urlopen(f"https://{fs.url}/dir/blob.bin", timeout=30) as r:
+            assert r.read() == payload
+    finally:
+        fs.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_configure_rejects_cert_without_key(certs):
+    with pytest.raises(ValueError, match="must be set together"):
+        tls.configure(certs["ca"], certs["cert"], "")
+
+
+def test_cluster_e2e_over_tls(certs, tmp_path):
+    """The §3.1 write/read stack with every hop encrypted: heartbeats,
+    assign, replication fan-out, reads, deletes."""
+    from seaweedfs_tpu.cluster.client import ClusterError, MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    tls.configure(
+        certs["ca"], certs["cert"], certs["key"],
+        https=True,
+        override_authority="weedtpu-cluster",
+    )
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    servers = []
+    try:
+        for i in range(2):
+            d = tmp_path / f"srv{i}"
+            d.mkdir()
+            vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.4)
+            vs.start()
+            servers.append(vs)
+        client = MasterClient(master.address)
+        import os
+
+        payload = os.urandom(30_000)
+        res = client.submit(payload, replication="001")
+        assert client.read(res.fid) == payload
+
+        # the data path is genuinely TLS: a plain-HTTP GET must fail
+        vid = int(res.fid.split(",")[0])
+        holder = next(s for s in servers if s.store.get_volume(vid) is not None)
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://{holder.url}/{res.fid}", timeout=3)
+        # and an HTTPS GET with the cluster CA succeeds
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(certs["ca"])
+        ctx.load_cert_chain(certs["cert"], certs["key"])
+        ctx.check_hostname = False
+        with urllib.request.urlopen(
+            f"https://{holder.url}/{res.fid}", timeout=10, context=ctx
+        ) as r:
+            assert r.read() == payload
+
+        assert client.delete(res.fid)
+        with pytest.raises(ClusterError):
+            client.read(res.fid)
+        client.close()
+    finally:
+        for vs in servers:
+            vs.stop()
+        master.stop()
