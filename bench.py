@@ -452,6 +452,61 @@ def mode_remote() -> None:
 
 
 # ---------------------------------------------------------------------------
+# stage 2d: dp-scaling sweep (child, 8 virtual CPU devices)
+# ---------------------------------------------------------------------------
+
+
+def mode_dp() -> None:
+    """Encode throughput across dp=1/2/4/8 meshes (SURVEY §2.5, VERDICT r3
+    #5). On this single-core host the virtual CPU devices share one core,
+    so the curve quantifies the sharding machinery's overhead (flat =
+    free), not chip speedup — the real-speedup axis needs real chips."""
+    import jax
+
+    from seaweedfs_tpu.utils.devices import honor_platform_env
+
+    honor_platform_env()
+    import numpy as np
+
+    from seaweedfs_tpu.ops import gf8
+    from seaweedfs_tpu.parallel import mesh as mesh_mod
+    from seaweedfs_tpu.parallel import sharded
+
+    out: dict = {
+        "devices": len(jax.devices()),
+        "host_cores": os.cpu_count(),
+        "note": (
+            "virtual CPU mesh on one host core: the curve measures "
+            "sharding-machinery overhead at fixed global problem size, "
+            "not parallel speedup"
+        ),
+    }
+    b, n = 8, 1 << 20  # fixed global problem: 80 MiB of data
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=(b, 10, n), dtype=np.uint8)
+    pm = gf8.parity_matrix(10, 4)
+    sweep: dict = {}
+    for dp in (1, 2, 4, 8):
+        if dp > len(jax.devices()):
+            break
+        try:
+            mesh = mesh_mod.device_mesh(("dp", "sp"), shape=(dp, 1))
+            enc = sharded.make_encode_fn(mesh, pm)
+            x = sharded.shard_batch(mesh, data)
+            t = _median_time(lambda: jax.block_until_ready(enc(x)), iters=3, warmup=1)
+            sweep[str(dp)] = round(b * 10 * n / t / 1e9, 3)
+        except Exception as e:  # noqa: BLE001 — one dp point must not kill the sweep
+            sweep[str(dp)] = f"error: {str(e)[:120]}"
+    out["encode_gbps_by_dp"] = sweep
+    base = sweep.get("1")
+    if isinstance(base, float) and base > 0:
+        out["efficiency_vs_dp1"] = {
+            k: round(v / base, 3) for k, v in sweep.items() if isinstance(v, float)
+        }
+    _emit(out)
+
+
+# ---------------------------------------------------------------------------
 # stage 3: device suite (child, default/axon platform)
 # ---------------------------------------------------------------------------
 
@@ -491,7 +546,7 @@ def mode_device() -> None:
 
         return rs_pallas.gf_apply_fused(parity_bits, d)
 
-    best_gbps, best_name = 0.0, "none"
+    best_gbps, best_name, best_fn = 0.0, "none", None
     for name, fn in (("xla", encode_xla), ("pallas", encode_pallas)):
         try:
             t = _median_time(lambda: jax.block_until_ready(fn(data)), iters=10, warmup=3)
@@ -501,9 +556,22 @@ def mode_device() -> None:
             out[f"{name}_error"] = str(e)[:500]
             continue
         if gbps > best_gbps:
-            best_gbps, best_name = gbps, name
+            best_gbps, best_name, best_fn = gbps, name, fn
     out["best_gbps"] = round(best_gbps, 3)
     out["best_backend"] = best_name
+
+    # jax.profiler capture of the winning kernel (SURVEY §5 tracing row):
+    # only meaningful with a real device; the trace directory is committed
+    # as a round artifact for offline analysis
+    trace_dir = os.environ.get("BENCH_TRACE_DIR", "")
+    if trace_dir and best_fn is not None and out["platform"] != "cpu":
+        try:
+            with jax.profiler.trace(trace_dir):
+                for _ in range(3):
+                    jax.block_until_ready(best_fn(data))
+            out["trace_dir"] = trace_dir
+        except Exception as e:  # noqa: BLE001 — tracing must not zero the run
+            out["trace_error"] = str(e)[:200]
     _emit(out)
 
 
@@ -562,6 +630,23 @@ def main() -> None:
     else:
         result["remote_ladder_error"] = remote_err
 
+    # stage 2d: dp-scaling sweep over the virtual 8-device CPU mesh
+    if deadline - time.monotonic() > 30:
+        dp, dp_err = _run_child(
+            "dp",
+            timeout=min(300, int(deadline - time.monotonic())),
+            extra_env={
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+        )
+        if dp:
+            result["dp_scaling"] = dp
+        else:
+            result["dp_scaling_error"] = dp_err
+    else:
+        result["dp_scaling_error"] = "skipped: bench deadline exhausted"
+
     # stage 2b: TPU-lowering proof — device-free Mosaic validation of the
     # Pallas kernel (cheap; proves the kernel compiles for the real target
     # even when the tunnel is wedged)
@@ -586,11 +671,19 @@ def main() -> None:
         elif probe_err is None:
             probe_err = probe2_err
 
-    # stage 3: device suite
+    # stage 3: device suite (with a jax.profiler capture directory)
     device = None
     if device_ok and deadline - time.monotonic() > 60:
         device, dev_err = _run_child(
-            "device", timeout=max(60, int(deadline - time.monotonic()))
+            "device",
+            timeout=max(60, int(deadline - time.monotonic())),
+            extra_env={
+                "BENCH_TRACE_DIR": os.environ.get(
+                    "BENCH_TRACE_DIR",
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "artifacts", "jax_trace"),
+                )
+            },
         )
         if device:
             result["device"] = device
@@ -631,6 +724,8 @@ if __name__ == "__main__":
         mode_cpu()
     elif mode == "remote":
         mode_remote()
+    elif mode == "dp":
+        mode_dp()
     elif mode == "device":
         mode_device()
     else:

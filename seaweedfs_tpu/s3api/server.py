@@ -625,7 +625,7 @@ class _Handler(httpd.QuietHandler):
         # stream through the filer: read source, write dest (fresh needles,
         # so source delete can never orphan the copy)
         try:
-            with urllib.request.urlopen(
+            with tls.urlopen(
                 self.s3.filer_url(self.s3.object_path(s_bucket, s_key)), timeout=60
             ) as r:
                 data = r.read()

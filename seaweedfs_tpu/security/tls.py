@@ -83,9 +83,16 @@ def configure(
 def configure_from_conf(conf: dict) -> bool:
     """Wire TLS up from a parsed security.toml. Returns True when enabled."""
     g = conf.get("grpc") or {}
-    if not g.get("ca"):
-        return False
     h = conf.get("https") or {}
+    if not g.get("ca"):
+        if h.get("enabled"):
+            # fail CLOSED: the operator asked for an encrypted data path but
+            # gave no trust anchor — silently serving plaintext would be a
+            # security misconfiguration they can't see
+            raise ValueError(
+                "security.toml: [https] enabled=true requires [grpc] ca/cert/key"
+            )
+        return False
     configure(
         ca_file=g["ca"],
         cert_file=g.get("cert", ""),
@@ -163,13 +170,17 @@ def https_server_context() -> Optional[ssl.SSLContext]:
     st = _state
     if st is None or not st.https:
         return None
+    if not st.cert_file or not st.key_file:
+        raise ValueError("tls: https servers need grpc.cert and grpc.key in security.toml")
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(st.cert_file, st.key_file)
-    # data-path mTLS is optional: browsers / presigned-URL clients talk to
-    # the gateways too, so the server verifies peers only when asked
+    # require_client_auth means mTLS on the data path too — CERT_REQUIRED,
+    # actually enforced by the handshake. Deployments whose gateways face
+    # browsers / presigned-URL clients set require_client_auth=false and
+    # rely on the gateway's own auth (SigV4/JWT) instead.
     if st.require_client_auth:
         ctx.load_verify_locations(st.ca_file)
-        ctx.verify_mode = ssl.CERT_OPTIONAL
+        ctx.verify_mode = ssl.CERT_REQUIRED
     return ctx
 
 

@@ -166,6 +166,42 @@ def test_configure_rejects_cert_without_key(certs):
         tls.configure(certs["ca"], certs["cert"], "")
 
 
+def test_https_without_ca_fails_closed():
+    """[https] enabled with no [grpc] ca must error, not silently serve
+    plaintext."""
+    with pytest.raises(ValueError, match="requires"):
+        tls.configure_from_conf({"https": {"enabled": True}})
+
+
+def test_https_mtls_rejects_anonymous_data_client(certs, tmp_path):
+    """require_client_auth on the data path is enforced by the handshake:
+    a CA-trusting client with NO certificate is refused."""
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    tls.configure(
+        certs["ca"], certs["cert"], certs["key"],
+        https=True, override_authority="weedtpu-cluster",
+    )
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.4)
+    vs.start()
+    try:
+        anon = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        anon.load_verify_locations(certs["ca"])
+        anon.check_hostname = False
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"https://{vs.host}:{vs.port}/status", timeout=5, context=anon
+            )
+    finally:
+        vs.stop()
+        master.stop()
+
+
 def test_cluster_e2e_over_tls(certs, tmp_path):
     """The §3.1 write/read stack with every hop encrypted: heartbeats,
     assign, replication fan-out, reads, deletes."""
