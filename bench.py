@@ -546,6 +546,38 @@ def mode_device() -> None:
 
         return rs_pallas.gf_apply_fused(parity_bits, d)
 
+    # Two numbers per backend (measured 2026-07-29 on the TPU v5 chip):
+    #   per-call      — one dispatch per encode. Through the axon tunnel this
+    #                   is FLOORED at ~65 ms/dispatch (a tiny x+1 op costs the
+    #                   same), so it reflects the tunnel, not the chip.
+    #   steady-state  — slope method: time lax.scan chains of K1 and K2
+    #                   encodes in ONE dispatch; (t2-t1)/(K2-K1) is the true
+    #                   per-encode device time. This matches production use
+    #                   (a storage node streams encodes) and BASELINE.md's
+    #                   device-side protocol.
+    def steady_gbps(encode_fn):
+        from jax import lax
+
+        def make_chain(k):
+            @jax.jit
+            def chain(d):
+                def body(acc, i):
+                    return acc ^ encode_fn(d ^ i)[:, :4, :], ()
+                acc, _ = lax.scan(
+                    body,
+                    jnp.zeros((b, 4, n), jnp.uint8),
+                    jnp.arange(k, dtype=jnp.uint8),
+                )
+                return acc
+
+            return chain
+
+        k1, k2 = 1, 4
+        c1, c2 = make_chain(k1), make_chain(k2)
+        t1 = _median_time(lambda: jax.block_until_ready(c1(data)), iters=2, warmup=1)
+        t2 = _median_time(lambda: jax.block_until_ready(c2(data)), iters=2, warmup=1)
+        return data_bytes / ((t2 - t1) / (k2 - k1)) / 1e9
+
     best_gbps, best_name, best_fn = 0.0, "none", None
     for name, fn in (("xla", encode_xla), ("pallas", encode_pallas)):
         try:
@@ -557,8 +589,24 @@ def mode_device() -> None:
             continue
         if gbps > best_gbps:
             best_gbps, best_name, best_fn = gbps, name, fn
+    # slope-measure only the per-call winner: each chain is two more XLA
+    # compiles, and the device child must fit the watchdog budget even on a
+    # cold compile cache (measured 2026-07-29: xla 31.1, pallas 18.7 GB/s
+    # steady-state, so the per-call winner is also the steady-state winner)
+    if best_fn is not None:
+        try:
+            steady = steady_gbps(best_fn)
+            out[f"{best_name}_steady_gbps"] = round(steady, 3)
+            if steady > best_gbps:
+                best_gbps = steady
+        except Exception as e:  # noqa: BLE001
+            out["steady_error"] = str(e)[:300]
     out["best_gbps"] = round(best_gbps, 3)
     out["best_backend"] = best_name
+    out["dispatch_floor_note"] = (
+        "per-call numbers are floored by the axon tunnel's ~65 ms dispatch "
+        "RTT; steady-state (scan-chain slope) is the device-side throughput"
+    )
 
     # jax.profiler capture of the winning kernel (SURVEY §5 tracing row):
     # only meaningful with a real device; the trace directory is committed
