@@ -572,11 +572,18 @@ def mode_device() -> None:
 
             return chain
 
-        k1, k2 = 1, 4
+        k1, k2 = 1, 8
         c1, c2 = make_chain(k1), make_chain(k2)
-        t1 = _median_time(lambda: jax.block_until_ready(c1(data)), iters=2, warmup=1)
-        t2 = _median_time(lambda: jax.block_until_ready(c2(data)), iters=2, warmup=1)
-        return data_bytes / ((t2 - t1) / (k2 - k1)) / 1e9
+        t1 = _median_time(lambda: jax.block_until_ready(c1(data)), iters=3, warmup=1)
+        t2 = _median_time(lambda: jax.block_until_ready(c2(data)), iters=3, warmup=1)
+        per = (t2 - t1) / (k2 - k1)
+        if per <= 0:
+            # tunnel RTT jitter swamped the slope — an invalid measurement
+            # must be flagged, not recorded as a (negative) throughput
+            raise ValueError(
+                f"slope not measurable: t({k1})={t1:.4f}s t({k2})={t2:.4f}s"
+            )
+        return data_bytes / per / 1e9
 
     best_gbps, best_name, best_fn = 0.0, "none", None
     for name, fn in (("xla", encode_xla), ("pallas", encode_pallas)):

@@ -227,8 +227,8 @@ def _filer_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-grpcPort", type=int, default=0)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="127.0.0.1:9333")
-    p.add_argument("-store", default="memory", help="memory|sqlite")
-    p.add_argument("-dir", default="", help="store/meta-log directory (sqlite store)")
+    p.add_argument("-store", default="memory", help="memory|sqlite|log")
+    p.add_argument("-dir", default="", help="store/meta-log directory (sqlite/log stores)")
     p.add_argument("-collection", default="")
     p.add_argument("-defaultReplicaPlacement", default="")
     p.add_argument("-maxMB", type=int, default=4, help="chunk size in MiB")
@@ -242,7 +242,10 @@ def _filer_run(args: argparse.Namespace) -> int:
 
     # share the cluster's jwt keys so chunk deletes/reads work secured
     guard = _load_guard()
-    store_path = os.path.join(args.dir, "filer.db") if args.dir else ""
+    if args.store == "sqlite":
+        store_path = os.path.join(args.dir, "filer.db") if args.dir else ""
+    else:  # log-structured store takes its directory
+        store_path = args.dir
     f = FilerServer(
         args.master,
         store=make_store(args.store, store_path),

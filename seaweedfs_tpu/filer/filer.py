@@ -242,7 +242,10 @@ class Filer:
         except EntryNotFound:
             return False
 
-    def mkdirs(self, path: str, mode: int = 0o770) -> None:
+    def mkdirs(self, path: str, mode: int = 0o770, _events: Optional[list] = None) -> None:
+        """Create parents. `_events` collects (old, new) pairs for deferred
+        notification instead of emitting immediately — used by rename,
+        whose store transaction may still roll back."""
         path = normalize_path(path)
         if path == "/":
             return
@@ -262,7 +265,10 @@ class Filer:
                         attributes=Attributes(mtime=time.time(), mode=mode | 0o040000),
                     )
                     self.store.insert(e)
-                    self._notify(None, e)
+                    if _events is None:
+                        self._notify(None, e)
+                    else:
+                        _events.append((None, e))
 
     def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
         """Insert (or overwrite) an entry; parents are created implicitly,
@@ -380,28 +386,52 @@ class Filer:
 
     def rename(self, old_path: str, new_path: str) -> Entry:
         """AtomicRenameEntry analog: move an entry (and its subtree) —
-        chunks do not move, only namespace records."""
+        chunks do not move, only namespace records. Stores with real
+        transactions (sqlite) group the whole subtree move atomically: a
+        crash mid-rename can never leave half the tree at each path.
+
+        Irreversible side effects are deferred until the transaction
+        commits: metadata events would replay phantom renames on
+        subscribers after a rollback, and deleting a displaced target's
+        chunks inside the txn would resurrect a chunk-less entry on
+        rollback."""
         old_path = normalize_path(old_path)
         new_path = normalize_path(new_path)
+        events: list[tuple[Entry, Entry]] = []
+        reclaim: list = []
         with self._lock:
-            entry = self.store.find(old_path)
-            try:
-                target = self.store.find(new_path)
-                if target.is_directory and not entry.is_directory:
-                    raise IsADirectoryError(new_path)
-                # overwrite: reclaim the displaced file's chunks
-                if target.chunks and self.chunk_io is not None:
-                    self.chunk_io.delete_chunks(target.chunks)
-            except EntryNotFound:
-                pass
-            self.mkdirs(posixpath.dirname(new_path) or "/")
-            if entry.is_directory:
-                # move children first so events replay consistently
-                for child in self.store.list(old_path, limit=1 << 30):
-                    self.rename(child.path, posixpath.join(new_path, child.name))
-            old_copy = Entry.from_dict(entry.to_dict())
-            entry.path = new_path
-            self.store.insert(entry)
-            self.store.delete(old_path)
-            self._notify(old_copy, entry)
+            with self.store.transaction():
+                entry = self._rename_inner(old_path, new_path, events, reclaim)
+            # committed: now the side effects are safe to apply
+            for old_copy, moved in events:
+                self._notify(old_copy, moved)
+            if reclaim and self.chunk_io is not None:
+                self.chunk_io.delete_chunks(reclaim)
             return entry
+
+    def _rename_inner(
+        self, old_path: str, new_path: str, events: list, reclaim: list
+    ) -> Entry:
+        """Namespace-only subtree move; collects deferred side effects."""
+        entry = self.store.find(old_path)
+        try:
+            target = self.store.find(new_path)
+            if target.is_directory and not entry.is_directory:
+                raise IsADirectoryError(new_path)
+            if target.chunks:  # overwrite: reclaim AFTER commit
+                reclaim.extend(target.chunks)
+        except EntryNotFound:
+            pass
+        self.mkdirs(posixpath.dirname(new_path) or "/", _events=events)
+        if entry.is_directory:
+            # move children first so events replay consistently
+            for child in self.store.list(old_path, limit=1 << 30):
+                self._rename_inner(
+                    child.path, posixpath.join(new_path, child.name), events, reclaim
+                )
+        old_copy = Entry.from_dict(entry.to_dict())
+        entry.path = new_path
+        self.store.insert(entry)
+        self.store.delete(old_path)
+        events.append((old_copy, entry))
+        return entry

@@ -15,6 +15,7 @@ through FilerStore.KvPut/KvGet).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import posixpath
 import sqlite3
@@ -69,6 +70,41 @@ class FilerStore:
 
     def close(self) -> None:
         pass
+
+    # -- transactions / batch (filerstore.go BeginTransaction/... analog) ----
+    #
+    # Default: no-op, matching the reference's non-transactional backends
+    # (its memory/redis stores accept Begin/Commit without grouping). Stores
+    # with real atomicity (sqlite) override all three.
+
+    def begin_transaction(self) -> None:
+        pass
+
+    def commit_transaction(self) -> None:
+        pass
+
+    def rollback_transaction(self) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """`with store.transaction():` — commit on success, rollback on
+        exception. Multi-entry operations (rename subtree, batch imports)
+        group their writes through this."""
+        self.begin_transaction()
+        try:
+            yield self
+        except BaseException:
+            self.rollback_transaction()
+            raise
+        else:
+            self.commit_transaction()
+
+    def insert_batch(self, entries: list[Entry]) -> None:
+        """Insert many entries atomically where the store supports it."""
+        with self.transaction():
+            for e in entries:
+                self.insert(e)
 
 
 class MemoryStore(FilerStore):
@@ -151,6 +187,7 @@ class SqliteStore(FilerStore):
 
     def __init__(self, db_path: str):
         self._lock = threading.RLock()
+        self._txn_depth = 0
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         with self._lock:
             self._conn.executescript(
@@ -169,6 +206,44 @@ class SqliteStore(FilerStore):
             )
             self._conn.commit()
 
+    def _maybe_commit(self) -> None:
+        if self._txn_depth == 0:
+            self._conn.commit()
+
+    # Transactions HOLD the store's RLock from begin to commit/rollback:
+    # sqlite's txn state is connection-global, so without the lock a write
+    # from another thread (e.g. a KvPut RPC that bypasses Filer._lock)
+    # would silently join — and be rolled back with — this transaction
+    # while its caller already saw success. Holding the RLock serializes
+    # other writers until the commit; the owning thread re-enters freely.
+
+    def begin_transaction(self) -> None:
+        self._lock.acquire()
+        self._txn_depth += 1
+
+    def commit_transaction(self) -> None:
+        if self._txn_depth == 0:
+            return
+        self._txn_depth -= 1
+        if self._txn_depth == 0:
+            self._conn.commit()
+        self._lock.release()
+
+    def rollback_transaction(self) -> None:
+        if self._txn_depth == 0:
+            return
+        self._conn.rollback()
+        while self._txn_depth:
+            self._txn_depth -= 1
+            self._lock.release()
+
+    def insert_batch(self, entries) -> None:
+        with self._lock, self.transaction():
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO entries (dir, name, meta) VALUES (?,?,?)",
+                [(e.dir, e.name, json.dumps(e.to_dict())) for e in entries],
+            )
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()
@@ -179,7 +254,7 @@ class SqliteStore(FilerStore):
                 "INSERT OR REPLACE INTO entries (dir, name, meta) VALUES (?,?,?)",
                 (entry.dir, entry.name, json.dumps(entry.to_dict())),
             )
-            self._conn.commit()
+            self._maybe_commit()
 
     update = insert
 
@@ -203,7 +278,7 @@ class SqliteStore(FilerStore):
                 "DELETE FROM entries WHERE dir=? AND name=?",
                 (posixpath.dirname(path) or "/", posixpath.basename(path)),
             )
-            self._conn.commit()
+            self._maybe_commit()
 
     def delete_folder_children(self, path: str) -> None:
         path = normalize_path(path)
@@ -212,7 +287,7 @@ class SqliteStore(FilerStore):
             self._conn.execute(
                 "DELETE FROM entries WHERE dir=? OR dir LIKE ?", (path, like)
             )
-            self._conn.commit()
+            self._maybe_commit()
 
     def list(self, dir_path, start_from="", include_start=False, limit=1024, prefix=""):
         dir_path = normalize_path(dir_path)
@@ -239,7 +314,7 @@ class SqliteStore(FilerStore):
             self._conn.execute(
                 "INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (key, bytes(value))
             )
-            self._conn.commit()
+            self._maybe_commit()
 
     def kv_get(self, key):
         with self._lock:
@@ -249,7 +324,7 @@ class SqliteStore(FilerStore):
     def kv_delete(self, key):
         with self._lock:
             self._conn.execute("DELETE FROM kv WHERE k=?", (key,))
-            self._conn.commit()
+            self._maybe_commit()
 
 
 def make_store(kind: str = "memory", path: str = "") -> FilerStore:
@@ -261,4 +336,10 @@ def make_store(kind: str = "memory", path: str = "") -> FilerStore:
         if not path:
             raise ValueError("sqlite store needs a db path")
         return SqliteStore(path)
-    raise ValueError(f"unknown filer store {kind!r} (memory|sqlite)")
+    if kind in ("log", "weedkv", "leveldb"):  # leveldb-analog embedded engine
+        if not path:
+            raise ValueError("log store needs a directory")
+        from seaweedfs_tpu.filer.logstore import LogFilerStore
+
+        return LogFilerStore(path)
+    raise ValueError(f"unknown filer store {kind!r} (memory|sqlite|log)")

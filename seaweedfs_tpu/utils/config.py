@@ -99,6 +99,12 @@ enabled = false
 [sqlite]
 enabled = true
 dbFile = "./filer.db"
+
+# from-scratch embedded log-structured store (the leveldb2-analog):
+# append-only CRC-framed log + in-memory index, auto-compaction
+[log]
+enabled = false
+dir = "./filerlog"
 ''',
 }
 

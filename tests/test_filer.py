@@ -34,7 +34,13 @@ from seaweedfs_tpu.filer.store import EntryNotFound
 
 
 def _stores(tmp_path):
-    return [MemoryStore(), SqliteStore(str(tmp_path / "f.db"))]
+    from seaweedfs_tpu.filer.logstore import LogFilerStore
+
+    return [
+        MemoryStore(),
+        SqliteStore(str(tmp_path / "f.db")),
+        LogFilerStore(str(tmp_path / "lg")),
+    ]
 
 
 def test_store_crud_and_listing(tmp_path):
@@ -278,3 +284,163 @@ def test_chunk_manifest_roundtrip(stack):
         fs.filer.delete_entry("/mani/big.bin")
     finally:
         chunks_mod.MANIFEST_BATCH = old
+
+
+# -- log-structured store engine (leveldb2-analog) ---------------------------
+
+
+def test_logkv_persistence_and_torn_tail(tmp_path):
+    from seaweedfs_tpu.filer.logstore import LogKv
+
+    p = str(tmp_path / "kv" / "filer.log")
+    kv = LogKv(p)
+    for i in range(50):
+        kv.put(f"k{i:03d}".encode(), f"value-{i}".encode() * 3)
+    kv.delete(b"k010")
+    kv.put(b"k011", b"updated")
+    kv.close()
+
+    # reopen: replay rebuilds exactly the surviving state
+    kv2 = LogKv(p)
+    assert kv2.get(b"k010") is None
+    assert kv2.get(b"k011") == b"updated"
+    assert kv2.get(b"k049") == b"value-49" * 3
+    assert len(kv2) == 49
+    kv2.close()
+
+    # torn tail: append garbage + half a record -> replay truncates, data intact
+    with open(p, "ab") as f:
+        f.write(b"\x01\x02\x03half-a-record")
+    kv3 = LogKv(p)
+    assert len(kv3) == 49 and kv3.get(b"k011") == b"updated"
+    kv3.put(b"after", b"torn-tail-write")  # log still appendable
+    kv3.close()
+    assert LogKv(p).get(b"after") == b"torn-tail-write"
+
+
+def test_logkv_compaction_reclaims_dead_bytes(tmp_path):
+    import os
+
+    from seaweedfs_tpu.filer.logstore import LogKv
+
+    p = str(tmp_path / "kv" / "filer.log")
+    kv = LogKv(p, compact_ratio=100.0)  # disable auto-compaction for the test
+    blob = b"x" * 4096
+    for round_ in range(20):  # rewrite the same keys -> mostly dead log
+        for i in range(16):
+            kv.put(f"k{i}".encode(), blob + str(round_).encode())
+    size_before = os.path.getsize(p)
+    kv.compact()
+    size_after = os.path.getsize(p)
+    assert size_after < size_before / 4, (size_before, size_after)
+    for i in range(16):
+        assert kv.get(f"k{i}".encode()) == blob + b"19"
+    kv.close()
+    kv2 = LogKv(p)  # compacted log replays clean
+    assert len(kv2) == 16
+    kv2.close()
+
+
+def test_log_filer_store_persists_namespace(tmp_path):
+    from seaweedfs_tpu.filer.logstore import LogFilerStore
+
+    d = str(tmp_path / "lgp")
+    st = LogFilerStore(d)
+    st.insert(Entry(path="/docs", is_directory=True))
+    st.insert(Entry(path="/docs/a.txt"))
+    st.insert(Entry(path="/docs/b.txt"))
+    st.kv_put("bookkeeping", b"\x01\x02")
+    st.close()
+    st2 = LogFilerStore(d)
+    assert [e.name for e in st2.list("/docs")] == ["a.txt", "b.txt"]
+    assert st2.kv_get("bookkeeping") == b"\x01\x02"
+    st2.close()
+
+
+# -- transactions -------------------------------------------------------------
+
+
+def test_sqlite_transaction_rollback_and_batch(tmp_path):
+    st = SqliteStore(str(tmp_path / "t.db"))
+    st.insert(Entry(path="/keep.txt"))
+    with pytest.raises(RuntimeError):
+        with st.transaction():
+            st.insert(Entry(path="/doomed1.txt"))
+            st.insert(Entry(path="/doomed2.txt"))
+            raise RuntimeError("abort")
+    with pytest.raises(EntryNotFound):
+        st.find("/doomed1.txt")
+    with pytest.raises(EntryNotFound):
+        st.find("/doomed2.txt")
+    assert st.find("/keep.txt").name == "keep.txt"
+
+    st.insert_batch([Entry(path=f"/b{i}.txt") for i in range(10)])
+    assert len(st.list("/", prefix="b")) == 10
+    st.close()
+
+
+def test_filer_rename_subtree_is_transactional(tmp_path):
+    """A store failure mid-subtree-rename must leave the namespace at the
+    ORIGINAL paths on a transactional store (no half-moved tree)."""
+    from seaweedfs_tpu.filer.filer import Filer
+
+    st = SqliteStore(str(tmp_path / "r.db"))
+    f = Filer(st, None)
+    f.mkdirs("/src/sub")
+    for n in ("a", "b", "c"):
+        f.create_entry(Entry(path=f"/src/sub/{n}.txt"))
+
+    calls = {"n": 0}
+    orig_insert = st.insert
+
+    def failing_insert(entry):
+        calls["n"] += 1
+        if calls["n"] == 3:  # blow up mid-move
+            raise IOError("disk full")
+        orig_insert(entry)
+
+    st.insert = failing_insert
+    events_before = len(f._events)
+    with pytest.raises(IOError):
+        f.rename("/src", "/dst")
+    st.insert = orig_insert
+    # rollback: everything still at the source, nothing at the destination
+    assert {e.name for e in st.list("/src/sub")} == {"a.txt", "b.txt", "c.txt"}
+    with pytest.raises(EntryNotFound):
+        st.find("/dst")
+    # and NO phantom rename events escaped to subscribers/replicators
+    assert len(f._events) == events_before, "rolled-back rename leaked events"
+    # a successful rename emits its (deferred) events after commit
+    f.rename("/src", "/dst2")
+    assert len(f._events) > events_before
+    assert {e.name for e in st.list("/dst2/sub")} == {"a.txt", "b.txt", "c.txt"}
+    st.close()
+
+
+def test_sqlite_transaction_blocks_other_writers(tmp_path):
+    """A KvPut landing mid-transaction from another thread must not be
+    swallowed into (and rolled back with) the transaction."""
+    import threading as _th
+    import time as _t
+
+    st = SqliteStore(str(tmp_path / "iso.db"))
+    done = _th.Event()
+
+    def other_writer():
+        st.kv_put("other", b"acknowledged")  # blocks until the txn ends
+        done.set()
+
+    with pytest.raises(RuntimeError):
+        with st.transaction():
+            st.insert(Entry(path="/doomed.txt"))
+            t = _th.Thread(target=other_writer, daemon=True)
+            t.start()
+            _t.sleep(0.2)
+            assert not done.is_set(), "writer slipped into the open txn"
+            raise RuntimeError("abort")
+    assert done.wait(5), "writer never unblocked after rollback"
+    # the other thread's acknowledged write survived the rollback
+    assert st.kv_get("other") == b"acknowledged"
+    with pytest.raises(EntryNotFound):
+        st.find("/doomed.txt")
+    st.close()
