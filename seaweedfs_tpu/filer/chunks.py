@@ -26,11 +26,24 @@ MANIFEST_BATCH = 1000  # fold chunk lists longer than this into manifests
 
 
 class ChunkIO:
-    """Upload/read/delete chunks through a MasterClient."""
+    """Upload/read/delete chunks through a MasterClient. An optional
+    ChunkCache (weed/util/chunk_cache analog) front-ends reads: fids are
+    immutable, so a hit never needs validation; deletes evict."""
 
-    def __init__(self, master: MasterClient, chunk_size: int = DEFAULT_CHUNK_SIZE):
+    def __init__(self, master: MasterClient, chunk_size: int = DEFAULT_CHUNK_SIZE, cache=None):
         self.master = master
         self.chunk_size = chunk_size
+        self.cache = cache
+
+    def _read_chunk(self, fid: str) -> bytes:
+        if self.cache is not None:
+            hit = self.cache.get(fid)
+            if hit is not None:
+                return hit
+        data = self.master.read(fid)
+        if self.cache is not None:
+            self.cache.put(fid, data)
+        return data
 
     # -- write ----------------------------------------------------------------
 
@@ -89,7 +102,7 @@ class ChunkIO:
         # chunks sorted by mtime: later writes overwrite earlier bytes,
         # the same winner rule as the reference's visible-interval list
         for c in sorted(chunks, key=lambda c: c.mtime_ns):
-            data = self.master.read(c.fid)
+            data = self._read_chunk(c.fid)
             buf[c.offset : c.offset + c.size] = data[: c.size]
         return bytes(buf)
 
@@ -103,7 +116,7 @@ class ChunkIO:
             hi = min(end, c.offset + c.size)
             if lo >= hi:
                 continue
-            data = self.master.read(c.fid)
+            data = self._read_chunk(c.fid)
             buf[lo - offset : hi - offset] = data[lo - c.offset : hi - c.offset]
         return bytes(buf)
 
@@ -124,7 +137,7 @@ class ChunkIO:
         for c in in_order:
             if c.offset > pos:  # hole: sparse file, zero-fill
                 yield bytes(c.offset - pos)
-            yield self.master.read(c.fid)[: c.size]
+            yield self._read_chunk(c.fid)[: c.size]
             pos = c.offset + c.size
 
     # -- delete ---------------------------------------------------------------
@@ -139,6 +152,8 @@ class ChunkIO:
                     manifest = None
             if manifest:
                 self.delete_chunks(manifest)
+            if self.cache is not None:
+                self.cache.delete(c.fid)
             try:
                 self.master.delete(c.fid)
             except Exception:  # noqa: BLE001 — best-effort, orphans vacuumed later
@@ -176,7 +191,7 @@ class ChunkIO:
         return out
 
     def _load_manifest(self, c: FileChunk) -> list[FileChunk]:
-        payload = self.master.read(c.fid)
+        payload = self._read_chunk(c.fid)
         return [FileChunk.from_dict(d) for d in json.loads(payload.decode())]
 
     def resolve_manifests(self, chunks: list[FileChunk]) -> list[FileChunk]:

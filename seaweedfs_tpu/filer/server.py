@@ -58,7 +58,13 @@ class FilerServer:
         self.master = MasterClient(
             master_address, signing_key=signing_key, read_signing_key=read_signing_key
         )
-        self.chunk_io = ChunkIO(self.master, chunk_size=chunk_size)
+        from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+
+        # hot-chunk read cache (weed/util/chunk_cache analog): fids are
+        # immutable so hits never need validation; deletes evict
+        self.chunk_io = ChunkIO(
+            self.master, chunk_size=chunk_size, cache=ChunkCache(memory_bytes=64 << 20)
+        )
         self.filer = Filer(store or make_store("memory"), self.chunk_io, log_dir=log_dir)
         self.collection = collection
         self.replication = replication

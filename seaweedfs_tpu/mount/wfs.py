@@ -141,7 +141,15 @@ class WFS:
         self.filer = FilerClient(filer_grpc_address)
         conf = self.filer.configuration()
         self.master = MasterClient(conf["masters"][0])
-        self.chunk_io = ChunkIO(self.master, chunk_size=int(conf["chunk_size"]))
+        from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+
+        # the mount is the reference's heaviest chunk_cache user: page
+        # reads re-fetch the same chunks constantly
+        self.chunk_io = ChunkIO(
+            self.master,
+            chunk_size=int(conf["chunk_size"]),
+            cache=ChunkCache(memory_bytes=64 << 20),
+        )
         self.collection = conf.get("collection", "")
         self.replication = conf.get("replication", "")
         self.auto_flush_bytes = auto_flush_bytes

@@ -11,8 +11,23 @@ RPC surface (weedtpu.MessageQueue):
                   -> stream of LogRecord dicts (flushed segments first,
                      then the live tail)
 
+Consumer groups (weed/mq sub_coordinator analog):
+  JoinGroup       {namespace, topic, group, consumer_id}
+                  -> {generation, partitions, partition_count}
+  GroupHeartbeat  {namespace, topic, group, consumer_id} -> {generation}
+  LeaveGroup      {namespace, topic, group, consumer_id}
+  CommitOffset    {namespace, topic, group, partition, ts_ns}
+  FetchOffset     {namespace, topic, group, partition} -> {ts_ns}
+
+Membership is broker-resident with a session TTL (a consumer that stops
+heartbeating is reaped and its partitions rebalance); the generation
+bumps on every membership change so consumers detect rebalances.
+Committed offsets persist through the filer KV facet, so a group
+resumes where it left off across broker AND consumer restarts.
+
 Partition assignment: explicit, else hash(key) % partitions — the
-reference's key-hash routing.
+reference's key-hash routing; within a group, partitions are split
+round-robin over the sorted member ids.
 """
 
 from __future__ import annotations
@@ -82,18 +97,31 @@ class _Partition:
         return out
 
 
+class _Group:
+    """Resident state of one consumer group on one topic."""
+
+    def __init__(self):
+        self.members: dict[str, float] = {}  # consumer_id -> last heartbeat
+        self.generation = 0
+
+
 class Broker:
+    GROUP_SESSION_TIMEOUT = 10.0
+
     def __init__(
         self,
         filer_http_address: str,
         filer_grpc_address: str,
         port: int = 0,
         host: str = "127.0.0.1",
+        group_session_timeout: float = GROUP_SESSION_TIMEOUT,
     ):
         self.filer_http = filer_http_address
         self.filer = FilerClient(filer_grpc_address)
         self.host = host
+        self.group_session_timeout = group_session_timeout
         self._partitions: dict[tuple[str, str, int], _Partition] = {}
+        self._groups: dict[tuple[str, str, str], _Group] = {}
         self._lock = threading.Lock()
         self._grpc = rpc.RpcServer(port=port, host=host)
         self._grpc.add_service(self._build_service())
@@ -149,7 +177,118 @@ class Broker:
         svc.add("ListTopics", self._rpc_list)
         svc.add("Publish", self._rpc_publish)
         svc.add("Subscribe", self._rpc_subscribe, kind="unary_stream", resp_format="json")
+        svc.add("JoinGroup", self._rpc_join_group)
+        svc.add("GroupHeartbeat", self._rpc_group_heartbeat)
+        svc.add("LeaveGroup", self._rpc_leave_group)
+        svc.add("CommitOffset", self._rpc_commit_offset)
+        svc.add("FetchOffset", self._rpc_fetch_offset)
         return svc
+
+    # -- consumer groups ------------------------------------------------------
+
+    def _group(self, ns: str, topic: str, group: str) -> _Group:
+        key = (ns, topic, group)
+        with self._lock:
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _Group()
+            return g
+
+    def _reap_stale(self, g: _Group, now: float) -> bool:
+        """Caller holds self._lock. Returns True when membership changed."""
+        stale = [
+            cid
+            for cid, seen in g.members.items()
+            if now - seen > self.group_session_timeout
+        ]
+        for cid in stale:
+            del g.members[cid]
+        if stale:
+            g.generation += 1
+        return bool(stale)
+
+    def _assigned(self, g: _Group, consumer_id: str, count: int) -> list[int]:
+        """Partitions for consumer_id: round-robin over sorted members —
+        deterministic, so every member computes the same split."""
+        members = sorted(g.members)
+        if consumer_id not in members:
+            return []
+        rank = members.index(consumer_id)
+        return [p for p in range(count) if p % len(members) == rank]
+
+    def _rpc_join_group(self, req: dict, ctx) -> dict:
+        import time as _time
+
+        ns = req.get("namespace", "default")
+        topic = req["topic"]
+        conf = self._topic_conf(ns, topic)
+        if conf is None:
+            raise rpc.NotFoundFault(f"topic {ns}/{topic} not configured")
+        count = int(conf.get("partition_count", 4))
+        cid = req["consumer_id"]
+        g = self._group(ns, topic, req.get("group", "default"))
+        now = _time.monotonic()
+        with self._lock:
+            self._reap_stale(g, now)
+            if cid not in g.members:
+                g.generation += 1
+            g.members[cid] = now
+            return {
+                "generation": g.generation,
+                "partitions": self._assigned(g, cid, count),
+                "partition_count": count,
+            }
+
+    def _rpc_group_heartbeat(self, req: dict, ctx) -> dict:
+        import time as _time
+
+        ns = req.get("namespace", "default")
+        key = (ns, req["topic"], req.get("group", "default"))
+        now = _time.monotonic()
+        with self._lock:
+            # look up WITHOUT creating: a typo'd topic/group must error,
+            # not grow broker-resident state forever
+            g = self._groups.get(key)
+            if g is None:
+                raise rpc.NotFoundFault(f"unknown group {key[2]} on {ns}/{req['topic']}")
+            self._reap_stale(g, now)
+            if req["consumer_id"] in g.members:
+                g.members[req["consumer_id"]] = now
+            return {"generation": g.generation}
+
+    def _rpc_leave_group(self, req: dict, ctx) -> dict:
+        ns = req.get("namespace", "default")
+        key = (ns, req["topic"], req.get("group", "default"))
+        with self._lock:
+            g = self._groups.get(key)
+            if g is None:
+                return {}
+            if g.members.pop(req["consumer_id"], None) is not None:
+                g.generation += 1
+            if not g.members:  # last one out: drop the resident entry
+                del self._groups[key]
+        return {}
+
+    @staticmethod
+    def _offset_key(ns: str, topic: str, group: str, partition: int) -> str:
+        return f"mq.offset/{ns}/{topic}/{group}/{partition:04d}"
+
+    def _rpc_commit_offset(self, req: dict, ctx) -> dict:
+        ns = req.get("namespace", "default")
+        key = self._offset_key(
+            ns, req["topic"], req.get("group", "default"), int(req["partition"])
+        )
+        self.filer.kv_put(key, str(int(req["ts_ns"])).encode())
+        return {}
+
+    def _rpc_fetch_offset(self, req: dict, ctx) -> dict:
+        ns = req.get("namespace", "default")
+        raw = self.filer.kv_get(
+            self._offset_key(
+                ns, req["topic"], req.get("group", "default"), int(req["partition"])
+            )
+        )
+        return {"ts_ns": int(raw.decode()) if raw else 0}
 
     def _rpc_configure(self, req: dict, ctx) -> dict:
         from seaweedfs_tpu.filer.entry import Entry
@@ -289,3 +428,112 @@ class BrokerClient:
             resp_format="json",
         ):
             yield LogRecord.from_dict(d)
+
+    # -- consumer groups ------------------------------------------------------
+
+    def join_group(self, topic: str, group: str, consumer_id: str, namespace: str = "default") -> dict:
+        return self._rpc.call(
+            MQ_SERVICE,
+            "JoinGroup",
+            {"namespace": namespace, "topic": topic, "group": group, "consumer_id": consumer_id},
+        )
+
+    def group_heartbeat(self, topic: str, group: str, consumer_id: str, namespace: str = "default") -> int:
+        return int(
+            self._rpc.call(
+                MQ_SERVICE,
+                "GroupHeartbeat",
+                {"namespace": namespace, "topic": topic, "group": group, "consumer_id": consumer_id},
+            )["generation"]
+        )
+
+    def leave_group(self, topic: str, group: str, consumer_id: str, namespace: str = "default") -> None:
+        self._rpc.call(
+            MQ_SERVICE,
+            "LeaveGroup",
+            {"namespace": namespace, "topic": topic, "group": group, "consumer_id": consumer_id},
+        )
+
+    def commit_offset(self, topic: str, group: str, partition: int, ts_ns: int, namespace: str = "default") -> None:
+        self._rpc.call(
+            MQ_SERVICE,
+            "CommitOffset",
+            {"namespace": namespace, "topic": topic, "group": group,
+             "partition": partition, "ts_ns": ts_ns},
+        )
+
+    def fetch_offset(self, topic: str, group: str, partition: int, namespace: str = "default") -> int:
+        return int(
+            self._rpc.call(
+                MQ_SERVICE,
+                "FetchOffset",
+                {"namespace": namespace, "topic": topic, "group": group, "partition": partition},
+            )["ts_ns"]
+        )
+
+    def consume(
+        self,
+        topic: str,
+        group: str,
+        consumer_id: str,
+        namespace: str = "default",
+        poll_idle_s: float = 0.5,
+        auto_commit: bool = True,
+        max_rounds: Optional[int] = None,
+    ):
+        """Group consumer loop: join, drain each assigned partition from
+        its committed offset, and rebalance whenever the broker's
+        generation moves. Yields (partition, LogRecord).
+
+        Commit discipline is commit-on-next-poll (at-least-once): a
+        record's offset commits only when the caller comes back for the
+        next one — proof it processed the last. A caller that crashes or
+        breaks mid-stream therefore sees its LAST record redelivered;
+        call `commit_offset(topic, group, p, rec.ts_ns)` before a
+        graceful stop to avoid that one duplicate. Committing any
+        earlier (e.g. on generator close) would silently LOSE a record
+        whose processing raised.
+
+        `max_rounds` bounds the poll loop (None = run until closed)."""
+        import time as _time
+
+        state = self.join_group(topic, group, consumer_id, namespace)
+        hb_interval = 2.0  # well under the broker's session timeout
+        last_hb = _time.monotonic()
+        rounds = 0
+        while max_rounds is None or rounds < max_rounds:
+            rounds += 1
+            rebalance = False
+            for p in state["partitions"]:
+                since = self.fetch_offset(topic, group, p, namespace)
+                for rec in self.subscribe(
+                    topic, partition=p, since_ns=since,
+                    namespace=namespace, max_idle_s=poll_idle_s,
+                ):
+                    yield p, rec
+                    # the caller came back: the record was processed
+                    if auto_commit:
+                        self.commit_offset(topic, group, p, rec.ts_ns, namespace)
+                    # a busy partition must not starve the heartbeat —
+                    # the broker would reap us as stale mid-stream
+                    if _time.monotonic() - last_hb >= hb_interval:
+                        last_hb = _time.monotonic()
+                        if self.group_heartbeat(
+                            topic, group, consumer_id, namespace
+                        ) != state["generation"]:
+                            rebalance = True
+                            break
+                if rebalance:
+                    break
+            if not rebalance:
+                if not state["partitions"]:
+                    # idle member (more consumers than partitions): wait for
+                    # a rebalance instead of hammering the broker
+                    _time.sleep(poll_idle_s)
+                last_hb = _time.monotonic()
+                rebalance = (
+                    self.group_heartbeat(topic, group, consumer_id, namespace)
+                    != state["generation"]
+                )
+            if rebalance:  # pick up the new split
+                state = self.join_group(topic, group, consumer_id, namespace)

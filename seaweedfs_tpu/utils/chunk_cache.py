@@ -1,0 +1,145 @@
+"""ChunkCache — tiered read cache for immutable chunks, the
+weed/util/chunk_cache analog [VERIFY: mount empty; SURVEY.md §2.1 "Util"
+row]: the filer/mount read path hits the same hot chunks over and over
+(directory pages, small files, manifest heads); a fid is written once and
+never mutated, so caching by fid is safe and deletes just evict.
+
+Two tiers, like the reference's memory + on-disk volume caches:
+
+  memory   byte-budgeted LRU (OrderedDict), items above `max_item_bytes`
+           bypass it — one huge blob must not wipe the working set
+  disk     optional directory of fid-named files with a byte budget,
+           evicted oldest-mtime-first; survives restarts (the reference's
+           persisted disk cache role)
+
+Reads promote disk hits back into memory. All operations are lock-guarded
+and O(1)-ish; eviction is amortized.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+class ChunkCache:
+    def __init__(
+        self,
+        memory_bytes: int = 64 << 20,
+        max_item_bytes: int = 4 << 20,
+        disk_dir: str = "",
+        disk_bytes: int = 0,
+    ):
+        self.memory_budget = memory_bytes
+        self.max_item_bytes = max_item_bytes
+        self.disk_dir = disk_dir
+        self.disk_budget = disk_bytes
+        self._mem: OrderedDict[str, bytes] = OrderedDict()
+        self._mem_bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        if disk_dir and disk_bytes > 0:
+            os.makedirs(disk_dir, exist_ok=True)
+
+    # -- keys -----------------------------------------------------------------
+
+    @staticmethod
+    def _disk_name(fid: str) -> str:
+        return hashlib.sha1(fid.encode()).hexdigest() + ".chunk"
+
+    def _disk_path(self, fid: str) -> str:
+        return os.path.join(self.disk_dir, self._disk_name(fid))
+
+    # -- api ------------------------------------------------------------------
+
+    def get(self, fid: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._mem.get(fid)
+            if data is not None:
+                self._mem.move_to_end(fid)
+                self.hits += 1
+                return data
+        if self.disk_dir and self.disk_budget > 0:
+            try:
+                with open(self._disk_path(fid), "rb") as f:
+                    data = f.read()
+                self._put_mem(fid, data)  # promote
+                with self._lock:
+                    self.hits += 1
+                return data
+            except OSError:
+                pass
+        with self._lock:
+            self.misses += 1
+        return None
+
+    def put(self, fid: str, data: bytes) -> None:
+        if len(data) > self.max_item_bytes:
+            return
+        self._put_mem(fid, data)
+        if self.disk_dir and self.disk_budget > 0:
+            try:
+                tmp = self._disk_path(fid) + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, self._disk_path(fid))
+                self._evict_disk()
+            except OSError:
+                pass  # a full/broken disk tier must never fail a read
+
+    def _put_mem(self, fid: str, data: bytes) -> None:
+        with self._lock:
+            old = self._mem.pop(fid, None)
+            if old is not None:
+                self._mem_bytes -= len(old)
+            self._mem[fid] = data
+            self._mem_bytes += len(data)
+            while self._mem_bytes > self.memory_budget and self._mem:
+                _, evicted = self._mem.popitem(last=False)
+                self._mem_bytes -= len(evicted)
+
+    def delete(self, fid: str) -> None:
+        with self._lock:
+            old = self._mem.pop(fid, None)
+            if old is not None:
+                self._mem_bytes -= len(old)
+        if self.disk_dir and self.disk_budget > 0:
+            try:
+                os.remove(self._disk_path(fid))
+            except OSError:
+                pass
+
+    def _evict_disk(self) -> None:
+        try:
+            entries = [
+                (e.stat().st_mtime, e.path, e.stat().st_size)
+                for e in os.scandir(self.disk_dir)
+                if e.name.endswith(".chunk")
+            ]
+        except OSError:
+            return
+        total = sum(s for _, _, s in entries)
+        if total <= self.disk_budget:
+            return
+        for _, path, size in sorted(entries):  # oldest first
+            try:
+                os.remove(path)
+                total -= size
+            except OSError:
+                pass
+            if total <= self.disk_budget:
+                break
+
+    @property
+    def memory_bytes_used(self) -> int:
+        with self._lock:
+            return self._mem_bytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._mem.clear()
+            self._mem_bytes = 0

@@ -1,0 +1,56 @@
+"""Wire-contract schema tests: pb/contracts.proto is the normative pin for
+every RPC (SURVEY §2.6 / VERDICT r3 missing #7) — it must stay a valid
+proto3 file AND cover every method the servers actually register."""
+
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTO = os.path.join(REPO, "seaweedfs_tpu", "pb", "contracts.proto")
+
+
+def test_contracts_proto_is_valid_proto3():
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not in image")
+    proc = subprocess.run(
+        [
+            "protoc",
+            f"--proto_path={os.path.dirname(PROTO)}",
+            "--descriptor_set_out=/dev/null",
+            PROTO,
+        ],
+        capture_output=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_every_registered_rpc_method_is_in_the_schema():
+    """Grep every svc.add("Method", ...) registration in the package and
+    demand an `rpc Method(` line in contracts.proto — schema drift fails
+    the build instead of rotting silently."""
+    with open(PROTO, encoding="utf-8") as f:
+        schema = f.read()
+    declared = set(re.findall(r"\brpc\s+(\w+)\(", schema))
+
+    registered = set()
+    pkg = os.path.join(REPO, "seaweedfs_tpu")
+    for root, _, files in os.walk(pkg):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(root, name), encoding="utf-8") as f:
+                src = f.read()
+            # matches both `svc.add("M", ...)` and the `add = svc.add` alias
+            # style (`add("M", ...)`) used by the filer and volume servers
+            registered.update(re.findall(r"\badd\(\s*\"(\w+)\"", src))
+
+    assert len(registered) > 40, (
+        f"extraction looks broken: only {len(registered)} methods found"
+    )
+    missing = registered - declared
+    assert not missing, f"RPC methods registered but absent from contracts.proto: {sorted(missing)}"

@@ -444,3 +444,50 @@ def test_sqlite_transaction_blocks_other_writers(tmp_path):
     with pytest.raises(EntryNotFound):
         st.find("/doomed.txt")
     st.close()
+
+
+# -- chunk cache (weed/util/chunk_cache analog) -------------------------------
+
+
+def test_chunk_cache_lru_and_tiers(tmp_path):
+    from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+
+    cc = ChunkCache(memory_bytes=10_000, max_item_bytes=6_000,
+                    disk_dir=str(tmp_path / "cc"), disk_bytes=50_000)
+    cc.put("1,aa", b"x" * 4000)
+    cc.put("2,bb", b"y" * 4000)
+    assert cc.get("1,aa") == b"x" * 4000  # refreshes LRU position
+    cc.put("3,cc", b"z" * 4000)  # budget 10k: evicts 2,bb from memory
+    assert cc.memory_bytes_used <= 10_000
+    assert cc.get("2,bb") == b"y" * 4000  # disk tier still has it (promoted)
+    # oversized items bypass the cache entirely
+    cc.put("4,dd", b"w" * 7000)
+    assert cc.get("4,dd") is None
+    # delete evicts every tier
+    cc.delete("1,aa")
+    cc.clear()
+    assert cc.get("1,aa") is None
+    assert cc.hits >= 2 and cc.misses >= 2
+
+
+def test_chunk_cache_serves_filer_rereads(stack):
+    """Re-reading the same file must hit the cache, not the volume tier."""
+    _, _, fs = stack
+    payload = os.urandom(4000)
+    _http("PUT", f"http://{fs.url}/cached/file.bin", payload)
+    _http("GET", f"http://{fs.url}/cached/file.bin")  # populate
+    cache = fs.chunk_io.cache
+    h0 = cache.hits
+    reads = {"n": 0}
+    orig = fs.chunk_io.master.read
+
+    def counting_read(fid):
+        reads["n"] += 1
+        return orig(fid)
+
+    fs.chunk_io.master.read = counting_read
+    _, _, got = _http("GET", f"http://{fs.url}/cached/file.bin")
+    fs.chunk_io.master.read = orig
+    assert got == payload
+    assert reads["n"] == 0, "re-read went to the volume tier despite cache"
+    assert cache.hits > h0
