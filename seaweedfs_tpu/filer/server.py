@@ -53,6 +53,7 @@ class FilerServer:
         replication: str = "",
         signing_key: Optional[bytes] = None,
         read_signing_key: Optional[bytes] = None,
+        chunk_cache_bytes: int = 64 << 20,
     ):
         self.master_address = master_address
         self.master = MasterClient(
@@ -61,10 +62,10 @@ class FilerServer:
         from seaweedfs_tpu.utils.chunk_cache import ChunkCache
 
         # hot-chunk read cache (weed/util/chunk_cache analog): fids are
-        # immutable so hits never need validation; deletes evict
-        self.chunk_io = ChunkIO(
-            self.master, chunk_size=chunk_size, cache=ChunkCache(memory_bytes=64 << 20)
-        )
+        # immutable so hits never need validation; deletes evict.
+        # chunk_cache_bytes=0 disables it (RAM-constrained deployments).
+        cache = ChunkCache(memory_bytes=chunk_cache_bytes) if chunk_cache_bytes else None
+        self.chunk_io = ChunkIO(self.master, chunk_size=chunk_size, cache=cache)
         self.filer = Filer(store or make_store("memory"), self.chunk_io, log_dir=log_dir)
         self.collection = collection
         self.replication = replication

@@ -137,6 +137,7 @@ class WFS:
         filer_grpc_address: str,
         auto_flush_bytes: int = 8 * 1024 * 1024,
         watch: bool = False,
+        chunk_cache_bytes: int = 64 << 20,
     ):
         self.filer = FilerClient(filer_grpc_address)
         conf = self.filer.configuration()
@@ -144,11 +145,10 @@ class WFS:
         from seaweedfs_tpu.utils.chunk_cache import ChunkCache
 
         # the mount is the reference's heaviest chunk_cache user: page
-        # reads re-fetch the same chunks constantly
+        # reads re-fetch the same chunks constantly; 0 disables
+        cache = ChunkCache(memory_bytes=chunk_cache_bytes) if chunk_cache_bytes else None
         self.chunk_io = ChunkIO(
-            self.master,
-            chunk_size=int(conf["chunk_size"]),
-            cache=ChunkCache(memory_bytes=64 << 20),
+            self.master, chunk_size=int(conf["chunk_size"]), cache=cache
         )
         self.collection = conf.get("collection", "")
         self.replication = conf.get("replication", "")

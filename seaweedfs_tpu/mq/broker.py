@@ -186,14 +186,6 @@ class Broker:
 
     # -- consumer groups ------------------------------------------------------
 
-    def _group(self, ns: str, topic: str, group: str) -> _Group:
-        key = (ns, topic, group)
-        with self._lock:
-            g = self._groups.get(key)
-            if g is None:
-                g = self._groups[key] = _Group()
-            return g
-
     def _reap_stale(self, g: _Group, now: float) -> bool:
         """Caller holds self._lock. Returns True when membership changed."""
         stale = [
@@ -206,6 +198,16 @@ class Broker:
         if stale:
             g.generation += 1
         return bool(stale)
+
+    def _sweep_dead_groups(self, now: float) -> None:
+        """Caller holds self._lock: drop group entries whose every member
+        is gone (crashed consumers never call LeaveGroup) — broker-resident
+        state must not grow with the history of group names."""
+        for key in list(self._groups):
+            g = self._groups[key]
+            self._reap_stale(g, now)
+            if not g.members:
+                del self._groups[key]
 
     def _assigned(self, g: _Group, consumer_id: str, count: int) -> list[int]:
         """Partitions for consumer_id: round-robin over sorted members —
@@ -226,10 +228,16 @@ class Broker:
             raise rpc.NotFoundFault(f"topic {ns}/{topic} not configured")
         count = int(conf.get("partition_count", 4))
         cid = req["consumer_id"]
-        g = self._group(ns, topic, req.get("group", "default"))
+        key = (ns, topic, req.get("group", "default"))
         now = _time.monotonic()
         with self._lock:
-            self._reap_stale(g, now)
+            # lookup-or-create and mutate under ONE lock hold: a racing
+            # LeaveGroup deleting the entry between two acquisitions would
+            # otherwise leave this joiner registered in an orphaned object
+            self._sweep_dead_groups(now)
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _Group()
             if cid not in g.members:
                 g.generation += 1
             g.members[cid] = now
@@ -237,6 +245,7 @@ class Broker:
                 "generation": g.generation,
                 "partitions": self._assigned(g, cid, count),
                 "partition_count": count,
+                "session_timeout_s": self.group_session_timeout,
             }
 
     def _rpc_group_heartbeat(self, req: dict, ctx) -> dict:
@@ -249,9 +258,15 @@ class Broker:
             # look up WITHOUT creating: a typo'd topic/group must error,
             # not grow broker-resident state forever
             g = self._groups.get(key)
+            if g is not None:
+                self._reap_stale(g, now)
+                if not g.members:
+                    del self._groups[key]  # fully reaped: drop the entry
+                    g = None
             if g is None:
+                # the consumer treats this as "rejoin" (it may itself have
+                # been the reaped member)
                 raise rpc.NotFoundFault(f"unknown group {key[2]} on {ns}/{req['topic']}")
-            self._reap_stale(g, now)
             if req["consumer_id"] in g.members:
                 g.members[req["consumer_id"]] = now
             return {"generation": g.generation}
@@ -479,6 +494,7 @@ class BrokerClient:
         namespace: str = "default",
         poll_idle_s: float = 0.5,
         auto_commit: bool = True,
+        commit_every: int = 1,
         max_rounds: Optional[int] = None,
     ):
         """Group consumer loop: join, drain each assigned partition from
@@ -486,19 +502,28 @@ class BrokerClient:
         generation moves. Yields (partition, LogRecord).
 
         Commit discipline is commit-on-next-poll (at-least-once): a
-        record's offset commits only when the caller comes back for the
+        record's offset commits only after the caller comes back for the
         next one — proof it processed the last. A caller that crashes or
-        breaks mid-stream therefore sees its LAST record redelivered;
-        call `commit_offset(topic, group, p, rec.ts_ns)` before a
-        graceful stop to avoid that one duplicate. Committing any
-        earlier (e.g. on generator close) would silently LOSE a record
-        whose processing raised.
+        breaks mid-stream therefore sees its last <= `commit_every`
+        records redelivered; call `commit_offset(topic, group, p,
+        rec.ts_ns)` before a graceful stop to avoid the duplicates.
+        Committing any earlier (e.g. on generator close) would silently
+        LOSE a record whose processing raised. Raising `commit_every`
+        batches the offset RPCs (1 filer kv_put per N records instead of
+        per record) at the price of a longer redelivery window.
+
+        Heartbeats pace themselves from the broker's advertised session
+        timeout, and every blocking wait is capped below it — a live
+        consumer is never reaped for being busy OR idle.
 
         `max_rounds` bounds the poll loop (None = run until closed)."""
         import time as _time
 
         state = self.join_group(topic, group, consumer_id, namespace)
-        hb_interval = 2.0  # well under the broker's session timeout
+
+        def hb_interval():
+            return max(0.05, float(state.get("session_timeout_s", 10.0)) / 3)
+
         last_hb = _time.monotonic()
         rounds = 0
         while max_rounds is None or rounds < max_rounds:
@@ -506,34 +531,55 @@ class BrokerClient:
             rebalance = False
             for p in state["partitions"]:
                 since = self.fetch_offset(topic, group, p, namespace)
+                pending = 0  # records delivered but not yet committed
+                last_ts = since
                 for rec in self.subscribe(
                     topic, partition=p, since_ns=since,
-                    namespace=namespace, max_idle_s=poll_idle_s,
+                    # cap the blocking wait below the session timeout
+                    namespace=namespace, max_idle_s=min(poll_idle_s, hb_interval()),
                 ):
                     yield p, rec
                     # the caller came back: the record was processed
-                    if auto_commit:
-                        self.commit_offset(topic, group, p, rec.ts_ns, namespace)
+                    last_ts, pending = rec.ts_ns, pending + 1
+                    if auto_commit and pending >= commit_every:
+                        self.commit_offset(topic, group, p, last_ts, namespace)
+                        pending = 0
                     # a busy partition must not starve the heartbeat —
                     # the broker would reap us as stale mid-stream
-                    if _time.monotonic() - last_hb >= hb_interval:
+                    if _time.monotonic() - last_hb >= hb_interval():
                         last_hb = _time.monotonic()
-                        if self.group_heartbeat(
-                            topic, group, consumer_id, namespace
-                        ) != state["generation"]:
+                        if self._heartbeat_or_rejoin(
+                            topic, group, consumer_id, namespace, state
+                        ):
                             rebalance = True
                             break
+                if auto_commit and pending:
+                    self.commit_offset(topic, group, p, last_ts, namespace)
                 if rebalance:
                     break
             if not rebalance:
                 if not state["partitions"]:
                     # idle member (more consumers than partitions): wait for
                     # a rebalance instead of hammering the broker
-                    _time.sleep(poll_idle_s)
+                    _time.sleep(min(poll_idle_s, hb_interval()))
                 last_hb = _time.monotonic()
-                rebalance = (
-                    self.group_heartbeat(topic, group, consumer_id, namespace)
-                    != state["generation"]
+                rebalance = self._heartbeat_or_rejoin(
+                    topic, group, consumer_id, namespace, state
                 )
             if rebalance:  # pick up the new split
                 state = self.join_group(topic, group, consumer_id, namespace)
+
+    def _heartbeat_or_rejoin(self, topic, group, consumer_id, namespace, state) -> bool:
+        """True when the consumer must rejoin: the generation moved, or the
+        broker forgot the group (we were reaped / the entry was swept)."""
+        import grpc as _grpc
+
+        try:
+            return (
+                self.group_heartbeat(topic, group, consumer_id, namespace)
+                != state["generation"]
+            )
+        except _grpc.RpcError as e:
+            if e.code() == _grpc.StatusCode.NOT_FOUND:
+                return True
+            raise

@@ -42,8 +42,17 @@ class ChunkCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self._disk_bytes = 0  # running total: eviction scans only when over budget
         if disk_dir and disk_bytes > 0:
             os.makedirs(disk_dir, exist_ok=True)
+            try:
+                self._disk_bytes = sum(
+                    e.stat().st_size
+                    for e in os.scandir(disk_dir)
+                    if e.name.endswith(".chunk")
+                )
+            except OSError:
+                pass
 
     # -- keys -----------------------------------------------------------------
 
@@ -67,7 +76,11 @@ class ChunkCache:
             try:
                 with open(self._disk_path(fid), "rb") as f:
                     data = f.read()
-                self._put_mem(fid, data)  # promote
+                # same guard as put(): a persisted oversized blob (e.g.
+                # after a restart with a smaller max_item_bytes) must not
+                # wipe the memory working set on promotion
+                if len(data) <= self.max_item_bytes:
+                    self._put_mem(fid, data)
                 with self._lock:
                     self.hits += 1
                 return data
@@ -83,11 +96,20 @@ class ChunkCache:
         self._put_mem(fid, data)
         if self.disk_dir and self.disk_budget > 0:
             try:
-                tmp = self._disk_path(fid) + ".tmp"
+                path = self._disk_path(fid)
+                try:
+                    prev = os.path.getsize(path)
+                except OSError:
+                    prev = 0
+                tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)
-                os.replace(tmp, self._disk_path(fid))
-                self._evict_disk()
+                os.replace(tmp, path)
+                with self._lock:
+                    self._disk_bytes += len(data) - prev
+                    over = self._disk_bytes > self.disk_budget
+                if over:
+                    self._evict_disk()
             except OSError:
                 pass  # a full/broken disk tier must never fail a read
 
@@ -108,12 +130,18 @@ class ChunkCache:
             if old is not None:
                 self._mem_bytes -= len(old)
         if self.disk_dir and self.disk_budget > 0:
+            path = self._disk_path(fid)
             try:
-                os.remove(self._disk_path(fid))
+                size = os.path.getsize(path)
+                os.remove(path)
+                with self._lock:
+                    self._disk_bytes = max(0, self._disk_bytes - size)
             except OSError:
                 pass
 
     def _evict_disk(self) -> None:
+        """Called only when the running total crossed the budget — the
+        directory scan is paid once per overflow, not per put."""
         try:
             entries = [
                 (e.stat().st_mtime, e.path, e.stat().st_size)
@@ -123,16 +151,16 @@ class ChunkCache:
         except OSError:
             return
         total = sum(s for _, _, s in entries)
-        if total <= self.disk_budget:
-            return
         for _, path, size in sorted(entries):  # oldest first
+            if total <= self.disk_budget:
+                break
             try:
                 os.remove(path)
                 total -= size
             except OSError:
                 pass
-            if total <= self.disk_budget:
-                break
+        with self._lock:
+            self._disk_bytes = total
 
     @property
     def memory_bytes_used(self) -> int:
@@ -140,6 +168,17 @@ class ChunkCache:
             return self._mem_bytes
 
     def clear(self) -> None:
+        """Full invalidation of BOTH tiers (a memory-only clear would keep
+        serving old bytes from disk on the next get)."""
         with self._lock:
             self._mem.clear()
             self._mem_bytes = 0
+        if self.disk_dir and self.disk_budget > 0:
+            try:
+                for e in os.scandir(self.disk_dir):
+                    if e.name.endswith(".chunk"):
+                        os.remove(e.path)
+            except OSError:
+                pass
+            with self._lock:
+                self._disk_bytes = 0

@@ -294,10 +294,20 @@ def test_mq_stale_member_is_reaped(stack):
             c.join_group("t", "g", "dead-consumer")
             live = c.join_group("t", "g", "live-consumer")
             assert len(live["partitions"]) == 1
-            time.sleep(0.5)  # dead-consumer misses its heartbeats
-            c.group_heartbeat("t", "g", "live-consumer")  # triggers reap
+            # live keeps heartbeating; dead goes silent past the 0.3 s TTL
+            for _ in range(3):
+                time.sleep(0.2)
+                c.group_heartbeat("t", "g", "live-consumer")
             live = c.join_group("t", "g", "live-consumer")
             assert set(live["partitions"]) == {0, 1}
+            # and a group whose EVERY member goes silent is swept entirely:
+            # the next heartbeat tells the consumer to rejoin
+            import grpc as _grpc
+
+            time.sleep(0.5)
+            with pytest.raises(_grpc.RpcError, match="unknown group"):
+                c.group_heartbeat("t", "g", "live-consumer")
+            assert set(c.join_group("t", "g", "live-consumer")["partitions"]) == {0, 1}
 
 
 def test_mq_consume_crash_never_loses_a_record(stack):
