@@ -39,6 +39,8 @@ class MasterServer:
         peers: Optional[list[str]] = None,
         raft_dir: str = "",
         election_timeout: tuple[float, float] = (1.0, 2.0),
+        garbage_threshold: float = 0.3,
+        vacuum_interval: float = 900.0,
     ):
         self.guard = guard
         self.topology = Topology(
@@ -63,8 +65,11 @@ class MasterServer:
         self.host = host
         self.port = self._server.port
         self._reap_interval = reap_interval
+        self.garbage_threshold = garbage_threshold
+        self._vacuum_interval = vacuum_interval
         self._stop = threading.Event()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._vacuumer = threading.Thread(target=self._vacuum_loop, daemon=True)
         # raft HA (reference: master quorum; single-master when no peers)
         self.raft = None
         if peers:
@@ -162,6 +167,7 @@ class MasterServer:
         if self.raft is not None:
             self.raft.start()
         self._reaper.start()
+        self._vacuumer.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -183,6 +189,67 @@ class MasterServer:
     def _reap_loop(self) -> None:
         while not self._stop.wait(self._reap_interval):
             self.topology.reap_dead_nodes()
+
+    # -- automatic vacuum (topology_vacuum.go analog) --------------------------
+
+    def _vacuum_loop(self) -> None:
+        while not self._stop.wait(self._vacuum_interval):
+            if not self.is_leader:
+                continue  # exactly one master drives cluster maintenance
+            try:
+                self.vacuum_once()
+            except Exception:  # noqa: BLE001 — maintenance must never die
+                pass
+
+    def vacuum_once(self) -> list[int]:
+        """One scan: compact every writable volume whose heartbeat-reported
+        garbage ratio exceeds the threshold, on every holder. Returns the
+        volume ids vacuumed. The reference's master does this on a timer;
+        operators can still force it via `volume.vacuum` in the shell.
+
+        Safety: the sweep defers entirely while the cluster admin lock is
+        held — every mutating shell operation (ec.encode, balance, ...)
+        runs under it, and compacting a volume mid-copy/encode would shift
+        every needle offset under the operation's feet. Each holder is
+        also re-checked with a live VolumeStatus immediately before the
+        compact: the heartbeat-reported read_only flag can be a whole
+        heartbeat interval stale."""
+        now = time.monotonic()
+        with self._admin_lock_mu:
+            if any(exp > now for _, exp, _ in self._admin_locks.values()):
+                return []  # operator maintenance in flight: next sweep retries
+        candidates: dict[int, list[str]] = {}
+        with self.topology._lock:
+            for node in self.topology.nodes.values():
+                for vi in node.volumes.values():
+                    if vi.read_only:
+                        continue
+                    if vi.garbage_ratio >= self.garbage_threshold:
+                        candidates.setdefault(vi.id, []).append(node.grpc_address)
+        done = []
+        for vid, holders in sorted(candidates.items()):
+            ok = True
+            for addr in holders:  # every replica compacts (same live set)
+                try:
+                    with rpc.RpcClient(addr) as c:
+                        status = c.call(
+                            VOLUME_SERVICE, "VolumeStatus", {"volume_id": vid},
+                            timeout=10,
+                        )
+                        if status.get("read_only"):
+                            ok = False  # marked since the last heartbeat
+                            continue
+                        c.call(
+                            VOLUME_SERVICE,
+                            "VolumeCompact",
+                            {"volume_id": vid},
+                            timeout=600,
+                        )
+                except Exception:  # noqa: BLE001 — retried next sweep
+                    ok = False
+            if ok:
+                done.append(vid)
+        return done
 
     # -- RPC surface ---------------------------------------------------------
 

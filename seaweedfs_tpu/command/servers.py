@@ -73,6 +73,10 @@ def _master_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-peers", default="", help="comma-separated master quorum (raft HA)")
+    p.add_argument("-garbageThreshold", type=float, default=0.3,
+                   help="auto-vacuum volumes whose dead fraction exceeds this")
+    p.add_argument("-vacuumInterval", type=float, default=900.0,
+                   help="seconds between automatic vacuum sweeps")
     p.add_argument("-raftDir", default="", help="raft term/vote persistence directory")
     p.add_argument("-metricsPort", type=int, default=0)
 
@@ -89,6 +93,8 @@ def _master_run(args: argparse.Namespace) -> int:
         guard=_load_guard(),
         peers=peers or None,
         raft_dir=args.raftDir,
+        garbage_threshold=args.garbageThreshold,
+        vacuum_interval=args.vacuumInterval,
     )
     m.start()
     _maybe_metrics(args.metricsPort)

@@ -530,13 +530,24 @@ class BrokerClient:
             rounds += 1
             rebalance = False
             for p in state["partitions"]:
+                # heartbeat between partitions too: several idle partitions
+                # in sequence must not add up past the session timeout
+                if _time.monotonic() - last_hb >= hb_interval():
+                    last_hb = _time.monotonic()
+                    if self._heartbeat_or_rejoin(
+                        topic, group, consumer_id, namespace, state
+                    ):
+                        rebalance = True
+                        break
                 since = self.fetch_offset(topic, group, p, namespace)
                 pending = 0  # records delivered but not yet committed
                 last_ts = since
                 for rec in self.subscribe(
                     topic, partition=p, since_ns=since,
-                    # cap the blocking wait below the session timeout
-                    namespace=namespace, max_idle_s=min(poll_idle_s, hb_interval()),
+                    # cap each blocking wait well below the session timeout:
+                    # combined with the pre-partition heartbeat above, the
+                    # longest un-heartbeated stretch is ~1.5/3 of the TTL
+                    namespace=namespace, max_idle_s=min(poll_idle_s, hb_interval() / 2),
                 ):
                     yield p, rec
                     # the caller came back: the record was processed

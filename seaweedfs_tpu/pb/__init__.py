@@ -72,6 +72,7 @@ class VolumeInformation:
     ttl: str = ""
     version: int = 3
     disk_type: str = ""
+    garbage_ratio: float = 0.0  # dead fraction of .dat; auto-vacuum signal
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -89,6 +90,7 @@ class VolumeInformation:
             ttl=d.get("ttl", ""),
             version=int(d.get("version", 3)),
             disk_type=d.get("disk_type", ""),
+            garbage_ratio=float(d.get("garbage_ratio", 0.0)),
         )
 
 

@@ -209,6 +209,7 @@ class Store:
                         "replica_placement": str(v.super_block.replica_placement),
                         "ttl": str(v.super_block.ttl),
                         "version": v.version,
+                        "garbage_ratio": round(v.garbage_ratio(), 4),
                     }
                 )
         return out

@@ -96,6 +96,13 @@ class Volume:
 
                     self.nm = new_needle_map(needle_map_kind, self.base_path)
             self._idx = open(self.idx_path, "ab")
+            # live-byte accounting for the garbage ratio that drives the
+            # master's automatic vacuum (topology_vacuum.go analog): one
+            # O(live) pass at mount, then maintained incrementally
+            self._live_bytes = sum(
+                types.actual_size(size, self.version)
+                for _, _, size in self.nm.ascending_visit()
+            )
         except BaseException:
             self._dat.close()
             raise
@@ -141,7 +148,11 @@ class Volume:
             self._dat.write(rec)
             self._dat.flush()
             stored = types.offset_to_bytes(offset)
+            old = self.nm.get(n.id)
+            if old is not None:  # overwrite: the old record becomes garbage
+                self._live_bytes -= types.actual_size(old[1], self.version)
             self.nm.set(n.id, stored, n.size)
+            self._live_bytes += types.actual_size(n.size, self.version)
             self._idx.write(types.pack_index_entry(n.id, stored, n.size))
             self._idx.flush()
             return offset, n.size
@@ -151,8 +162,10 @@ class Volume:
         with self._lock:
             if self.read_only:
                 raise VolumeReadOnly(f"volume {self.id} is read-only")
-            if self.nm.get(needle_id) is None:
+            old = self.nm.get(needle_id)
+            if old is None:
                 return False
+            self._live_bytes -= types.actual_size(old[1], self.version)
             tomb = Needle(id=needle_id, cookie=0)
             self._dat.seek(0, os.SEEK_END)
             self._dat.write(tomb.to_bytes(self.version, tombstone=True))
@@ -189,6 +202,17 @@ class Volume:
 
     def needle_count(self) -> int:
         return len(self.nm)
+
+    def garbage_ratio(self) -> float:
+        """Fraction of the .dat body that is dead (deleted/overwritten
+        records + tombstones) — the auto-vacuum trigger signal."""
+        from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
+
+        with self._lock:
+            body = self.content_size() - SUPER_BLOCK_SIZE
+            if body <= 0:
+                return 0.0
+            return max(0.0, (body - self._live_bytes) / body)
 
     # -- maintenance ---------------------------------------------------------
 

@@ -151,16 +151,19 @@ class ChunkCache:
         except OSError:
             return
         total = sum(s for _, _, s in entries)
+        removed = 0
         for _, path, size in sorted(entries):  # oldest first
-            if total <= self.disk_budget:
+            if total - removed <= self.disk_budget:
                 break
             try:
                 os.remove(path)
-                total -= size
+                removed += size
             except OSError:
                 pass
+        # adjust by the delta rather than overwriting: puts/deletes racing
+        # this scan already updated the counter for files we didn't see
         with self._lock:
-            self._disk_bytes = total
+            self._disk_bytes = max(0, self._disk_bytes - removed)
 
     @property
     def memory_bytes_used(self) -> int:

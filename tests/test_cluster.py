@@ -357,3 +357,53 @@ def test_snowflake_monotonic_against_clock():
     sq._last_ms += 10_000
     a, b = sq.next_ids(), sq.next_ids()
     assert b > a >= ((sq._last_ms - sq.EPOCH_MS) << 22)
+
+
+def test_master_auto_vacuum(tmp_path):
+    """topology_vacuum.go analog: the master spots garbage-heavy volumes
+    from heartbeat-reported garbage ratios and compacts them on every
+    holder — no operator involved."""
+    master = MasterServer(port=0, reap_interval=3600, garbage_threshold=0.3,
+                          vacuum_interval=3600)  # sweep driven manually
+    master.start()
+    d = tmp_path / "srv"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+    vs.start()
+    client = MasterClient(master.address)
+    try:
+        fids = []
+        for i in range(20):
+            r = client.submit(os.urandom(3000))
+            fids.append(r.fid)
+        vid = int(fids[0].split(",")[0])
+        vol = vs.store.get_volume(vid)
+        assert vol.garbage_ratio() < 0.05
+        # delete 75% -> garbage crosses the threshold
+        for fid in fids[:15]:
+            client.delete(fid)
+        assert vol.garbage_ratio() > 0.3
+        size_before = vol.content_size()
+        _wait_for(
+            lambda: any(
+                vi.garbage_ratio > 0.3
+                for n in master.topology.nodes.values()
+                for vi in n.volumes.values()
+            ),
+            msg="garbage ratio reaches the master via heartbeat",
+        )
+        done = master.vacuum_once()
+        assert vid in done
+        vol2 = vs.store.get_volume(vid)
+        assert vol2.content_size() < size_before / 2, "compaction did not shrink .dat"
+        assert vol2.garbage_ratio() < 0.05
+        # survivors intact, deleted stay gone
+        for fid in fids[15:]:
+            assert client.read(fid)
+        for fid in fids[:3]:
+            with pytest.raises(ClusterError):
+                client.read(fid)
+    finally:
+        client.close()
+        vs.stop()
+        master.stop()
