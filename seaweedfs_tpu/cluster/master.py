@@ -228,6 +228,12 @@ class MasterServer:
                         candidates.setdefault(vi.id, []).append(node.grpc_address)
         done = []
         for vid, holders in sorted(candidates.items()):
+            with self._admin_lock_mu:  # an operator may have locked mid-sweep
+                if any(
+                    exp > time.monotonic()
+                    for _, exp, _ in self._admin_locks.values()
+                ):
+                    return done  # stop immediately; next sweep retries
             ok = True
             for addr in holders:  # every replica compacts (same live set)
                 try:

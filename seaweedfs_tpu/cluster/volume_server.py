@@ -94,6 +94,14 @@ class VolumeServer:
         ]
         self._masters = {a: rpc.RpcClient(a) for a in self._master_addresses}
         self._master = self._masters[self._master_addresses[0]]
+        # Per-volume maintenance mutex: compact, EC-shard generation, and
+        # the .dat/.idx copy streams all read/rewrite the volume FILES
+        # outside the Volume's needle lock — two of them interleaving on
+        # one volume (auto-vacuum racing ec.encode, balance racing compact)
+        # would stream/encode a half-swapped .dat. Serializing them here
+        # closes the race no matter which actor (timer or operator) fires.
+        self._maint_locks: dict[int, threading.Lock] = {}
+        self._maint_mu = threading.Lock()
         # degraded-read plumbing: LookupEcVolume answers are cached per vid
         # with expiry (the reference caches ShardLocations on the EcVolume)
         # and peer channels are pooled — an uncached lookup + fresh dial per
@@ -361,11 +369,27 @@ class VolumeServer:
         v.read_only = False
         return {}
 
+    def maintenance_lock(self, vid: int) -> threading.Lock:
+        with self._maint_mu:
+            lk = self._maint_locks.get(vid)
+            if lk is None:
+                lk = self._maint_locks[vid] = threading.Lock()
+            return lk
+
     def _rpc_compact(self, req: dict, ctx) -> dict:
-        v = self.store.get_volume(int(req["volume_id"]))
+        vid = int(req["volume_id"])
+        v = self.store.get_volume(vid)
         if v is None:
             raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
-        before, after = v.compact()
+        with self.maintenance_lock(vid):
+            v = self.store.get_volume(vid)
+            if v is None:
+                raise rpc.NotFoundFault(f"volume {vid} not found")
+            if v.read_only:
+                # frozen volumes are frozen for a reason (ec.encode, copy in
+                # flight): compacting one would shift every needle offset
+                raise rpc.RpcFault(f"volume {vid} is read-only; not compacting")
+            before, after = v.compact()
         return {"bytes_before": before, "bytes_after": after}
 
     def _rpc_volume_copy(self, req: dict, ctx) -> dict:
@@ -519,8 +543,9 @@ class VolumeServer:
         if req.get("small_block_size"):
             kwargs["small_block_size"] = int(req["small_block_size"])
         t0 = time.monotonic()
-        stripe.write_ec_files(v.base_path, encoder=self.store.encoder, **kwargs)
-        stripe.write_sorted_file_from_idx(v.base_path)
+        with self.maintenance_lock(vid):  # never interleave with compact/copy
+            stripe.write_ec_files(v.base_path, encoder=self.store.encoder, **kwargs)
+            stripe.write_sorted_file_from_idx(v.base_path)
         stats.EcEncodeSeconds.observe(time.monotonic() - t0)
         stats.EcEncodeBytes.inc(os.path.getsize(v.base_path + ".dat"))
         return {"shard_ids": list(range(TOTAL_SHARDS_COUNT))}
@@ -560,18 +585,28 @@ class VolumeServer:
         return {}
 
     def _rpc_ec_file_copy(self, req: dict, ctx):
-        """Stream one local EC-related file (server side of ShardsCopy)."""
+        """Stream one local EC-related file (server side of ShardsCopy and
+        of VolumeCopy's .dat/.idx pull). Streaming .dat/.idx holds the
+        volume's maintenance mutex so a concurrent compact can never swap
+        the file mid-stream (the destination would get a torn copy)."""
         vid = int(req["volume_id"])
         base = self._base_path_for(vid, req.get("collection", ""))
         path = base + req["ext"]
-        if not os.path.exists(path):
-            raise rpc.NotFoundFault(f"{path} not found")
-        with open(path, "rb") as f:
-            while True:
-                chunk = f.read(_COPY_CHUNK)
-                if not chunk:
-                    break
-                yield chunk
+        lock = self.maintenance_lock(vid) if req["ext"] in (".dat", ".idx") else None
+        if lock is not None:
+            lock.acquire()
+        try:
+            if not os.path.exists(path):
+                raise rpc.NotFoundFault(f"{path} not found")
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(_COPY_CHUNK)
+                    if not chunk:
+                        break
+                    yield chunk
+        finally:
+            if lock is not None:
+                lock.release()
 
     def _rpc_ec_rebuild(self, req: dict, ctx) -> dict:
         """VolumeEcShardsRebuild: reconstruct missing shards from >=10 local."""

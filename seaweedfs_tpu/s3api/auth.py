@@ -124,6 +124,12 @@ class Iam:
             return None, "NotImplemented"
         if self.open:
             return Identity("anonymous", "", "", [ACTION_ADMIN]), ""
+        qparams = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+        if "X-Amz-Signature" in qparams:
+            # presigned URL: SigV4 in the query string, not the headers
+            return self._authenticate_presigned(
+                method, path, query, headers, qparams, expect_service, expect_hosts
+            )
         auth = headers.get("authorization", "")
         if not auth.startswith(_ALGO):
             return None, "MissingSecurityHeader"
@@ -179,6 +185,75 @@ class Iam:
             headers,
             signed_headers,
             payload_hash,
+            amz_date,
+            region,
+            service,
+        )
+        if not hmac.compare_digest(want, got_sig):
+            return None, "SignatureDoesNotMatch"
+        return identity, ""
+
+
+    _PRESIGN_MAX_EXPIRES = 7 * 24 * 3600  # AWS's 7-day ceiling
+
+    def _authenticate_presigned(
+        self,
+        method: str,
+        path: str,
+        query: str,
+        headers: dict[str, str],
+        qparams: dict[str, str],
+        expect_service: Optional[str],
+        expect_hosts: Optional[set[str]],
+    ) -> tuple[Optional[Identity], str]:
+        """Query-string SigV4 (presigned URLs): the payload is always
+        UNSIGNED-PAYLOAD and X-Amz-Signature is excluded from the canonical
+        query. Expiry comes from X-Amz-Date + X-Amz-Expires."""
+        if qparams.get("X-Amz-Algorithm") != _ALGO:
+            return None, "AuthorizationQueryParametersError"
+        try:
+            cred = qparams["X-Amz-Credential"]
+            amz_date = qparams["X-Amz-Date"]
+            expires = int(qparams["X-Amz-Expires"])
+            signed_headers = qparams["X-Amz-SignedHeaders"].split(";")
+            got_sig = qparams["X-Amz-Signature"]
+            access_key, date, region, service, _ = cred.split("/", 4)
+        except (KeyError, ValueError):
+            return None, "AuthorizationQueryParametersError"
+        if not 1 <= expires <= self._PRESIGN_MAX_EXPIRES:
+            return None, "AuthorizationQueryParametersError"
+        if expect_service is not None and service != expect_service:
+            return None, "AccessDenied"
+        if "host" not in signed_headers:
+            return None, "InvalidRequest"
+        if expect_hosts is not None and headers.get("host", "").lower() not in expect_hosts:
+            return None, "AccessDenied"
+        identity = self.lookup(access_key)
+        if identity is None:
+            return None, "InvalidAccessKeyId"
+        try:
+            req_ts = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            return None, "AccessDenied"
+        now = time.time()
+        if now < req_ts - _MAX_SKEW_S:
+            return None, "AccessDenied"  # from the future beyond clock skew
+        if now > req_ts + expires:
+            return None, "AccessDenied"  # expired link
+        # canonical query = every parameter EXCEPT the signature itself
+        filtered = "&".join(
+            part
+            for part in query.split("&")
+            if part and not part.startswith("X-Amz-Signature=")
+        )
+        want = _signature(
+            identity.secret_key,
+            method,
+            path,
+            filtered,
+            headers,
+            signed_headers,
+            "UNSIGNED-PAYLOAD",
             amz_date,
             region,
             service,
@@ -281,6 +356,48 @@ def sign_request(
         f"SignedHeaders={';'.join(signed)}, Signature={sig}"
     )
     return headers
+
+
+def presign_url(
+    access_key: str,
+    secret_key: str,
+    method: str,
+    url: str,
+    expires: int = 3600,
+    region: str = "us-east-1",
+    service: str = "s3",
+) -> str:
+    """Client half of presigned URLs: returns `url` with the SigV4 query
+    parameters appended. The holder of the link can perform `method` on
+    the object until expiry, with no credentials of their own."""
+    u = urllib.parse.urlparse(url)
+    path = urllib.parse.unquote(u.path or "/")
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
+    params = [
+        ("X-Amz-Algorithm", _ALGO),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", amz_date),
+        ("X-Amz-Expires", str(int(expires))),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    base_q = [p for p in (u.query or "").split("&") if p]
+    query = "&".join(
+        base_q + [f"{k}={urllib.parse.quote(v, safe='-_.~')}" for k, v in params]
+    )
+    sig = _signature(
+        secret_key,
+        method,
+        path,
+        query,
+        {"host": u.netloc},
+        ["host"],
+        "UNSIGNED-PAYLOAD",
+        amz_date,
+        region,
+        service,
+    )
+    return u._replace(query=query + f"&X-Amz-Signature={sig}").geturl()
 
 
 # -- identity persistence (filer KV) ------------------------------------------

@@ -120,8 +120,9 @@ def do_volume_vacuum(args: list[str], env: CommandEnv, w: TextIO) -> None:
             vid = int(v["id"])
             if fl.volumeId and vid != fl.volumeId:
                 continue
-            fc, dc = int(v.get("file_count", 0)), int(v.get("delete_count", 0))
-            if not fl.volumeId and (fc + dc == 0 or dc / max(fc + dc, 1) < fl.garbageThreshold):
+            if v.get("read_only"):  # frozen volumes refuse compaction
+                continue
+            if not fl.volumeId and float(v.get("garbage_ratio", 0.0)) < fl.garbageThreshold:
                 continue
             resp = env.vs_call(grpc_addr(n), "VolumeCompact", {"volume_id": vid})
             w.write(
@@ -251,6 +252,112 @@ register(
         "volume.fix.replication [-noFix]\n\tdetect under-replicated volumes and "
         "copy them to fresh nodes",
         do_volume_fix_replication,
+    )
+)
+
+
+def do_volume_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Even volume counts across nodes (command_volume_balance.go analog):
+    move whole volumes (VolumeCopy .dat/.idx, then delete the source copy)
+    from the fullest node to the emptiest until counts differ by <=1,
+    never co-locating two replicas of one volume. Writable volumes are
+    frozen on every holder for the move (a write landing mid-copy would
+    be missing from the destination) and thawed after."""
+    fl = parse_flags(args, collection="", noApply=False)
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    if len(nodes) < 2:
+        w.write("volume.balance: need >=2 nodes\n")
+        return
+    by_url = {n["url"]: n for n in nodes}
+    placement: dict[str, dict[int, dict]] = {
+        n["url"]: {int(v["id"]): v for v in n.get("volumes", [])} for n in nodes
+    }
+    moves = 0
+    while True:
+        urls = sorted(placement, key=lambda u: len(placement[u]))
+        lightest, heaviest = urls[0], urls[-1]
+        if len(placement[heaviest]) - len(placement[lightest]) <= 1:
+            break
+        candidate = None
+        for vid, v in sorted(placement[heaviest].items()):
+            if fl.collection and v.get("collection", "") != fl.collection:
+                continue
+            if vid in placement[lightest]:  # replica already there
+                continue
+            candidate = (vid, v)
+            break
+        if candidate is None:
+            break
+        vid, v = candidate
+        if fl.noApply:
+            w.write(f"volume.balance (dry): would move {vid} {heaviest} -> {lightest}\n")
+            placement[lightest][vid] = v
+            del placement[heaviest][vid]
+            moves += 1
+            continue
+        holders = [u for u in placement if vid in placement[u]]
+        # live read_only check, not the heartbeat-stale topology flag: a
+        # volume marked writable since the last heartbeat would otherwise
+        # take writes mid-copy and lose them with the source delete
+        status = env.vs_call(
+            grpc_addr(by_url[heaviest]), "VolumeStatus", {"volume_id": vid}
+        )
+        was_writable = not status.get("read_only", False)
+        frozen: list[str] = []
+        moved = False
+        try:
+            if was_writable:
+                for u in holders:  # inside try: a failed freeze still thaws
+                    env.vs_call(
+                        grpc_addr(by_url[u]), "VolumeMarkReadonly", {"volume_id": vid}
+                    )
+                    frozen.append(u)
+            env.vs_call(
+                grpc_addr(by_url[lightest]),
+                "VolumeCopy",
+                {
+                    "volume_id": vid,
+                    "collection": v.get("collection", ""),
+                    "source_data_node": grpc_addr(by_url[heaviest]),
+                    "read_only": True,
+                },
+            )
+            env.vs_call(
+                grpc_addr(by_url[heaviest]), "VolumeDelete", {"volume_id": vid}
+            )
+            moved = True
+        finally:
+            if was_writable:
+                # success: thaw survivors + destination (source copy is
+                # gone). Failure: thaw EXACTLY what was frozen, source
+                # included — a failed move must never leave the volume
+                # read-only until an operator notices.
+                thaw = (
+                    [u for u in holders if u != heaviest] + [lightest]
+                    if moved
+                    else frozen
+                )
+                for u in thaw:
+                    try:
+                        env.vs_call(
+                            grpc_addr(by_url[u]), "VolumeMarkWritable", {"volume_id": vid}
+                        )
+                    except Exception:  # noqa: BLE001 — best-effort thaw
+                        pass
+        placement[lightest][vid] = v
+        del placement[heaviest][vid]
+        w.write(f"volume.balance: moved {vid} {heaviest} -> {lightest}\n")
+        moves += 1
+    w.write(f"volume.balance: {moves} moves\n")
+
+
+register(
+    ShellCommand(
+        "volume.balance",
+        "volume.balance [-collection c] [-noApply]\n\teven volume counts across "
+        "nodes by moving whole volumes",
+        do_volume_balance,
     )
 )
 

@@ -199,17 +199,20 @@ class Store:
         out = []
         for loc in self.locations:
             for vid, v in loc.volumes.items():
+                # lock-free snapshot: the heartbeat must not block behind a
+                # long-running compaction's volume lock
+                size, count, garbage = v.stats_snapshot()
                 out.append(
                     {
                         "id": vid,
                         "collection": v.collection,
-                        "size": v.content_size(),
-                        "file_count": v.needle_count(),
+                        "size": size,
+                        "file_count": count,
                         "read_only": v.read_only,
                         "replica_placement": str(v.super_block.replica_placement),
                         "ttl": str(v.super_block.ttl),
                         "version": v.version,
-                        "garbage_ratio": round(v.garbage_ratio(), 4),
+                        "garbage_ratio": round(garbage, 4),
                     }
                 )
         return out

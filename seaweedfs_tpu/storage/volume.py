@@ -206,13 +206,27 @@ class Volume:
     def garbage_ratio(self) -> float:
         """Fraction of the .dat body that is dead (deleted/overwritten
         records + tombstones) — the auto-vacuum trigger signal."""
+        with self._lock:
+            return self._garbage_from(self.content_size())
+
+    def _garbage_from(self, size: int) -> float:
         from seaweedfs_tpu.storage.super_block import SUPER_BLOCK_SIZE
 
-        with self._lock:
-            body = self.content_size() - SUPER_BLOCK_SIZE
-            if body <= 0:
-                return 0.0
-            return max(0.0, (body - self._live_bytes) / body)
+        body = size - SUPER_BLOCK_SIZE
+        if body <= 0:
+            return 0.0
+        return max(0.0, (body - self._live_bytes) / body)
+
+    def stats_snapshot(self) -> tuple[int, int, float]:
+        """(size, needle_count, garbage_ratio) WITHOUT the volume lock —
+        the heartbeat thread must keep reporting while a compaction holds
+        the lock for minutes, or the master reaps a healthy node mid-
+        compact. Values are GIL-consistent-enough; staleness is fine."""
+        try:
+            size = os.path.getsize(self.dat_path)
+        except OSError:
+            size = 0
+        return size, len(self.nm), self._garbage_from(size)
 
     # -- maintenance ---------------------------------------------------------
 

@@ -389,3 +389,27 @@ def test_run_script_multiple_commands(cluster):
     run_script(env, "lock; volume.list; unlock", out)
     s = out.getvalue()
     assert "locked" in s and "DataCenter" in s and "unlocked" in s
+
+
+def test_volume_balance_moves_volumes(cluster):
+    """command_volume_balance.go analog: an uneven cluster converges to
+    counts within 1, moved volumes stay fully readable."""
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=30, size=900)
+    # force growth of several volumes so there's something to move
+    for _ in range(6):
+        client.assign()  # each assign may grow a volume
+    import time as _t
+
+    _t.sleep(0.8)  # heartbeats settle
+    counts_before = {
+        n["url"]: len(n.get("volumes", [])) for n in env.topology_nodes()
+    }
+    run(env, "lock")
+    out = run(env, "volume.balance")
+    assert "volume.balance:" in out
+    _t.sleep(0.8)  # heartbeats propagate the moves
+    counts = {n["url"]: len(n.get("volumes", [])) for n in env.topology_nodes()}
+    assert max(counts.values()) - min(counts.values()) <= 1, (counts_before, counts)
+    for fid, payload in fids:
+        assert client.read(fid) == payload, f"{fid} unreadable after balance"
