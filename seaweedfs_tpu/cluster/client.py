@@ -272,7 +272,10 @@ class MasterClient:
                 continue
         return ok
 
-    def submit(self, data: bytes, collection: str = "", replication: str = "", mime: str = "") -> SubmitResult:
-        a = self.assign(collection=collection, replication=replication)
+    def submit(
+        self, data: bytes, collection: str = "", replication: str = "",
+        mime: str = "", ttl: str = "",
+    ) -> SubmitResult:
+        a = self.assign(collection=collection, replication=replication, ttl=ttl)
         size = self.upload(a.fid, data, mime=mime, auth=a.auth)
         return SubmitResult(fid=a.fid, url=a.url, size=size)

@@ -222,8 +222,8 @@ class MasterServer:
         with self.topology._lock:
             for node in self.topology.nodes.values():
                 for vi in node.volumes.values():
-                    if vi.read_only:
-                        continue
+                    if vi.read_only or vi.disk_type == "remote":
+                        continue  # frozen or tiered: cannot compact
                     if vi.garbage_ratio >= self.garbage_threshold:
                         candidates.setdefault(vi.id, []).append(node.grpc_address)
         done = []

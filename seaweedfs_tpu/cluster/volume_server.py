@@ -207,6 +207,9 @@ class VolumeServer:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):
             try:
+                # prune whole TTL volumes whose newest write aged out; the
+                # heartbeat that follows drops them from the topology
+                self._reap_expired_volumes()
                 self.heartbeat_once()
             except Exception:  # noqa: BLE001 — keep beating; master reappears
                 continue
@@ -343,15 +346,7 @@ class VolumeServer:
         return {}
 
     def _rpc_volume_delete(self, req: dict, ctx) -> dict:
-        vid = int(req["volume_id"])
-        for loc in self.store.locations:
-            v = loc.volumes.pop(vid, None)
-            if v is not None:
-                v.close()
-                for ext in (".dat", ".idx"):
-                    p = v.base_path + ext
-                    if os.path.exists(p):
-                        os.remove(p)
+        self.store.remove_volume(int(req["volume_id"]))
         self.heartbeat_once()  # push the deletion to the master now
         return {}
 
@@ -368,6 +363,20 @@ class VolumeServer:
             raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
         v.read_only = False
         return {}
+
+    def _reap_expired_volumes(self) -> None:
+        """TTL reap under the per-volume maintenance mutex: a volume that
+        is frozen (balance/ec.encode in flight) or mid-copy must not have
+        its files unlinked underneath the operation — it stays for the
+        next sweep."""
+        for vid in self.store.expired_volume_ids():
+            with self.maintenance_lock(vid):
+                vol = self.store.get_volume(vid)
+                if vol is None or vol.read_only:
+                    continue  # frozen: an operator operation owns it
+                if vid not in set(self.store.expired_volume_ids()):
+                    continue  # a write landed since the scan
+                self.store.remove_volume(vid)
 
     def maintenance_lock(self, vid: int) -> threading.Lock:
         with self._maint_mu:

@@ -285,6 +285,8 @@ def do_volume_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
                 continue
             if vid in placement[lightest]:  # replica already there
                 continue
+            if v.get("disk_type") == "remote":
+                continue  # tiered: no local .dat to stream
             candidate = (vid, v)
             break
         if candidate is None:

@@ -195,6 +195,57 @@ class Store:
 
     # -- status / heartbeat --------------------------------------------------
 
+    def remove_volume(self, vid: int) -> bool:
+        """Close and unlink a local volume's files."""
+        removed = False
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    for ext in (".dat", ".idx", ".sdx", ".sdx.meta"):
+                        p = v.base_path + ext
+                        if os.path.exists(p):
+                            os.remove(p)
+                    removed = True
+        return removed
+
+    def expired_volume_ids(self) -> list[int]:
+        """TTL volumes whose NEWEST write has aged out (the reference
+        prunes ttl volumes the same way: .dat mtime is the last append,
+        so mtime + ttl < now means every needle inside is past its TTL).
+        Scan only — the volume server deletes under its per-volume
+        maintenance mutex so a reap can never race a copy/encode."""
+        import time as _time
+
+        expired = []
+        with self._lock:
+            for loc in self.locations:
+                for vid, v in loc.volumes.items():
+                    ttl_s = v.super_block.ttl.seconds
+                    if not ttl_s:
+                        continue
+                    try:
+                        mtime = os.path.getmtime(v.dat_path)
+                    except OSError:
+                        continue
+                    if mtime + ttl_s < _time.time():
+                        expired.append(vid)
+        return expired
+
+    def reap_expired_volumes(self) -> list[int]:
+        """Standalone (no volume server) expiry pass, used by tests and
+        local tools; servers go through expired_volume_ids() + their
+        maintenance mutex instead."""
+        expired = [
+            vid
+            for vid in self.expired_volume_ids()
+            if (v := self.get_volume(vid)) is not None and not v.read_only
+        ]
+        for vid in expired:
+            self.remove_volume(vid)
+        return expired
+
     def volume_infos(self) -> list[dict]:
         out = []
         for loc in self.locations:
@@ -212,6 +263,7 @@ class Store:
                         "replica_placement": str(v.super_block.replica_placement),
                         "ttl": str(v.super_block.ttl),
                         "version": v.version,
+                        "disk_type": "remote" if v.tiered else "",
                         "garbage_ratio": round(garbage, 4),
                     }
                 )

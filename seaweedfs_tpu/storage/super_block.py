@@ -69,6 +69,13 @@ class TTL:
         return cls(b[0], TTL_UNITS.get(b[1], "m"))
 
     @property
+    def seconds(self) -> int:
+        """0 = no expiry."""
+        if not self.count:
+            return 0
+        return self.count * _TTL_MINUTES[self.unit or "m"] * 60
+
+    @property
     def minutes(self) -> int:
         return self.count * _TTL_MINUTES.get(self.unit, 0) if self.count else 0
 

@@ -20,6 +20,10 @@ from seaweedfs_tpu.storage.needle_map import CompactMap
 from seaweedfs_tpu.storage.super_block import SuperBlock
 
 
+class NeedleExpired(KeyError):
+    """TTL needle aged out: reads as absent; compaction reclaims it."""
+
+
 class VolumeReadOnly(IOError):
     pass
 
@@ -193,6 +197,14 @@ class Volume:
             raise IOError(f"needle id mismatch at {offset}: {n.id:x} != {needle_id:x}")
         if cookie is not None and n.cookie != cookie:
             raise PermissionError(f"needle {needle_id:x}: cookie mismatch")
+        # needle-level TTL: on a TTL volume an aged-out needle reads as
+        # absent even before the whole volume is reaped
+        ttl_s = self.super_block.ttl.seconds
+        if ttl_s and n.append_at_ns:
+            import time as _time
+
+            if n.append_at_ns / 1e9 + ttl_s < _time.time():
+                raise NeedleExpired(f"needle {needle_id} expired (ttl)")
         return n
 
     def content_size(self) -> int:
@@ -225,7 +237,11 @@ class Volume:
         try:
             size = os.path.getsize(self.dat_path)
         except OSError:
-            size = 0
+            if not self.tiered:
+                return 0, len(self.nm), 0.0
+            # remote .dat: take the locked path — tiered volumes cannot
+            # compact, so nothing ever holds the lock for minutes
+            size = self.content_size()
         return size, len(self.nm), self._garbage_from(size)
 
     # -- maintenance ---------------------------------------------------------
@@ -235,7 +251,10 @@ class Volume:
         (volume_checking.go analog — here a full sweep of indexed needles)."""
         ok = 0
         for key, stored, size in self.nm.ascending_visit():
-            self.read_needle(key)  # raises on parse/crc error
+            try:
+                self.read_needle(key)  # raises on parse/crc error
+            except NeedleExpired:
+                continue  # aged-out TTL needle: absent, not corrupt
             ok += 1
         return ok
 
@@ -280,7 +299,12 @@ class Volume:
             with open(cpd_dat, "wb") as dat, open(cpd_idx, "wb") as idxf:
                 dat.write(new_sb.to_bytes())
                 for key, stored, size in self.nm.ascending_visit():
-                    n = self.read_needle(key)
+                    try:
+                        n = self.read_needle(key)
+                    except NeedleExpired:
+                        # aged-out TTL needle: dropping it IS the reclaim
+                        self._live_bytes -= types.actual_size(size, self.version)
+                        continue
                     offset = dat.tell()
                     rec = n.to_bytes(self.version)
                     dat.write(rec)

@@ -343,3 +343,47 @@ def test_sorted_file_map_mount_reads_only_tail(tmp_path):
     assert m2.get(n + 1) is None
     assert len(m2) == n
     m2.close()
+
+
+# -- TTL expiry ---------------------------------------------------------------
+
+
+def test_ttl_needle_expires_on_read(tmp_path):
+    import time as _t
+
+    store = Store([str(tmp_path / "ttl")], encoder=ENC)
+    store.load()
+    store.create_volume(9, ttl="1m")
+    # fresh needle reads fine
+    store.write_needle(9, Needle(cookie=1, id=10, data=b"fresh"))
+    assert store.read_needle(9, 10).data == b"fresh"
+    # a needle whose append timestamp is older than the TTL reads as absent
+    old = Needle(cookie=1, id=11, data=b"stale",
+                 append_at_ns=_t.time_ns() - 120 * 10**9)
+    store.write_needle(9, old)
+    with pytest.raises(KeyError, match="expired"):
+        store.read_needle(9, 11)
+    # a non-TTL volume never expires needles
+    store.create_volume(10)
+    store.write_needle(10, Needle(cookie=1, id=12, data=b"x",
+                                  append_at_ns=_t.time_ns() - 10**15))
+    assert store.read_needle(10, 12).data == b"x"
+
+
+def test_ttl_volume_reaped_when_newest_write_ages_out(tmp_path):
+    import time as _t
+
+    store = Store([str(tmp_path / "reap")], encoder=ENC)
+    store.load()
+    v = store.create_volume(20, ttl="1m")
+    store.write_needle(20, Needle(cookie=1, id=1, data=b"doomed"))
+    store.create_volume(21)  # no ttl: must survive
+    store.write_needle(21, Needle(cookie=1, id=2, data=b"keeper"))
+    assert store.reap_expired_volumes() == []  # newest write still fresh
+    # age the TTL volume's last write past 1m
+    past = _t.time() - 120
+    os.utime(v.dat_path, (past, past))
+    assert store.reap_expired_volumes() == [20]
+    assert store.get_volume(20) is None
+    assert not os.path.exists(v.dat_path)
+    assert store.read_needle(21, 2).data == b"keeper"
