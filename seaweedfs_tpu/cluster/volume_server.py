@@ -368,14 +368,19 @@ class VolumeServer:
         """TTL reap under the per-volume maintenance mutex: a volume that
         is frozen (balance/ec.encode in flight) or mid-copy must not have
         its files unlinked underneath the operation — it stays for the
-        next sweep."""
+        next sweep. The expiry re-check happens under the VOLUME lock and
+        flips read_only before any unlink, so a write acked after the
+        sweep's scan either refreshed the mtime (volume survives) or is
+        refused — an acknowledged write is never deleted."""
         for vid in self.store.expired_volume_ids():
             with self.maintenance_lock(vid):
                 vol = self.store.get_volume(vid)
                 if vol is None or vol.read_only:
                     continue  # frozen: an operator operation owns it
-                if vid not in set(self.store.expired_volume_ids()):
-                    continue  # a write landed since the scan
+                with vol._lock:
+                    if not vol.is_expired():
+                        continue  # a write landed since the scan
+                    vol.read_only = True  # fence out further writes
                 self.store.remove_volume(vid)
 
     def maintenance_lock(self, vid: int) -> threading.Lock:

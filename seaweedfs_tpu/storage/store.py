@@ -196,19 +196,26 @@ class Store:
     # -- status / heartbeat --------------------------------------------------
 
     def remove_volume(self, vid: int) -> bool:
-        """Close and unlink a local volume's files."""
-        removed = False
+        """Close and unlink a local volume's files. The store lock covers
+        only the map pop — close() can block behind a minutes-long
+        compaction's volume lock, and holding Store._lock through that
+        would stall create/mount (and with them every Assign-driven grow)
+        cluster-wide."""
+        popped = []
         with self._lock:
             for loc in self.locations:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
-                    v.close()
-                    for ext in (".dat", ".idx", ".sdx", ".sdx.meta"):
-                        p = v.base_path + ext
-                        if os.path.exists(p):
-                            os.remove(p)
-                    removed = True
-        return removed
+                    popped.append(v)
+        for v in popped:
+            v.close()
+            # .tierinfo included: leaving it would resurrect the volume as
+            # a zombie on the next mount (load() discovers via *.tierinfo)
+            for ext in (".dat", ".idx", ".sdx", ".sdx.meta", ".tierinfo"):
+                p = v.base_path + ext
+                if os.path.exists(p):
+                    os.remove(p)
+        return bool(popped)
 
     def expired_volume_ids(self) -> list[int]:
         """TTL volumes whose NEWEST write has aged out (the reference

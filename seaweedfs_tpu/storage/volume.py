@@ -215,6 +215,20 @@ class Volume:
     def needle_count(self) -> int:
         return len(self.nm)
 
+    def is_expired(self) -> bool:
+        """True when this is a TTL volume whose NEWEST write (.dat mtime)
+        has aged out. Callers deciding to DELETE must re-check under
+        self._lock: a write that was acked meanwhile refreshed the mtime."""
+        ttl_s = self.super_block.ttl.seconds
+        if not ttl_s or self.tiered:
+            return False
+        import time as _time
+
+        try:
+            return os.path.getmtime(self.dat_path) + ttl_s < _time.time()
+        except OSError:
+            return False
+
     def garbage_ratio(self) -> float:
         """Fraction of the .dat body that is dead (deleted/overwritten
         records + tombstones) — the auto-vacuum trigger signal."""
