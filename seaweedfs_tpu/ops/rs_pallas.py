@@ -5,11 +5,15 @@ and an int32 accumulator in HBM; this kernel keeps both in VMEM:
 
     per grid step (one batch element x one stripe tile of T bytes):
       load   data tile (C, T) uint8                  HBM -> VMEM
-      unpack bits (C*8, T) int8 via shift/mask       VPU, VMEM-resident
-      matmul acc = B @ bits -> (R*8, T) int32        MXU
+      unpack bits (8*C, T) int8, PLANE-major         VPU (block concat — no
+             (row j*C+ci = bit j of byte-row ci)     per-byte interleave;
+                                                     B's columns are pre-
+                                                     permuted to match)
+      matmul acc = B_pm @ bits -> (R*8, T) int32     MXU
       mod-2  acc & 1
-      pack   out = PACK @ acc -> (R, T) uint8        MXU (packing is linear:
-                                                     PACK[r, r*8+i] = 2^i)
+      pack   out[r] = sum_i acc[r*8+i] << i          VPU (7 shifted ORs —
+                                                     cheaper than a tiny
+                                                     M=R pack-matmul)
     store  out tile (R, T)                           VMEM -> HBM
 
 HBM traffic is exactly C+R bytes/byte-position — the algorithmic minimum —
@@ -35,39 +39,43 @@ from seaweedfs_tpu.ops import gf8
 DEFAULT_TILE = 8192
 
 
-def _kernel(b_ref, pack_ref, data_ref, out_ref):
+def _kernel(b_ref, data_ref, out_ref):
     data = data_ref[0]  # (C, T) uint8
     c, t = data.shape
-    # unrolled bit-plane extraction, widened to int32 (Mosaic has no 8-bit
-    # iota or shifts)
+    # Plane-major bit layout: row j*C + ci = bit j of input byte-row ci.
+    # Concatenating whole (C, T) blocks keeps every plane in its natural
+    # VMEM layout — the earlier byte-major stack(axis=1).reshape forced a
+    # per-byte sublane interleave that Mosaic had to shuffle for. The
+    # lifted matrix's COLUMNS are pre-permuted host-side to match (free).
     wide = data.astype(jnp.int32)
-    planes = [((wide >> j) & 1) for j in range(8)]
-    bits = jnp.stack(planes, axis=1).reshape(c * 8, t).astype(jnp.int8)
+    bits = jnp.concatenate(
+        [((wide >> j) & 1) for j in range(8)], axis=0
+    ).astype(jnp.int8)
     acc = jax.lax.dot_general(
         b_ref[...],
         bits,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
-    acc = (acc & 1).astype(jnp.float32)
-    # pack via a second (tiny, f32) MXU matmul — packing is linear and every
-    # value is an exact small integer, so f32 is exact
-    packed = jax.lax.dot_general(
-        pack_ref[...],
-        acc,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    out_ref[0] = packed.astype(jnp.int32).astype(jnp.uint8)
+    acc = acc & 1  # (R*8, T), rows r*8 + i
+    # pack on the VPU: out[r] = sum_i acc[r*8+i] << i. Leading-dim reshape
+    # regroups rows without touching the minor (lane) dimension; 7 shifted
+    # ORs beat the old tiny f32 pack-matmul (M=R wastes the 128x128 MXU).
+    rows8, _ = acc.shape
+    acc3 = acc.reshape(rows8 // 8, 8, t)
+    out = acc3[:, 0, :]
+    for i in range(1, 8):
+        out = out | (acc3[:, i, :] << i)
+    out_ref[0] = out.astype(jnp.uint8)
 
 
-def _pack_matrix(rows: int) -> np.ndarray:
-    """(R, R*8) int32: PACK[r, r*8+i] = 1 << i (little-endian bit packing)."""
-    p = np.zeros((rows, rows * 8), dtype=np.float32)
-    for r in range(rows):
-        for i in range(8):
-            p[r, r * 8 + i] = 1 << i
-    return p
+def _plane_major_columns(b_bits: np.ndarray) -> np.ndarray:
+    """Permute the lifted matrix's columns from byte-major (ci*8 + j) to
+    plane-major (j*C + ci), matching the kernel's bit layout."""
+    rows8, cols8 = b_bits.shape
+    c = cols8 // 8
+    perm = [(k % c) * 8 + (k // c) for k in range(cols8)]
+    return np.asarray(b_bits)[:, perm]
 
 
 def _on_tpu() -> bool:
@@ -77,22 +85,21 @@ def _on_tpu() -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def _apply_padded(b_bits, pack, data, tile: int, interpret: bool):
+def _apply_padded(b_pm, data, tile: int, interpret: bool):
     batch, c, n = data.shape
-    rows = pack.shape[0]
+    rows = b_pm.shape[0] // 8
     grid = (batch, n // tile)
     return pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((b_bits.shape[0], b_bits.shape[1]), lambda b, i: (0, 0)),
-            pl.BlockSpec((rows, rows * 8), lambda b, i: (0, 0)),
+            pl.BlockSpec((b_pm.shape[0], b_pm.shape[1]), lambda b, i: (0, 0)),
             pl.BlockSpec((1, c, tile), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, rows, tile), lambda b, i: (b, 0, i)),
         out_shape=jax.ShapeDtypeStruct((batch, rows, n), jnp.uint8),
         interpret=interpret,
-    )(b_bits, pack, data)
+    )(b_pm, data)
 
 
 def gf_apply_fused(b_bits: jax.Array, data: jax.Array, tile: int = DEFAULT_TILE) -> jax.Array:
@@ -116,11 +123,50 @@ def gf_apply_fused(b_bits: jax.Array, data: jax.Array, tile: int = DEFAULT_TILE)
     n_pad = _round_up(n, t)
     if n_pad != n:
         data = jnp.pad(data, ((0, 0), (0, 0), (0, n_pad - n)))
-    pack = jnp.asarray(_pack_matrix(rows))
-    out = _apply_padded(b_bits, pack, data, t, not _on_tpu())
+    b_pm = _lifted_plane_major(b_bits)
+    out = _apply_padded(b_pm, data, t, not _on_tpu())
     if n_pad != n:
         out = out[..., :n]
     return out[0] if squeeze else out
+
+
+@functools.lru_cache(maxsize=256)
+def _plane_major_cached(key) -> jax.Array:
+    rows8, cols8, flat = key
+    arr = np.frombuffer(bytes(flat), dtype=np.int8).reshape(rows8, cols8)
+    return jnp.asarray(_plane_major_columns(arr))
+
+
+def plane_major_matrix(m: np.ndarray) -> jax.Array:
+    """Host-side: lifted + column-permuted device matrix for the kernel,
+    cached by matrix value — the hot path (apply_matrix) never round-trips
+    an already-uploaded matrix back through the host."""
+    from seaweedfs_tpu.ops import gf8
+
+    lifted = gf8.gf_matrix_to_bits(np.asarray(m, dtype=np.uint8)).astype(np.int8)
+    return _plane_major_cached((lifted.shape[0], lifted.shape[1], lifted.tobytes()))
+
+
+# id-keyed memo for the b_bits (device array) compat path: np.asarray on a
+# device array is a blocking D2H transfer — ~65 ms through the axon tunnel —
+# so it must happen once per matrix object, not once per call
+_pm_by_id: dict[int, tuple] = {}
+
+
+def _lifted_plane_major(b_bits) -> jax.Array:
+    import weakref
+
+    k = id(b_bits)
+    hit = _pm_by_id.get(k)
+    if hit is not None and hit[0]() is b_bits:
+        return hit[1]
+    a = np.asarray(b_bits, dtype=np.int8)
+    pm = _plane_major_cached((a.shape[0], a.shape[1], a.tobytes()))
+    try:
+        _pm_by_id[k] = (weakref.ref(b_bits), pm)
+    except TypeError:  # non-weakrefable input (plain ndarray): value cache hit anyway
+        pass
+    return pm
 
 
 def _round_up(x: int, m: int) -> int:
@@ -128,7 +174,22 @@ def _round_up(x: int, m: int) -> int:
 
 
 def apply_matrix(m: np.ndarray, shards, tile: int = DEFAULT_TILE) -> jax.Array:
-    """GF(2^8) matrix application via the fused kernel (matrix cached)."""
-    from seaweedfs_tpu.ops import rs_jax
-
-    return gf_apply_fused(rs_jax.lifted_matrix(m), jnp.asarray(shards), tile)
+    """GF(2^8) matrix application via the fused kernel: the hot path —
+    permutes host-side (cached by matrix value), no device round-trip."""
+    data = jnp.asarray(shards)
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    batch, c, n = data.shape
+    rows = int(np.asarray(m).shape[0])
+    if n == 0:
+        out = jnp.zeros((batch, rows, 0), jnp.uint8)
+        return out[0] if squeeze else out
+    t = min(tile, _round_up(max(n, 128), 128))
+    n_pad = _round_up(n, t)
+    if n_pad != n:
+        data = jnp.pad(data, ((0, 0), (0, 0), (0, n_pad - n)))
+    out = _apply_padded(plane_major_matrix(m), data, t, not _on_tpu())
+    if n_pad != n:
+        out = out[..., :n]
+    return out[0] if squeeze else out

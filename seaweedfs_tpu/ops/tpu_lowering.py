@@ -60,13 +60,11 @@ def export_fused_kernel(
         rng = np.random.default_rng(1)
         m = rng.integers(1, 256, size=(rows, cols), dtype=np.uint8)
     b_bits = rs_jax.lifted_matrix(m)
-    pack = jnp.asarray(rs_pallas._pack_matrix(rows))
     n = tile * 2
 
-    fn = lambda b, p, d: rs_pallas._apply_padded(b, p, d, tile, False)  # noqa: E731
+    fn = lambda b, d: rs_pallas._apply_padded(b, d, tile, False)  # noqa: E731
     args = (
         jax.ShapeDtypeStruct(b_bits.shape, jnp.int8),
-        jax.ShapeDtypeStruct(pack.shape, jnp.float32),
         jax.ShapeDtypeStruct((batch, cols, n), jnp.uint8),
     )
     exported = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
