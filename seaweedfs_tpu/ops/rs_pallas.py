@@ -102,6 +102,26 @@ def _apply_padded(b_pm, data, tile: int, interpret: bool):
     )(b_pm, data)
 
 
+def _apply_pm(b_pm: jax.Array, data: jax.Array, tile: int) -> jax.Array:
+    """Shared pad/tile/squeeze plumbing over an already-plane-major matrix."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    batch, c, n = data.shape
+    rows = b_pm.shape[0] // 8
+    if n == 0:
+        out = jnp.zeros((batch, rows, 0), jnp.uint8)
+        return out[0] if squeeze else out
+    t = min(tile, _round_up(max(n, 128), 128))
+    n_pad = _round_up(n, t)
+    if n_pad != n:
+        data = jnp.pad(data, ((0, 0), (0, 0), (0, n_pad - n)))
+    out = _apply_padded(b_pm, data, t, not _on_tpu())
+    if n_pad != n:
+        out = out[..., :n]
+    return out[0] if squeeze else out
+
+
 def gf_apply_fused(b_bits: jax.Array, data: jax.Array, tile: int = DEFAULT_TILE) -> jax.Array:
     """Fused equivalent of rs_jax.gf_apply for TPU.
 
@@ -111,23 +131,7 @@ def gf_apply_fused(b_bits: jax.Array, data: jax.Array, tile: int = DEFAULT_TILE)
     runs in Pallas interpret mode so the exact kernel logic stays testable
     on the CPU mesh.
     """
-    squeeze = data.ndim == 2
-    if squeeze:
-        data = data[None]
-    batch, c, n = data.shape
-    rows = b_bits.shape[0] // 8
-    if n == 0:
-        out = jnp.zeros((batch, rows, 0), jnp.uint8)
-        return out[0] if squeeze else out
-    t = min(tile, _round_up(max(n, 128), 128))
-    n_pad = _round_up(n, t)
-    if n_pad != n:
-        data = jnp.pad(data, ((0, 0), (0, 0), (0, n_pad - n)))
-    b_pm = _lifted_plane_major(b_bits)
-    out = _apply_padded(b_pm, data, t, not _on_tpu())
-    if n_pad != n:
-        out = out[..., :n]
-    return out[0] if squeeze else out
+    return _apply_pm(_lifted_plane_major(b_bits), data, tile)
 
 
 @functools.lru_cache(maxsize=256)
@@ -137,19 +141,28 @@ def _plane_major_cached(key) -> jax.Array:
     return jnp.asarray(_plane_major_columns(arr))
 
 
+@functools.lru_cache(maxsize=256)
+def _lift_pm_cached(key) -> jax.Array:
+    rows, cols, flat = key
+    m = np.frombuffer(bytes(flat), dtype=np.uint8).reshape(rows, cols)
+    lifted = gf8.gf_matrix_to_bits(m).astype(np.int8)
+    return jnp.asarray(_plane_major_columns(lifted))
+
+
 def plane_major_matrix(m: np.ndarray) -> jax.Array:
     """Host-side: lifted + column-permuted device matrix for the kernel,
-    cached by matrix value — the hot path (apply_matrix) never round-trips
-    an already-uploaded matrix back through the host."""
-    from seaweedfs_tpu.ops import gf8
-
-    lifted = gf8.gf_matrix_to_bits(np.asarray(m, dtype=np.uint8)).astype(np.int8)
-    return _plane_major_cached((lifted.shape[0], lifted.shape[1], lifted.tobytes()))
+    cached by GF-matrix value — both the bit-lift (Python GF math) and the
+    permutation happen once per matrix, and the hot path (apply_matrix)
+    never round-trips an already-uploaded matrix through the host."""
+    a = np.asarray(m, dtype=np.uint8)
+    return _lift_pm_cached((a.shape[0], a.shape[1], a.tobytes()))
 
 
 # id-keyed memo for the b_bits (device array) compat path: np.asarray on a
 # device array is a blocking D2H transfer — ~65 ms through the axon tunnel —
-# so it must happen once per matrix object, not once per call
+# so it must happen once per matrix object, not once per call. Entries
+# self-evict when their source array is collected (weakref callback), so
+# the memo cannot pin dead device buffers for the life of the process.
 _pm_by_id: dict[int, tuple] = {}
 
 
@@ -163,7 +176,8 @@ def _lifted_plane_major(b_bits) -> jax.Array:
     a = np.asarray(b_bits, dtype=np.int8)
     pm = _plane_major_cached((a.shape[0], a.shape[1], a.tobytes()))
     try:
-        _pm_by_id[k] = (weakref.ref(b_bits), pm)
+        ref = weakref.ref(b_bits, lambda _r, _k=k: _pm_by_id.pop(_k, None))
+        _pm_by_id[k] = (ref, pm)
     except TypeError:  # non-weakrefable input (plain ndarray): value cache hit anyway
         pass
     return pm
@@ -175,21 +189,5 @@ def _round_up(x: int, m: int) -> int:
 
 def apply_matrix(m: np.ndarray, shards, tile: int = DEFAULT_TILE) -> jax.Array:
     """GF(2^8) matrix application via the fused kernel: the hot path —
-    permutes host-side (cached by matrix value), no device round-trip."""
-    data = jnp.asarray(shards)
-    squeeze = data.ndim == 2
-    if squeeze:
-        data = data[None]
-    batch, c, n = data.shape
-    rows = int(np.asarray(m).shape[0])
-    if n == 0:
-        out = jnp.zeros((batch, rows, 0), jnp.uint8)
-        return out[0] if squeeze else out
-    t = min(tile, _round_up(max(n, 128), 128))
-    n_pad = _round_up(n, t)
-    if n_pad != n:
-        data = jnp.pad(data, ((0, 0), (0, 0), (0, n_pad - n)))
-    out = _apply_padded(plane_major_matrix(m), data, t, not _on_tpu())
-    if n_pad != n:
-        out = out[..., :n]
-    return out[0] if squeeze else out
+    lift + permute host-side once per matrix value, no device round-trip."""
+    return _apply_pm(plane_major_matrix(m), jnp.asarray(shards), tile)

@@ -195,14 +195,17 @@ def _encode_spread_cutover(
 
     _parallel([copy_and_mount(n, sids) for url, sids in alloc.items()
                for n in nodes if n["url"] == url])
-    # 4. source keeps only its allocated shards (delete remounts the rest)
+    # 4. source keeps only its allocated shards (delete remounts the rest).
+    # Single-node clusters keep everything: an empty shard_ids list means
+    # "delete ALL" to the RPC, so it must not be sent at all.
     kept = alloc.get(source["url"], [])
     moved = [s for s in range(TOTAL_SHARDS_COUNT) if s not in kept]
-    env.vs_call(
-        src_addr,
-        "VolumeEcShardsDelete",
-        {"volume_id": vid, "collection": collection, "shard_ids": moved},
-    )
+    if moved:
+        env.vs_call(
+            src_addr,
+            "VolumeEcShardsDelete",
+            {"volume_id": vid, "collection": collection, "shard_ids": moved},
+        )
     if kept:
         env.vs_call(
             src_addr,
