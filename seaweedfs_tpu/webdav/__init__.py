@@ -2,9 +2,11 @@
 net/webdav backed by the filer) [VERIFY: mount empty; SURVEY.md §2.1
 "Gateways" L6 row: "S3 REST, POSIX/FUSE, WebDAV"].
 
-Class-1 WebDAV on the filer namespace: OPTIONS, PROPFIND (Depth 0/1),
-MKCOL, GET/HEAD/PUT/DELETE, MOVE, COPY. Data flows through the filer
-HTTP API; namespace ops over filer RPC.
+Class-2 WebDAV on the filer namespace: OPTIONS, PROPFIND (Depth 0/1),
+MKCOL, GET/HEAD/PUT/DELETE, MOVE, COPY, LOCK/UNLOCK (exclusive depth-0
+write locks with timeout/refresh — what Finder/Windows/Office require
+to mount read-write). Data flows through the filer HTTP API; namespace
+ops over filer RPC.
 """
 
 from seaweedfs_tpu.webdav.server import WebDavServer

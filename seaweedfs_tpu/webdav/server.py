@@ -34,6 +34,11 @@ class WebDavServer:
         self.filer = FilerClient(filer_grpc_address)
         self.root = root.rstrip("/") or ""
         self.host = host
+        # class-2 write locks (RFC 4918 §6): path -> (token, owner, expiry).
+        # Exclusive, depth-0 — the minimum real clients (Finder, Windows,
+        # Office) demand before they will mount read-write.
+        self._locks: dict[str, tuple[str, str, float]] = {}
+        self._locks_mu = threading.Lock()
         self._http = _ThreadingHTTPServer((host, port), _Handler)
         tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
         self._http.dav_server = self
@@ -63,6 +68,46 @@ class WebDavServer:
         p = posixpath.normpath("/" + dav_path.lstrip("/"))
         return (self.root + p) if p != "/" else (self.root or "/")
 
+    # -- lock table -----------------------------------------------------------
+
+    DEFAULT_LOCK_S = 600.0
+    MAX_LOCK_S = 3600.0
+
+    def lock_of(self, path: str):
+        """(token, owner, expiry) or None; expired entries are dropped."""
+        with self._locks_mu:
+            entry = self._locks.get(path)
+            if entry is not None and entry[2] < time.time():
+                del self._locks[path]
+                entry = None
+            return entry
+
+    def acquire_lock(self, path: str, owner: str, seconds: float, token: str = ""):
+        """Grant (or refresh when `token` matches) the exclusive lock.
+        Returns (token, seconds) or None when someone else holds it."""
+        seconds = min(max(seconds, 1.0), self.MAX_LOCK_S)
+        with self._locks_mu:
+            cur = self._locks.get(path)
+            if cur is not None and cur[2] >= time.time() and cur[0] != token:
+                return None
+            if not token or cur is None or cur[0] != token:
+                import uuid
+
+                token = f"opaquelocktoken:{uuid.uuid4()}"
+                owner = owner or (cur[1] if cur else "")
+            else:
+                owner = cur[1]
+            self._locks[path] = (token, owner, time.time() + seconds)
+            return token, seconds
+
+    def release_lock(self, path: str, token: str) -> bool:
+        with self._locks_mu:
+            cur = self._locks.get(path)
+            if cur is None or cur[0] != token:
+                return False
+            del self._locks[path]
+            return True
+
     def filer_url(self, path: str) -> str:
         return f"{tls.scheme()}://{self.filer_http}{urllib.parse.quote(path)}"
 
@@ -88,12 +133,93 @@ class _Handler(httpd.QuietHandler):
 
     # -- methods --------------------------------------------------------------
 
+    # -- locking (RFC 4918 class 2) -------------------------------------------
+
+    def _submitted_token(self) -> str:
+        """Lock token from the If / Lock-Token headers (either form)."""
+        import re as _re
+
+        for h in (self.headers.get("If", ""), self.headers.get("Lock-Token", "")):
+            m = _re.search(r"<(opaquelocktoken:[^>]+)>", h)
+            if m:
+                return m.group(1)
+        return ""
+
+    def _check_lock(self, path: str) -> bool:
+        """True when `path` is writable by this request: unlocked, or the
+        request submitted the lock's token. Replies 423 otherwise."""
+        entry = self.dav.lock_of(path)
+        if entry is None or self._submitted_token() == entry[0]:
+            return True
+        self._reply(423, b"<?xml version=\"1.0\"?><D:error xmlns:D=\"DAV:\"/>")
+        return False
+
+    def _lock_seconds(self) -> float:
+        t = self.headers.get("Timeout", "")
+        for part in t.split(","):
+            part = part.strip()
+            if part.lower().startswith("second-"):
+                try:
+                    return float(part[len("second-"):])
+                except ValueError:
+                    break
+        return self.dav.DEFAULT_LOCK_S
+
+    def do_LOCK(self):
+        path = self.dav.fpath(self._path())
+        body = self.read_body()
+        if body is None and int(self.headers.get("Content-Length", 0) or 0) == 0 \
+                and "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            self.reply_length_required()
+            return
+        body = body or b""
+        owner = ""
+        if body:
+            try:
+                root = ET.fromstring(body)
+                o = root.find(f"{{{_DAV}}}owner")
+                if o is not None:
+                    owner = "".join(o.itertext()).strip()
+            except ET.ParseError:
+                self._reply(400, b"bad lockinfo")
+                return
+        granted = self.dav.acquire_lock(
+            path, owner, self._lock_seconds(),
+            token="" if body else self._submitted_token(),  # empty body = refresh
+        )
+        if granted is None:
+            self._reply(423, b"<?xml version=\"1.0\"?><D:error xmlns:D=\"DAV:\"/>")
+            return
+        token, seconds = granted
+        prop = ET.Element(f"{{{_DAV}}}prop")
+        ld = ET.SubElement(prop, f"{{{_DAV}}}lockdiscovery")
+        al = ET.SubElement(ld, f"{{{_DAV}}}activelock")
+        ET.SubElement(ET.SubElement(al, f"{{{_DAV}}}locktype"), f"{{{_DAV}}}write")
+        ET.SubElement(ET.SubElement(al, f"{{{_DAV}}}lockscope"), f"{{{_DAV}}}exclusive")
+        ET.SubElement(al, f"{{{_DAV}}}depth").text = "0"
+        if owner:
+            ET.SubElement(al, f"{{{_DAV}}}owner").text = owner
+        ET.SubElement(al, f"{{{_DAV}}}timeout").text = f"Second-{int(seconds)}"
+        ET.SubElement(
+            ET.SubElement(al, f"{{{_DAV}}}locktoken"), f"{{{_DAV}}}href"
+        ).text = token
+        out = ET.tostring(prop, xml_declaration=True, encoding="unicode").encode()
+        self._reply(200, out, headers={"Lock-Token": f"<{token}>"})
+
+    def do_UNLOCK(self):
+        path = self.dav.fpath(self._path())
+        if self.dav.release_lock(path, self._submitted_token()):
+            self._reply(204)
+        else:
+            self._reply(409, b"no such lock")
+
     def do_OPTIONS(self):
         self._reply(
             200,
             headers={
                 "DAV": "1,2",
-                "Allow": "OPTIONS, PROPFIND, MKCOL, GET, HEAD, PUT, DELETE, MOVE, COPY",
+                "Allow": "OPTIONS, PROPFIND, MKCOL, GET, HEAD, PUT, DELETE, "
+                         "MOVE, COPY, LOCK, UNLOCK",
                 "MS-Author-Via": "DAV",
             },
         )
@@ -142,6 +268,8 @@ class _Handler(httpd.QuietHandler):
         self._reply(207, body)
 
     def do_MKCOL(self):
+        if not self._check_lock(self.dav.fpath(self._path())):
+            return
         fpath = self.dav.fpath(self._path())
         if self.dav.filer.lookup(fpath) is not None:
             self._reply(405)
@@ -193,6 +321,8 @@ class _Handler(httpd.QuietHandler):
 
     def do_PUT(self):
         fpath = self.dav.fpath(self._path())
+        if not self._check_lock(fpath):
+            return
         body = self.read_body()
         if body is None:
             self.reply_length_required()
@@ -213,10 +343,18 @@ class _Handler(httpd.QuietHandler):
 
     def do_DELETE(self):
         fpath = self.dav.fpath(self._path())
+        if not self._check_lock(fpath):
+            return
         if self.dav.filer.lookup(fpath) is None:
             self._reply(404)
             return
         self.dav.filer.delete(fpath, recursive=True)
+        # RFC 4918: DELETE destroys any lock on the resource — a stale
+        # entry would 423-block whoever creates the path next. The request
+        # already passed _check_lock, so dropping whatever is there is safe.
+        cur = self.dav.lock_of(fpath)
+        if cur is not None:
+            self.dav.release_lock(fpath, cur[0])
         self._reply(204)
 
     def _dest_path(self) -> Optional[str]:
@@ -232,6 +370,10 @@ class _Handler(httpd.QuietHandler):
         if dst is None:
             self._reply(400)
             return
+        if not self._check_lock(src):
+            return
+        if not self._check_lock(dst):
+            return
         if self.dav.filer.lookup(src) is None:
             self._reply(404)
             return
@@ -244,6 +386,12 @@ class _Handler(httpd.QuietHandler):
         except (IsADirectoryError, FileNotFoundError):
             self._reply(412)
             return
+        # locks are URL-scoped and do not travel with the resource: clear
+        # both ends so neither path carries a stale 423
+        for p in (src, dst):
+            cur = self.dav.lock_of(p)
+            if cur is not None:
+                self.dav.release_lock(p, cur[0])
         self._reply(204 if overwrote else 201)
 
     def do_COPY(self):
@@ -251,6 +399,8 @@ class _Handler(httpd.QuietHandler):
         dst = self._dest_path()
         if dst is None:
             self._reply(400)
+            return
+        if not self._check_lock(dst):  # overwriting a locked target
             return
         entry = self.dav.filer.lookup(src)
         if entry is None:

@@ -203,3 +203,136 @@ def test_iam_user_and_key_lifecycle(stack):
     except urllib.error.HTTPError as e:
         code = e.code
     assert code == 403
+
+def test_webdav_class2_locks(stack):
+    """RFC 4918 class-2 exclusive write locks: LOCK grants a token, writes
+    without it 423, writes with it pass, refresh extends, UNLOCK frees."""
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    _req(base, "PUT", "/locked.txt", b"v1")
+
+    lockinfo = (
+        b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+        b"<D:lockscope><D:exclusive/></D:lockscope>"
+        b"<D:locktype><D:write/></D:locktype>"
+        b"<D:owner>alice</D:owner></D:lockinfo>"
+    )
+    code, headers, body = _req(
+        base, "LOCK", "/locked.txt", lockinfo, {"Timeout": "Second-60"}
+    )
+    assert code == 200, body
+    token = headers["Lock-Token"].strip("<>")
+    assert token.startswith("opaquelocktoken:")
+    assert b"lockdiscovery" in body and b"alice" in body
+
+    # second client cannot lock or write
+    code, _, _ = _req(base, "LOCK", "/locked.txt", lockinfo)
+    assert code == 423
+    code, _, _ = _req(base, "PUT", "/locked.txt", b"intruder")
+    assert code == 423
+    code, _, _ = _req(base, "DELETE", "/locked.txt")
+    assert code == 423
+    code, _, _ = _req(
+        base, "MOVE", "/locked.txt", None,
+        {"Destination": f"http://{dav.url}/stolen.txt"},
+    )
+    assert code == 423
+
+    # the holder writes fine with If: (<token>)
+    code, _, _ = _req(
+        base, "PUT", "/locked.txt", b"v2", {"If": f"(<{token}>)"}
+    )
+    assert code == 201
+    code, _, body = _req(base, "GET", "/locked.txt")
+    assert code == 200 and body == b"v2"
+
+    # refresh: LOCK with empty body + the token
+    code, headers, _ = _req(
+        base, "LOCK", "/locked.txt", None,
+        {"If": f"(<{token}>)", "Timeout": "Second-120"},
+    )
+    assert code == 200
+    assert headers["Lock-Token"].strip("<>") == token  # same lock, extended
+
+    # unlock with the wrong token fails; right token frees the resource
+    code, _, _ = _req(
+        base, "UNLOCK", "/locked.txt", None,
+        {"Lock-Token": "<opaquelocktoken:bogus>"},
+    )
+    assert code == 409
+    code, _, _ = _req(
+        base, "UNLOCK", "/locked.txt", None, {"Lock-Token": f"<{token}>"}
+    )
+    assert code == 204
+    code, _, _ = _req(base, "PUT", "/locked.txt", b"free again")
+    assert code == 201
+
+
+def test_webdav_lock_expires(stack):
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    _req(base, "PUT", "/expire.txt", b"x")
+    code, headers, _ = _req(
+        base, "LOCK", "/expire.txt",
+        b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+        b"<D:lockscope><D:exclusive/></D:lockscope>"
+        b"<D:locktype><D:write/></D:locktype></D:lockinfo>",
+        {"Timeout": "Second-1"},
+    )
+    assert code == 200
+    import time as _t
+
+    _t.sleep(1.2)
+    code, _, _ = _req(base, "PUT", "/expire.txt", b"after-expiry")
+    assert code == 201, "expired lock must not block writers"
+
+
+def test_webdav_locks_cleared_by_delete_and_move(stack):
+    """RFC 4918: DELETE destroys the lock; MOVE leaves no stale lock at
+    either path; COPY and MKCOL respect a locked destination."""
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    lockinfo = (
+        b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+        b"<D:lockscope><D:exclusive/></D:lockscope>"
+        b"<D:locktype><D:write/></D:locktype></D:lockinfo>"
+    )
+    # DELETE destroys the lock
+    _req(base, "PUT", "/gone.txt", b"x")
+    code, headers, _ = _req(base, "LOCK", "/gone.txt", lockinfo)
+    token = headers["Lock-Token"].strip("<>")
+    code, _, _ = _req(base, "DELETE", "/gone.txt", None, {"If": f"(<{token}>)"})
+    assert code == 204
+    code, _, _ = _req(base, "PUT", "/gone.txt", b"fresh")  # no stale 423
+    assert code == 201
+
+    # MOVE leaves no stale lock at src
+    _req(base, "PUT", "/mv-src.txt", b"x")
+    code, headers, _ = _req(base, "LOCK", "/mv-src.txt", lockinfo)
+    token = headers["Lock-Token"].strip("<>")
+    code, _, _ = _req(
+        base, "MOVE", "/mv-src.txt", None,
+        {"Destination": f"http://{dav.url}/mv-dst.txt", "If": f"(<{token}>)"},
+    )
+    assert code in (201, 204)
+    code, _, _ = _req(base, "PUT", "/mv-src.txt", b"new tenant")
+    assert code == 201
+    code, _, _ = _req(base, "PUT", "/mv-dst.txt", b"unlocked")
+    assert code == 201
+
+    # COPY over a locked destination 423s; MKCOL at a locked path 423s
+    _req(base, "PUT", "/copy-src.txt", b"src")
+    _req(base, "PUT", "/copy-dst.txt", b"dst")
+    code, headers, _ = _req(base, "LOCK", "/copy-dst.txt", lockinfo)
+    token = headers["Lock-Token"].strip("<>")
+    code, _, _ = _req(
+        base, "COPY", "/copy-src.txt", None,
+        {"Destination": f"http://{dav.url}/copy-dst.txt"},
+    )
+    assert code == 423
+    code, headers2, _ = _req(base, "LOCK", "/newdir", lockinfo)
+    tok2 = headers2["Lock-Token"].strip("<>")
+    code, _, _ = _req(base, "MKCOL", "/newdir")
+    assert code == 423
+    _req(base, "UNLOCK", "/copy-dst.txt", None, {"Lock-Token": f"<{token}>"})
+    _req(base, "UNLOCK", "/newdir", None, {"Lock-Token": f"<{tok2}>"})
