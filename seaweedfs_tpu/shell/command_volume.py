@@ -256,6 +256,54 @@ register(
 )
 
 
+def _move_volume(env: CommandEnv, by_url: dict, holders: list[str],
+                 vid: int, v: dict, src_url: str, dst_url: str) -> None:
+    """Freeze/copy/delete/thaw one volume move — shared by volume.balance
+    and volume.move. Freezing consults the LIVE VolumeStatus (the
+    heartbeat-stale topology flag could let a write land mid-copy and be
+    lost with the source delete); failure paths thaw exactly what was
+    frozen, source included."""
+    status = env.vs_call(grpc_addr(by_url[src_url]), "VolumeStatus", {"volume_id": vid})
+    was_writable = not status.get("read_only", False)
+    frozen: list[str] = []
+    moved = False
+    try:
+        if was_writable:
+            for u in holders:  # inside try: a failed freeze still thaws
+                env.vs_call(grpc_addr(by_url[u]), "VolumeMarkReadonly", {"volume_id": vid})
+                frozen.append(u)
+        env.vs_call(
+            grpc_addr(by_url[dst_url]),
+            "VolumeCopy",
+            {
+                "volume_id": vid,
+                "collection": v.get("collection", ""),
+                "source_data_node": grpc_addr(by_url[src_url]),
+                "read_only": True,
+            },
+        )
+        env.vs_call(grpc_addr(by_url[src_url]), "VolumeDelete", {"volume_id": vid})
+        moved = True
+    finally:
+        if was_writable:
+            # success: thaw survivors + destination (source copy is gone).
+            # Failure: thaw EXACTLY what was frozen, source included — a
+            # failed move must never leave the volume read-only until an
+            # operator notices.
+            thaw = (
+                [u for u in holders if u != src_url] + [dst_url]
+                if moved
+                else frozen
+            )
+            for u in thaw:
+                try:
+                    env.vs_call(
+                        grpc_addr(by_url[u]), "VolumeMarkWritable", {"volume_id": vid}
+                    )
+                except Exception:  # noqa: BLE001 — best-effort thaw
+                    pass
+
+
 def do_volume_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Even volume counts across nodes (command_volume_balance.go analog):
     move whole volumes (VolumeCopy .dat/.idx, then delete the source copy)
@@ -299,59 +347,62 @@ def do_volume_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
             moves += 1
             continue
         holders = [u for u in placement if vid in placement[u]]
-        # live read_only check, not the heartbeat-stale topology flag: a
-        # volume marked writable since the last heartbeat would otherwise
-        # take writes mid-copy and lose them with the source delete
-        status = env.vs_call(
-            grpc_addr(by_url[heaviest]), "VolumeStatus", {"volume_id": vid}
-        )
-        was_writable = not status.get("read_only", False)
-        frozen: list[str] = []
-        moved = False
-        try:
-            if was_writable:
-                for u in holders:  # inside try: a failed freeze still thaws
-                    env.vs_call(
-                        grpc_addr(by_url[u]), "VolumeMarkReadonly", {"volume_id": vid}
-                    )
-                    frozen.append(u)
-            env.vs_call(
-                grpc_addr(by_url[lightest]),
-                "VolumeCopy",
-                {
-                    "volume_id": vid,
-                    "collection": v.get("collection", ""),
-                    "source_data_node": grpc_addr(by_url[heaviest]),
-                    "read_only": True,
-                },
-            )
-            env.vs_call(
-                grpc_addr(by_url[heaviest]), "VolumeDelete", {"volume_id": vid}
-            )
-            moved = True
-        finally:
-            if was_writable:
-                # success: thaw survivors + destination (source copy is
-                # gone). Failure: thaw EXACTLY what was frozen, source
-                # included — a failed move must never leave the volume
-                # read-only until an operator notices.
-                thaw = (
-                    [u for u in holders if u != heaviest] + [lightest]
-                    if moved
-                    else frozen
-                )
-                for u in thaw:
-                    try:
-                        env.vs_call(
-                            grpc_addr(by_url[u]), "VolumeMarkWritable", {"volume_id": vid}
-                        )
-                    except Exception:  # noqa: BLE001 — best-effort thaw
-                        pass
+        _move_volume(env, by_url, holders, vid, v, heaviest, lightest)
         placement[lightest][vid] = v
         del placement[heaviest][vid]
         w.write(f"volume.balance: moved {vid} {heaviest} -> {lightest}\n")
         moves += 1
     w.write(f"volume.balance: {moves} moves\n")
+
+
+def do_volume_move(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Move one volume to a named node (command_volume_move.go analog):
+    the targeted form of volume.balance's move, same freeze/copy/delete/
+    thaw discipline."""
+    fl = parse_flags(args, volumeId=0, target="")
+    env.confirm_locked()
+    if not fl.volumeId or not fl.target:
+        raise ShellError("volume.move needs -volumeId and -target <url>")
+    nodes = env.topology_nodes()
+    by_url = {n["url"]: n for n in nodes}
+    dst = by_url.get(fl.target)
+    if dst is None:
+        raise ShellError(f"unknown node {fl.target!r} ({sorted(by_url)})")
+    src = next(
+        (
+            n
+            for n in nodes
+            if any(int(v["id"]) == fl.volumeId for v in n.get("volumes", []))
+        ),
+        None,
+    )
+    if src is None:
+        raise ShellError(f"volume {fl.volumeId} not found on any node")
+    if src["url"] == fl.target:
+        w.write(f"volume.move: {fl.volumeId} already on {fl.target}\n")
+        return
+    if any(int(v["id"]) == fl.volumeId for v in dst.get("volumes", [])):
+        raise ShellError(f"node {fl.target} already holds a replica of {fl.volumeId}")
+    v = next(v for v in src["volumes"] if int(v["id"]) == fl.volumeId)
+    if v.get("disk_type") == "remote":
+        raise ShellError(f"volume {fl.volumeId} is tiered — no local .dat to move")
+    holders = [
+        n["url"]
+        for n in nodes
+        if any(int(x["id"]) == fl.volumeId for x in n.get("volumes", []))
+    ]
+    _move_volume(env, by_url, holders, fl.volumeId, v, src["url"], fl.target)
+    w.write(f"volume.move: {fl.volumeId} {src['url']} -> {fl.target}\n")
+
+
+register(
+    ShellCommand(
+        "volume.move",
+        "volume.move -volumeId <id> -target <url>\n\tmove a volume to a "
+        "specific node",
+        do_volume_move,
+    )
+)
 
 
 register(

@@ -82,11 +82,39 @@ class WebDavServer:
                 entry = None
             return entry
 
+    def lock_under(self, path: str):
+        """Any live lock AT or UNDER `path` (collection ops must honor
+        child locks). Returns (locked_path, token) or None."""
+        prefix = path.rstrip("/") + "/"
+        now = time.time()
+        with self._locks_mu:
+            for p, (tok, _owner, exp) in list(self._locks.items()):
+                if exp < now:
+                    del self._locks[p]
+                    continue
+                if p == path or p.startswith(prefix):
+                    return p, tok
+            return None
+
+    def clear_under(self, path: str) -> None:
+        """Drop every lock entry at/under `path` (the resources are gone —
+        stale entries would 423-block whoever recreates the paths)."""
+        prefix = path.rstrip("/") + "/"
+        with self._locks_mu:
+            for p in list(self._locks):
+                if p == path or p.startswith(prefix):
+                    del self._locks[p]
+
     def acquire_lock(self, path: str, owner: str, seconds: float, token: str = ""):
         """Grant (or refresh when `token` matches) the exclusive lock.
         Returns (token, seconds) or None when someone else holds it."""
         seconds = min(max(seconds, 1.0), self.MAX_LOCK_S)
+        now = time.time()
         with self._locks_mu:
+            # opportunistic sweep: expired entries must not accumulate for
+            # the life of the gateway (clients lock every file they write)
+            for p in [p for p, e in self._locks.items() if e[2] < now]:
+                del self._locks[p]
             cur = self._locks.get(path)
             if cur is not None and cur[2] >= time.time() and cur[0] != token:
                 return None
@@ -146,10 +174,11 @@ class _Handler(httpd.QuietHandler):
         return ""
 
     def _check_lock(self, path: str) -> bool:
-        """True when `path` is writable by this request: unlocked, or the
-        request submitted the lock's token. Replies 423 otherwise."""
-        entry = self.dav.lock_of(path)
-        if entry is None or self._submitted_token() == entry[0]:
+        """True when `path` (INCLUDING any child of a collection) is
+        writable by this request: unlocked, or the request submitted the
+        covering lock's token. Replies 423 otherwise."""
+        hit = self.dav.lock_under(path)
+        if hit is None or self._submitted_token() == hit[1]:
             return True
         self._reply(423, b"<?xml version=\"1.0\"?><D:error xmlns:D=\"DAV:\"/>")
         return False
@@ -168,11 +197,9 @@ class _Handler(httpd.QuietHandler):
     def do_LOCK(self):
         path = self.dav.fpath(self._path())
         body = self.read_body()
-        if body is None and int(self.headers.get("Content-Length", 0) or 0) == 0 \
-                and "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+        if body is None:  # chunked encoding: unread bytes would desync keep-alive
             self.reply_length_required()
             return
-        body = body or b""
         owner = ""
         if body:
             try:
@@ -349,12 +376,10 @@ class _Handler(httpd.QuietHandler):
             self._reply(404)
             return
         self.dav.filer.delete(fpath, recursive=True)
-        # RFC 4918: DELETE destroys any lock on the resource — a stale
-        # entry would 423-block whoever creates the path next. The request
-        # already passed _check_lock, so dropping whatever is there is safe.
-        cur = self.dav.lock_of(fpath)
-        if cur is not None:
-            self.dav.release_lock(fpath, cur[0])
+        # RFC 4918: DELETE destroys the locks of everything it removed —
+        # stale entries would 423-block whoever recreates the paths. The
+        # request already passed _check_lock, so dropping them is safe.
+        self.dav.clear_under(fpath)
         self._reply(204)
 
     def _dest_path(self) -> Optional[str]:
@@ -387,11 +412,9 @@ class _Handler(httpd.QuietHandler):
             self._reply(412)
             return
         # locks are URL-scoped and do not travel with the resource: clear
-        # both ends so neither path carries a stale 423
-        for p in (src, dst):
-            cur = self.dav.lock_of(p)
-            if cur is not None:
-                self.dav.release_lock(p, cur[0])
+        # both subtrees so no path carries a stale 423
+        self.dav.clear_under(src)
+        self.dav.clear_under(dst)
         self._reply(204 if overwrote else 201)
 
     def do_COPY(self):

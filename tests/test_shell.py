@@ -413,3 +413,32 @@ def test_volume_balance_moves_volumes(cluster):
     assert max(counts.values()) - min(counts.values()) <= 1, (counts_before, counts)
     for fid, payload in fids:
         assert client.read(fid) == payload, f"{fid} unreadable after balance"
+
+
+def test_volume_move_to_named_node(cluster):
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=8, size=800)
+    vid = int(fids[0][0].split(",", 1)[0])
+    run(env, "lock")
+    src = next(s for s in servers if s.store.get_volume(vid) is not None)
+    dst = next(
+        s for s in servers
+        if s.store.get_volume(vid) is None and s.url != src.url
+    )
+    out = run(env, f"volume.move -volumeId {vid} -target {dst.url}")
+    assert f"-> {dst.url}" in out
+    assert dst.store.get_volume(vid) is not None
+    assert src.store.get_volume(vid) is None
+    for fid, payload in fids:
+        assert client.read(fid) == payload, f"{fid} unreadable after move"
+    # moved volume accepts writes again (thawed on the destination)
+    import os as _os
+
+    res = client.submit(_os.urandom(500))
+    assert client.read(res.fid)
+    # moving again to the same node is a no-op
+    out = run(env, f"volume.move -volumeId {vid} -target {dst.url}")
+    assert "already on" in out
+    # unknown target is refused
+    with pytest.raises(ShellError, match="unknown node"):
+        run(env, f"volume.move -volumeId {vid} -target 127.0.0.1:1")

@@ -336,3 +336,32 @@ def test_webdav_locks_cleared_by_delete_and_move(stack):
     assert code == 423
     _req(base, "UNLOCK", "/copy-dst.txt", None, {"Lock-Token": f"<{token}>"})
     _req(base, "UNLOCK", "/newdir", None, {"Lock-Token": f"<{tok2}>"})
+
+
+def test_webdav_collection_ops_honor_child_locks(stack):
+    """DELETE/MOVE of a directory must 423 while a child is locked by
+    someone else, and a completed delete clears the subtree's locks."""
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    lockinfo = (
+        b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+        b"<D:lockscope><D:exclusive/></D:lockscope>"
+        b"<D:locktype><D:write/></D:locktype></D:lockinfo>"
+    )
+    _req(base, "MKCOL", "/tree")
+    _req(base, "PUT", "/tree/child.txt", b"x")
+    code, headers, _ = _req(base, "LOCK", "/tree/child.txt", lockinfo)
+    token = headers["Lock-Token"].strip("<>")
+    # tokenless collection delete/move is refused while the child is locked
+    code, _, _ = _req(base, "DELETE", "/tree")
+    assert code == 423
+    code, _, _ = _req(
+        base, "MOVE", "/tree", None, {"Destination": f"http://{dav.url}/tree2"}
+    )
+    assert code == 423
+    # the lock holder may delete the whole tree; child locks die with it
+    code, _, _ = _req(base, "DELETE", "/tree", None, {"If": f"(<{token}>)"})
+    assert code == 204
+    _req(base, "MKCOL", "/tree")
+    code, _, _ = _req(base, "PUT", "/tree/child.txt", b"fresh")  # no stale 423
+    assert code == 201
