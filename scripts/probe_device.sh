@@ -1,0 +1,23 @@
+#!/bin/sh
+# Probe the axon TPU tunnel in a throwaway child (90s cap) and append the
+# result to PROBES_r04.jsonl. Never SIGTERMs a dispatch mid-flight: the probe
+# child only calls jax.devices(), which is safe to kill.
+cd /root/repo
+python - <<'PY'
+import json, subprocess, time, datetime
+t0 = time.time()
+try:
+    r = subprocess.run(
+        ["python", "-c", "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=90,
+    )
+    ok = r.returncode == 0 and "tpu" in r.stdout
+    err = "" if ok else (r.stderr[-200:] or r.stdout[-200:])
+except subprocess.TimeoutExpired:
+    ok, err = False, "timeout after 90s"
+rec = {"when": "round-4-loop", "ts": datetime.datetime.now(datetime.UTC).strftime("%Y-%m-%dT%H:%MZ"),
+       "method": "subprocess jax.devices(), 90s cap", "ok": ok, "dt_s": round(time.time()-t0, 1)}
+if err: rec["error"] = err
+with open("PROBES_r04.jsonl", "a") as f: f.write(json.dumps(rec) + "\n")
+print("probe ok" if ok else f"probe failed: {err}")
+PY

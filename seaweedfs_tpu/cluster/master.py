@@ -272,7 +272,28 @@ class MasterServer:
         svc.add("ReleaseAdminToken", self._rpc_release_admin_token)
         svc.add("FilerHeartbeat", self._rpc_filer_heartbeat)
         svc.add("ListClusterNodes", self._rpc_list_cluster_nodes)
+        svc.add("RaftListClusterServers", self._rpc_raft_status)
         return svc
+
+    def _rpc_raft_status(self, req: dict, ctx) -> dict:
+        """Raft membership/status for cluster.raft.ps (RaftListClusterServers
+        analog): which masters exist, who leads, at what term."""
+        r = self.raft
+        if r is None:
+            return {
+                "enabled": False,
+                "leader": self.address,
+                "state": "leader",
+                "term": 0,
+                "servers": [self.address],
+            }
+        return {
+            "enabled": True,
+            "leader": self._leader_address(),
+            "state": r.state,
+            "term": r.term,
+            "servers": sorted([r.me, *r.peers]),
+        }
 
     # -- filer registry (cluster node list, master_grpc_server_cluster.go
     # analog: filers announce themselves so shells/mounts can discover

@@ -127,12 +127,19 @@ class VolumeServer:
         self.heartbeat_once()
         self._hb_thread.start()
 
-    def stop(self) -> None:
+    def _leave_cluster(self) -> None:
+        """Stop heartbeating and depart the master topology (shared by
+        stop() and the VolumeServerLeave RPC). Setting _stop first also
+        gates heartbeat_once(): an admin RPC landing after leave must not
+        re-register the drained node."""
         self._stop.set()
         try:
             self._masters_fanout("LeaveCluster", {"url": self.url}, timeout=2)
         except Exception:  # noqa: BLE001 — masters may already be gone
             pass
+
+    def stop(self) -> None:
+        self._leave_cluster()
         self._http.shutdown()
         self._http.server_close()
         self._grpc.stop()
@@ -192,6 +199,8 @@ class VolumeServer:
         return ok[0]
 
     def heartbeat_once(self) -> None:
+        if self._stop.is_set():  # left the cluster: never re-register
+            return
         self._masters_fanout("Heartbeat", self._make_heartbeat().to_dict(), timeout=10)
 
     def _master_query(self, method: str, req: dict, timeout: float = 5.0) -> dict:
@@ -332,6 +341,10 @@ class VolumeServer:
         add("VolumeEcShardsDelete", self._rpc_ec_delete)
         add("VolumeTierMove", self._rpc_tier_move)
         add("VolumeTierFetch", self._rpc_tier_fetch)
+        add("VolumeConfigure", self._rpc_volume_configure)
+        add("VolumeNeedleIds", self._rpc_needle_ids)
+        add("ReadNeedle", self._rpc_read_needle)
+        add("VolumeServerLeave", self._rpc_server_leave)
         return svc
 
     # volume admin
@@ -542,6 +555,58 @@ class VolumeServer:
         fid = FileId.parse(req["fid"])
         found = self.store.delete_needle(fid.volume_id, fid.key)
         return {"found": bool(found)}
+
+    def _rpc_read_needle(self, req: dict, ctx) -> dict:
+        """Read one needle by id (no cookie check) — serves volume.check.disk's
+        replica sync, where the repairer must copy the needle verbatim
+        (cookie included) from the replica that has it."""
+        import base64
+
+        try:
+            n = self.store.read_needle(int(req["volume_id"]), int(req["needle_id"]))
+        except KeyError as e:  # volume or needle gone (racing delete): typed fault
+            raise rpc.NotFoundFault(str(e)) from e
+        return {
+            "cookie": n.cookie,
+            "data": base64.b64encode(n.data).decode(),
+            "name": (n.name or b"").decode("latin1"),
+            "mime": (n.mime or b"").decode("latin1"),
+        }
+
+    def _rpc_volume_configure(self, req: dict, ctx) -> dict:
+        """Change a volume's replica placement in its superblock
+        (volume.configure.replication analog)."""
+        v = self.store.get_volume(int(req["volume_id"]))
+        if v is None:
+            raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
+        v.configure_replication(req["replication"])
+        self.heartbeat_once()  # the topology keys layouts by (coll, rp, ttl)
+        return {"replication": str(v.super_block.replica_placement)}
+
+    def _rpc_needle_ids(self, req: dict, ctx) -> dict:
+        """Page through a volume's LIVE needle ids (id, size ascending by id)
+        — volume.check.disk diffs these across replicas. The first page also
+        carries the volume's tombstoned ids (from the .idx history) so the
+        repairer can tell "replica missed the write" from "replica processed
+        the delete" and propagate the delete instead of resurrecting."""
+        v = self.store.get_volume(int(req["volume_id"]))
+        if v is None:
+            raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
+        limit = min(int(req.get("limit", 65536)), 65536)
+        if req.get("tombstones"):  # tombstone-history page, same resume protocol
+            rows, truncated = v.tombstone_history(
+                int(req.get("deleted_start_from", 0)), limit
+            )
+            return {"deleted": rows, "deleted_truncated": truncated}
+        entries, truncated = v.needle_entries_page(int(req.get("start_from", 0)), limit)
+        return {"entries": entries, "truncated": truncated}
+
+    def _rpc_server_leave(self, req: dict, ctx) -> dict:
+        """Stop heartbeating and depart the master's topology
+        (volumeServer.leave analog). The process keeps serving reads so an
+        operator can drain it; a later stop() is a no-op for the heartbeat."""
+        self._leave_cluster()
+        return {"left": True}
 
     # EC surface (SURVEY.md §2.4)
 

@@ -73,3 +73,58 @@ register(
         do_cluster_check,
     )
 )
+
+
+def do_cluster_raft_ps(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Show raft membership and leadership (cluster.raft.ps analog)."""
+    st = env.master_call("RaftListClusterServers", {})
+    if not st.get("enabled"):
+        w.write(
+            f"raft disabled (single master {st.get('leader')}); "
+            "term 0, state leader\n"
+        )
+        return
+    w.write(
+        f"leader: {st.get('leader')}  term: {st.get('term')}  "
+        f"(answered by {env.master_address}, state {st.get('state')})\n"
+    )
+    for s in st.get("servers", []):
+        mark = "*" if s == st.get("leader") else " "
+        w.write(f"  {mark} {s}\n")
+
+
+register(
+    ShellCommand(
+        "cluster.raft.ps",
+        "cluster.raft.ps\n\tshow raft master membership, leader, and term",
+        do_cluster_raft_ps,
+    )
+)
+
+
+def do_cluster_ps(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """List cluster processes known to the master: masters, volume
+    servers, and announced filers (cluster.ps analog)."""
+    st = env.master_call("RaftListClusterServers", {})
+    for s in st.get("servers", []):
+        mark = "*" if s == st.get("leader") else " "
+        w.write(f"master {mark} {s}\n")
+    for n in env.topology_nodes():
+        w.write(
+            f"volume server {n['url']} (grpc :{n['grpc_port']}) "
+            f"dc={n.get('data_center')} rack={n.get('rack')} "
+            f"volumes={len(n.get('volumes', []))} "
+            f"ec={len(n.get('ec_shards', []))}\n"
+        )
+    filers = env.master_call("ListClusterNodes", {}).get("filers", [])
+    for f in filers:
+        w.write(f"filer {f.get('http_address')} (grpc {f.get('grpc_address')})\n")
+
+
+register(
+    ShellCommand(
+        "cluster.ps",
+        "cluster.ps\n\tlist masters, volume servers, and filers in the cluster",
+        do_cluster_ps,
+    )
+)

@@ -232,3 +232,40 @@ register(
         do_fs_meta_load,
     )
 )
+
+
+def do_fs_tree(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Recursive tree view of the namespace (command_fs_tree.go analog)."""
+    paths = _positional(args) or ["/"]
+    fc = env.filer_client()
+    dirs = files = 0
+
+    def walk(path: str, indent: str) -> None:
+        nonlocal dirs, files
+        start = ""
+        while True:
+            batch = fc.list(path, start_from=start, limit=1024)
+            if not batch:
+                break
+            for e in batch:
+                w.write(f"{indent}{e.name}{'/' if e.is_directory else ''}\n")
+                if e.is_directory:
+                    dirs += 1
+                    walk(e.path, indent + "  ")
+                else:
+                    files += 1
+            start = batch[-1].name
+
+    for p in paths:
+        w.write(p + "\n")
+        walk(p, "  ")
+    w.write(f"{dirs} directories, {files} files\n")
+
+
+register(
+    ShellCommand(
+        "fs.tree",
+        "fs.tree [path ...]\n\trecursively print the namespace tree",
+        do_fs_tree,
+    )
+)

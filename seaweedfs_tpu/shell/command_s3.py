@@ -1,0 +1,94 @@
+"""S3 bucket shell commands — s3.bucket.list / create / delete, mirroring
+weed/shell/command_s3_bucket_*.go [VERIFY: mount empty; SURVEY.md §2.1
+"Shell (ops)" row]. Buckets are filer directories under /buckets (the same
+layout the S3 gateway serves), so these commands work through the filer
+discovered via the master's cluster-node list.
+"""
+
+from __future__ import annotations
+
+from typing import TextIO
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.shell import CommandEnv, ShellCommand, ShellError, parse_flags, register
+
+BUCKETS_ROOT = "/buckets"
+
+
+def _valid_bucket(name: str) -> bool:
+    return (
+        bool(name)
+        and "/" not in name
+        and not name.startswith(".")
+        and name not in (".", "..")
+    )
+
+
+def do_s3_bucket_list(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fc = env.filer_client()
+    start = ""
+    count = 0
+    while True:
+        batch = fc.list(BUCKETS_ROOT, start_from=start, limit=1024)
+        if not batch:
+            break
+        for e in batch:
+            if e.is_directory and not e.name.startswith("."):
+                w.write(f"{e.name}\n")
+                count += 1
+        start = batch[-1].name
+    w.write(f"total {count} buckets\n")
+
+
+register(
+    ShellCommand(
+        "s3.bucket.list",
+        "s3.bucket.list\n\tlist S3 buckets (filer directories under /buckets)",
+        do_s3_bucket_list,
+    )
+)
+
+
+def do_s3_bucket_create(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, name="")
+    if not _valid_bucket(fl.name):
+        raise ShellError("s3.bucket.create -name <bucket>")
+    fc = env.filer_client()
+    path = f"{BUCKETS_ROOT}/{fl.name}"
+    if fc.lookup(path) is not None:
+        raise ShellError(f"bucket {fl.name!r} already exists")
+    fc.create(Entry(path=path, is_directory=True))
+    w.write(f"created bucket {fl.name}\n")
+
+
+register(
+    ShellCommand(
+        "s3.bucket.create",
+        "s3.bucket.create -name <bucket>\n\tcreate an S3 bucket",
+        do_s3_bucket_create,
+    )
+)
+
+
+def do_s3_bucket_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    fl = parse_flags(args, name="", force=False)
+    if not _valid_bucket(fl.name):
+        raise ShellError("s3.bucket.delete -name <bucket> [-force]")
+    fc = env.filer_client()
+    path = f"{BUCKETS_ROOT}/{fl.name}"
+    if fc.lookup(path) is None:
+        raise ShellError(f"bucket {fl.name!r} not found")
+    if not fl.force and fc.list(path, limit=1):
+        raise ShellError(f"bucket {fl.name!r} is not empty; use -force")
+    fc.delete(path, recursive=True)
+    w.write(f"deleted bucket {fl.name}\n")
+
+
+register(
+    ShellCommand(
+        "s3.bucket.delete",
+        "s3.bucket.delete -name <bucket> [-force]\n\tdelete an S3 bucket "
+        "(-force removes a non-empty bucket)",
+        do_s3_bucket_delete,
+    )
+)

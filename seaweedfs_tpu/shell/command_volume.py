@@ -520,3 +520,473 @@ register(
         do_volume_tier_fetch,
     )
 )
+
+
+def do_collection_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Delete every volume and EC volume of a collection
+    (command_collection_delete.go analog). Requires -force to actually
+    destroy data."""
+    fl = parse_flags(args, collection="", force=False)
+    env.confirm_locked()
+    if not fl.collection:
+        raise ShellError("collection.delete -collection <name> -force")
+    from seaweedfs_tpu.shell.command_ec import _ec_collections
+
+    nodes = env.topology_nodes()
+    colls = _ec_collections(env)
+    victims_normal: list[tuple[dict, int]] = []
+    victims_ec: list[tuple[dict, int]] = []
+    for n in nodes:
+        for v in n.get("volumes", []):
+            if v.get("collection", "") == fl.collection:
+                victims_normal.append((n, int(v["id"])))
+        for e in n.get("ec_shards", []):
+            if colls.get(int(e["volume_id"]), "") == fl.collection:
+                victims_ec.append((n, int(e["volume_id"])))
+    if not victims_normal and not victims_ec:
+        w.write(f"collection.delete: no volumes in {fl.collection!r}\n")
+        return
+    if not fl.force:
+        w.write(
+            f"collection.delete (dry): would delete {len(victims_normal)} volume "
+            f"replicas and {len(victims_ec)} EC shard sets in {fl.collection!r}; "
+            "re-run with -force\n"
+        )
+        return
+    for n, vid in victims_normal:
+        env.vs_call(grpc_addr(n), "VolumeDelete", {"volume_id": vid})
+    for n, vid in victims_ec:
+        env.vs_call(
+            grpc_addr(n),
+            "VolumeEcShardsDelete",
+            {"volume_id": vid, "collection": fl.collection, "shard_ids": []},
+        )
+    w.write(
+        f"collection.delete {fl.collection!r}: removed {len(victims_normal)} volume "
+        f"replicas, {len(victims_ec)} EC shard sets\n"
+    )
+
+
+register(
+    ShellCommand(
+        "collection.delete",
+        "collection.delete -collection <name> -force\n\tdelete every volume of a collection",
+        do_collection_delete,
+    )
+)
+
+
+def do_volume_configure_replication(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Rewrite a volume's replica placement on every holder
+    (command_volume_configure_replication.go analog)."""
+    fl = parse_flags(args, volumeId=0, collection="", replication="")
+    env.confirm_locked()
+    if not fl.replication or (not fl.volumeId and not fl.collection):
+        raise ShellError(
+            "volume.configure.replication (-volumeId <id> | -collection <c>) "
+            "-replication xyz"
+        )
+    ReplicaPlacement.parse(fl.replication)  # validate before touching disks
+    changed = 0
+    for n in env.topology_nodes():
+        for v in n.get("volumes", []):
+            vid = int(v["id"])
+            if fl.volumeId and vid != fl.volumeId:
+                continue
+            if fl.collection and v.get("collection", "") != fl.collection:
+                continue
+            env.vs_call(
+                grpc_addr(n),
+                "VolumeConfigure",
+                {"volume_id": vid, "replication": fl.replication},
+            )
+            w.write(f"volume {vid} on {n['url']}: replication -> {fl.replication}\n")
+            changed += 1
+    if not changed:
+        raise ShellError("volume.configure.replication: no matching volumes")
+
+
+register(
+    ShellCommand(
+        "volume.configure.replication",
+        "volume.configure.replication (-volumeId <id> | -collection <c>) -replication xyz\n"
+        "\tchange replica placement in the volume superblock on every holder",
+        do_volume_configure_replication,
+    )
+)
+
+
+def do_volume_delete_empty(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Delete volumes holding no live needles (command_volume_delete_empty.go
+    analog). -force applies; default is a dry run."""
+    fl = parse_flags(args, force=False)
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    seen: set[int] = set()
+    deleted = 0
+    for n in nodes:
+        for v in n.get("volumes", []):
+            vid = int(v["id"])
+            if vid in seen:
+                continue
+            seen.add(vid)
+            live = int(v.get("file_count", 0)) - int(v.get("delete_count", 0))
+            if live > 0:
+                continue
+            holders = [
+                m
+                for m in nodes
+                if any(int(x["id"]) == vid for x in m.get("volumes", []))
+            ]
+            if not fl.force:
+                w.write(f"volume.deleteEmpty (dry): volume {vid} is empty "
+                        f"on {[h['url'] for h in holders]}\n")
+                continue
+            # the topology counts are heartbeat-stale: freeze every holder
+            # (recording the LIVE read_only state, as _move_volume does),
+            # then re-check LIVE emptiness — a write acked since the last
+            # beat must abort the delete, not be destroyed with the volume
+            frozen: list[dict] = []  # holders WE froze (live status said writable)
+            still_empty = True
+            try:
+                for h in holders:
+                    st = env.vs_call(grpc_addr(h), "VolumeStatus", {"volume_id": vid})
+                    if int(st.get("file_count", 0)) > 0:
+                        still_empty = False
+                        break
+                    if not st.get("read_only", False):
+                        env.vs_call(grpc_addr(h), "VolumeMarkReadonly", {"volume_id": vid})
+                        frozen.append(h)
+                if still_empty:
+                    # re-check after the freeze closed the write window
+                    for h in holders:
+                        st = env.vs_call(grpc_addr(h), "VolumeStatus", {"volume_id": vid})
+                        if int(st.get("file_count", 0)) > 0:
+                            still_empty = False
+                            break
+            except Exception:  # noqa: BLE001 — unreachable holder: keep the volume
+                still_empty = False
+            if not still_empty:
+                for h in frozen:  # thaw exactly what we froze, nothing else
+                    try:
+                        env.vs_call(grpc_addr(h), "VolumeMarkWritable", {"volume_id": vid})
+                    except Exception:  # noqa: BLE001 — best-effort thaw
+                        pass
+                w.write(f"volume.deleteEmpty: {vid} no longer empty, skipped\n")
+                continue
+            removed: list[dict] = []
+            try:
+                for h in holders:
+                    env.vs_call(grpc_addr(h), "VolumeDelete", {"volume_id": vid})
+                    removed.append(h)
+            except Exception as e:  # noqa: BLE001 — partial delete: thaw survivors
+                survivors = [h for h in frozen if h not in removed]
+                for h in survivors:
+                    try:
+                        env.vs_call(grpc_addr(h), "VolumeMarkWritable", {"volume_id": vid})
+                    except Exception:  # noqa: BLE001 — best-effort thaw
+                        pass
+                w.write(
+                    f"volume.deleteEmpty: {vid} partially removed "
+                    f"({len(removed)}/{len(holders)}), survivors thawed: {e}\n"
+                )
+                continue
+            w.write(f"volume.deleteEmpty: removed {vid} from {len(holders)} nodes\n")
+            deleted += 1
+    w.write(f"volume.deleteEmpty: {deleted} volumes removed\n")
+
+
+register(
+    ShellCommand(
+        "volume.deleteEmpty",
+        "volume.deleteEmpty [-force]\n\tdelete volumes with zero live files from all replicas",
+        do_volume_delete_empty,
+    )
+)
+
+
+def _needle_ids_of(env: CommandEnv, node: dict, vid: int) -> tuple[dict[int, int], dict[int, int]]:
+    """(live id -> size, tombstone-history id -> final_dead) of one replica,
+    both fully paged — a dropped tombstone page would misread 'processed
+    the delete' as 'missed the write' and resurrect deleted data."""
+    out: dict[int, int] = {}
+    start = 0
+    while True:
+        resp = env.vs_call(
+            grpc_addr(node),
+            "VolumeNeedleIds",
+            {"volume_id": vid, "start_from": start, "limit": 65536},
+        )
+        for key, size in resp.get("entries", []):
+            out[int(key)] = int(size)
+        if not resp.get("truncated"):
+            break
+        start = max(out) + 1
+    tombs: dict[int, int] = {}
+    start = 0
+    while True:
+        resp = env.vs_call(
+            grpc_addr(node),
+            "VolumeNeedleIds",
+            {"volume_id": vid, "tombstones": True, "deleted_start_from": start,
+             "limit": 65536},
+        )
+        page = [(int(k), int(d)) for k, d in resp.get("deleted", [])]
+        tombs.update(page)
+        if not resp.get("deleted_truncated") or not page:
+            return out, tombs
+        start = max(k for k, _ in page) + 1
+
+
+def do_volume_check_disk(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Compare the replicas of each volume needle-by-needle and copy
+    missing needles from the replica that has them
+    (command_volume_check_disk.go analog). -fix applies repairs."""
+    fl = parse_flags(args, volumeId=0, fix=False)
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    seen: set[int] = set()
+    synced = mismatched = 0
+    for n in nodes:
+        for v in n.get("volumes", []):
+            vid = int(v["id"])
+            if vid in seen or (fl.volumeId and vid != fl.volumeId):
+                continue
+            seen.add(vid)
+            holders = [
+                m
+                for m in nodes
+                if any(int(x["id"]) == vid for x in m.get("volumes", []))
+            ]
+            if len(holders) < 2:
+                continue
+            state = {h["url"]: _needle_ids_of(env, h, vid) for h in holders}
+            live = {u: s[0] for u, s in state.items()}
+            tombs = {u: s[1] for u, s in state.items()}
+            union: set[int] = set()
+            for m in live.values():
+                union |= set(m)
+            # A FINAL tombstone anywhere means the needle was deleted — the
+            # replica still serving it missed the delete, so propagate the
+            # delete rather than resurrecting from the lagging replica.
+            # EXCEPT when some live holder's own history shows a tombstone
+            # followed by a re-write (final state live): that write postdates
+            # the delete, so the write wins and is copied out instead.
+            final_dead = {
+                nid
+                for t in tombs.values()
+                for nid, dead in t.items()
+                if dead
+            }
+            rewritten = {
+                nid
+                for u, t in tombs.items()
+                for nid, dead in t.items()
+                if not dead and nid in live[u]
+            }
+            delete_these = (union & final_dead) - rewritten
+            by_url = {h["url"]: h for h in holders}
+            for nid in sorted(delete_these):
+                for url, have in sorted(live.items()):
+                    if nid not in have:
+                        continue
+                    mismatched += 1
+                    w.write(
+                        f"volume {vid} on {url}: needle {nid:x} outlived its "
+                        f"delete\n"
+                    )
+                    if fl.fix:
+                        env.vs_call(
+                            grpc_addr(by_url[url]),
+                            "DeleteNeedle",
+                            {"fid": f"{vid},{nid:x}00000000"},
+                        )
+                        synced += 1
+            for url, have in sorted(live.items()):
+                missing = union - set(have) - delete_these
+                if not missing:
+                    continue
+                mismatched += 1
+                w.write(
+                    f"volume {vid} on {url}: missing {len(missing)} needles\n"
+                )
+                if not fl.fix:
+                    continue
+                for nid in sorted(missing):
+                    # prefer a donor whose history proves its copy postdates
+                    # the delete (rewrite evidence); else any live holder
+                    donor_url = next(
+                        (
+                            u
+                            for u, t in tombs.items()
+                            if nid in live[u] and t.get(nid) == 0
+                        ),
+                        next(u for u, m in live.items() if nid in m),
+                    )
+                    blob = env.vs_call(
+                        grpc_addr(by_url[donor_url]),
+                        "ReadNeedle",
+                        {"volume_id": vid, "needle_id": nid},
+                    )
+                    fid = f"{vid},{nid:x}{int(blob['cookie']):08x}"
+                    req = {"fid": fid, "data": blob["data"]}
+                    if blob.get("name"):
+                        req["name"] = blob["name"]
+                    if blob.get("mime"):
+                        req["mime"] = blob["mime"]
+                    env.vs_call(grpc_addr(by_url[url]), "WriteNeedle", req)
+                    synced += 1
+    w.write(
+        f"volume.check.disk: {mismatched} divergent replicas, "
+        f"{synced} needles synced\n"
+    )
+
+
+register(
+    ShellCommand(
+        "volume.check.disk",
+        "volume.check.disk [-volumeId <id>] [-fix]\n\tdiff replica needle sets and "
+        "copy missing needles from healthy replicas",
+        do_volume_check_disk,
+    )
+)
+
+
+def do_volume_server_leave(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Ask one volume server to stop heartbeating and leave the topology
+    (command_volume_server_leave.go analog)."""
+    fl = parse_flags(args, node="")
+    env.confirm_locked()
+    if not fl.node:
+        raise ShellError("volumeServer.leave -node <url>")
+    by_url = {n["url"]: n for n in env.topology_nodes()}
+    n = by_url.get(fl.node)
+    if n is None:
+        raise ShellError(f"unknown node {fl.node!r} ({sorted(by_url)})")
+    env.vs_call(grpc_addr(n), "VolumeServerLeave", {})
+    w.write(f"volumeServer.leave: {fl.node} left the cluster\n")
+
+
+register(
+    ShellCommand(
+        "volumeServer.leave",
+        "volumeServer.leave -node <url>\n\task a volume server to stop heartbeating "
+        "and depart the topology (it keeps serving until stopped)",
+        do_volume_server_leave,
+    )
+)
+
+
+def do_volume_server_evacuate(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Move every volume and EC shard off one node so it can be retired
+    (command_volume_server_evacuate.go analog)."""
+    fl = parse_flags(args, node="", noApply=False)
+    env.confirm_locked()
+    if not fl.node:
+        raise ShellError("volumeServer.evacuate -node <url> [-noApply]")
+    nodes = env.topology_nodes()
+    by_url = {n["url"]: n for n in nodes}
+    src = by_url.get(fl.node)
+    if src is None:
+        raise ShellError(f"unknown node {fl.node!r} ({sorted(by_url)})")
+    others = [n for n in nodes if n["url"] != fl.node]
+    if not others:
+        raise ShellError("volumeServer.evacuate: no other nodes to receive data")
+
+    moved = 0
+    # normal volumes: least-loaded target without a replica of the volume
+    for v in sorted(src.get("volumes", []), key=lambda v: int(v["id"])):
+        vid = int(v["id"])
+        if v.get("disk_type") == "remote":
+            w.write(f"evacuate: skipping tiered volume {vid} (no local .dat)\n")
+            continue
+        holders = [
+            n["url"]
+            for n in nodes
+            if any(int(x["id"]) == vid for x in n.get("volumes", []))
+        ]
+        targets = sorted(
+            (n for n in others if n["url"] not in holders),
+            key=lambda n: len(n.get("volumes", [])) + len(n.get("ec_shards", [])),
+        )
+        if not targets:
+            raise ShellError(f"evacuate: no replica-free target for volume {vid}")
+        dst = targets[0]
+        if fl.noApply:
+            w.write(f"evacuate (dry): volume {vid} {fl.node} -> {dst['url']}\n")
+        else:
+            _move_volume(env, by_url, holders, vid, v, fl.node, dst["url"])
+            w.write(f"evacuate: volume {vid} {fl.node} -> {dst['url']}\n")
+            dst.setdefault("volumes", []).append(v)
+        moved += 1
+
+    # EC shards: spread to nodes not already holding shards of that volume
+    from seaweedfs_tpu.shell.command_ec import _ec_collections
+
+    colls = _ec_collections(env)
+    for e in sorted(src.get("ec_shards", []), key=lambda e: int(e["volume_id"])):
+        vid = int(e["volume_id"])
+        sids = ShardBits(e.get("shard_bits", 0)).shard_ids()
+        collection = colls.get(vid, "")
+        for sid in sids:
+            targets = sorted(
+                others,
+                key=lambda n: sum(
+                    len(ShardBits(x.get("shard_bits", 0)).shard_ids())
+                    for x in n.get("ec_shards", [])
+                ),
+            )
+            # prefer a target without any shard of this volume (spread), else
+            # least-loaded (correct but reduces failure independence)
+            spread = [
+                n
+                for n in targets
+                if not any(
+                    int(x["volume_id"]) == vid for x in n.get("ec_shards", [])
+                )
+            ]
+            dst = (spread or targets)[0]
+            if fl.noApply:
+                w.write(f"evacuate (dry): ec {vid}.{sid} {fl.node} -> {dst['url']}\n")
+                moved += 1
+                continue
+            has_vid = any(
+                int(x["volume_id"]) == vid for x in dst.get("ec_shards", [])
+            )
+            env.vs_call(
+                grpc_addr(dst),
+                "VolumeEcShardsCopy",
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "shard_ids": [sid],
+                    "source_data_node": grpc_addr(src),
+                    "copy_ecx_file": not has_vid,
+                },
+            )
+            env.vs_call(
+                grpc_addr(dst),
+                "VolumeEcShardsMount",
+                {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+            )
+            env.vs_call(
+                grpc_addr(src),
+                "VolumeEcShardsDelete",
+                {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+            )
+            dst.setdefault("ec_shards", []).append(
+                {"volume_id": vid, "shard_bits": int(ShardBits.from_ids([sid]))}
+            )
+            w.write(f"evacuate: ec {vid}.{sid} {fl.node} -> {dst['url']}\n")
+            moved += 1
+    w.write(f"volumeServer.evacuate: {moved} moves\n")
+
+
+register(
+    ShellCommand(
+        "volumeServer.evacuate",
+        "volumeServer.evacuate -node <url> [-noApply]\n\tmove every volume and EC "
+        "shard off a node so it can be retired",
+        do_volume_server_evacuate,
+    )
+)

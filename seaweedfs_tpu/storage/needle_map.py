@@ -11,6 +11,7 @@ mount — the role of needle_map_leveldb.go / needle_map_sorted_file.go.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 from typing import BinaryIO, Iterator, Optional
@@ -26,12 +27,16 @@ class MemDb:
 
     def __init__(self) -> None:
         self._m: dict[int, tuple[int, int]] = {}
+        self._sorted: Optional[list[int]] = None  # cache, dropped on key churn
 
     def set(self, key: int, stored_offset: int, size: int) -> None:
+        if key not in self._m:
+            self._sorted = None
         self._m[key] = (stored_offset, size)
 
     def delete(self, key: int) -> None:
-        self._m.pop(key, None)
+        if self._m.pop(key, None) is not None:
+            self._sorted = None
 
     def get(self, key: int) -> Optional[tuple[int, int]]:
         return self._m.get(key)
@@ -39,10 +44,18 @@ class MemDb:
     def __len__(self) -> int:
         return len(self._m)
 
-    def ascending_visit(self) -> Iterator[tuple[int, int, int]]:
-        for key in sorted(self._m):
-            off, size = self._m[key]
-            yield key, off, size
+    def ascending_visit(self, start: int = 0) -> Iterator[tuple[int, int, int]]:
+        """Visit (key, offset, size) ascending by key, from `start` on.
+        The sorted key list is cached until the key set changes, so a paged
+        scan (VolumeNeedleIds: ~77 pages on a 5M-needle volume) sorts once
+        and each page is O(log n + page), not a fresh sort per page."""
+        if self._sorted is None:
+            self._sorted = sorted(self._m)
+        keys = self._sorted
+        for key in keys[bisect.bisect_left(keys, start):]:
+            entry = self._m.get(key)
+            if entry is not None:  # key vanished since the cache was cut
+                yield key, *entry
 
     def load_from_idx(self, idx_path: str) -> None:
         """Replay an .idx log: last write wins; tombstones/zero-offset delete.
@@ -211,11 +224,16 @@ class SortedFileNeedleMap:
     def __len__(self) -> int:
         return self._count
 
-    def ascending_visit(self) -> Iterator[tuple[int, int, int]]:
-        """Merge the sorted file with the sorted overlay."""
-        overlay_keys = sorted(self._overlay)
+    def ascending_visit(self, start: int = 0) -> Iterator[tuple[int, int, int]]:
+        """Merge the sorted file with the sorted overlay, from `start` on
+        (binary search into both sides — no linear skip for paged callers)."""
+        overlay_keys = sorted(k for k in self._overlay if k >= start)
         oi = 0
-        rows = self._mm if self._mm is not None else ()
+        if self._mm is not None and self._keys is not None:
+            first = int(np.searchsorted(self._keys, np.uint64(start)))
+            rows = self._mm[first:]
+        else:
+            rows = ()
         for row in rows:
             key = int(row["key"])
             while oi < len(overlay_keys) and overlay_keys[oi] < key:

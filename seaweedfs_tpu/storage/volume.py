@@ -120,6 +120,16 @@ class Volume:
     def version(self) -> int:
         return self.super_block.version
 
+    def configure_replication(self, replication: str) -> None:
+        """Rewrite the superblock's replica-placement byte in place
+        (volume.configure.replication analog): replication is a topology
+        property of the volume, so changing it must survive a remount."""
+        from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+        with self._lock:
+            self.super_block.replica_placement = ReplicaPlacement.parse(replication)
+            self._write_super_block()
+
     def close(self) -> None:
         with self._lock:
             # .idx must be durable before the persistent map advances its
@@ -214,6 +224,44 @@ class Volume:
 
     def needle_count(self) -> int:
         return len(self.nm)
+
+    def needle_entries_page(self, start: int, limit: int) -> tuple[list[list[int]], bool]:
+        """One page of live (id, size) pairs ascending from `start`, under
+        the volume lock (writers mutate the map under the same lock — an
+        unlocked visit can fault mid-iteration). Returns (page, truncated)."""
+        with self._lock:
+            out: list[list[int]] = []
+            for key, _off, size in self.nm.ascending_visit(start):
+                out.append([key, size])
+                if len(out) >= limit:
+                    break
+            return out, len(out) >= limit
+
+    def tombstone_history(self, start: int = 0, limit: int = 0) -> tuple[list[list[int]], bool]:
+        """Ids (ascending from `start`) with a tombstone anywhere in the
+        .idx history, each paired with whether the FINAL state is deleted
+        (1) or the needle was re-written after the delete (0). The delete
+        history volume.check.disk needs: final tombstones let it propagate
+        deletes instead of resurrecting from a replica that missed the
+        delete; rewrite evidence lets it tell 'missed the delete' from
+        'wrote after the delete' and keep the newer write. O(idx) walk;
+        ops-command cadence only. Returns (page, truncated); limit<=0 means
+        unbounded."""
+        with self._lock:
+            self._idx.flush()
+            with open(self.idx_path, "rb") as f:
+                buf = f.read()
+        ever: set[int] = set()
+        final: dict[int, bool] = {}
+        for key, off, size in idx_mod.walk_index_buffer(buf):
+            dead = off == 0 or types.is_deleted(size)
+            if dead:
+                ever.add(key)
+            final[key] = dead
+        rows = [[k, 1 if final[k] else 0] for k in sorted(ever) if k >= start]
+        if limit > 0 and len(rows) > limit:
+            return rows[:limit], True
+        return rows, False
 
     def is_expired(self) -> bool:
         """True when this is a TTL volume whose NEWEST write (.dat mtime)

@@ -35,8 +35,10 @@ class WebDavServer:
         self.root = root.rstrip("/") or ""
         self.host = host
         # class-2 write locks (RFC 4918 §6): path -> (token, owner, expiry).
-        # Exclusive, depth-0 — the minimum real clients (Finder, Windows,
-        # Office) demand before they will mount read-write.
+        # Exclusive, depth-infinity: a collection lock protects its internal
+        # members (enforced via lock_covering), and a member lock blocks
+        # collection-level ops (lock_under) — what Finder, Windows, and
+        # Office demand before they will mount read-write.
         self._locks: dict[str, tuple[str, str, float]] = {}
         self._locks_mu = threading.Lock()
         self._http = _ThreadingHTTPServer((host, port), _Handler)
@@ -95,6 +97,24 @@ class WebDavServer:
                 if p == path or p.startswith(prefix):
                     return p, tok
             return None
+
+    def lock_covering(self, path: str):
+        """Any live lock at `path` or at an ANCESTOR of it (RFC 4918 §7:
+        a write lock on a collection protects internal member creation,
+        modification, and removal). Returns (locked_path, token) or None."""
+        now = time.time()
+        with self._locks_mu:
+            cur = path.rstrip("/") or "/"
+            while True:
+                entry = self._locks.get(cur)
+                if entry is not None:
+                    if entry[2] < now:
+                        del self._locks[cur]
+                    else:
+                        return cur, entry[0]
+                if cur == "/" or "/" not in cur:
+                    return None
+                cur = cur.rsplit("/", 1)[0] or "/"
 
     def clear_under(self, path: str) -> None:
         """Drop every lock entry at/under `path` (the resources are gone —
@@ -174,10 +194,12 @@ class _Handler(httpd.QuietHandler):
         return ""
 
     def _check_lock(self, path: str) -> bool:
-        """True when `path` (INCLUDING any child of a collection) is
-        writable by this request: unlocked, or the request submitted the
-        covering lock's token. Replies 423 otherwise."""
-        hit = self.dav.lock_under(path)
+        """True when `path` is writable by this request: unlocked, or the
+        request submitted the covering lock's token. Both directions are
+        enforced — a child lock blocks collection ops (lock_under), and a
+        collection lock blocks tokenless writes to its members
+        (lock_covering). Replies 423 otherwise."""
+        hit = self.dav.lock_under(path) or self.dav.lock_covering(path)
         if hit is None or self._submitted_token() == hit[1]:
             return True
         self._reply(423, b"<?xml version=\"1.0\"?><D:error xmlns:D=\"DAV:\"/>")
@@ -210,10 +232,15 @@ class _Handler(httpd.QuietHandler):
             except ET.ParseError:
                 self._reply(400, b"bad lockinfo")
                 return
-        granted = self.dav.acquire_lock(
-            path, owner, self._lock_seconds(),
-            token="" if body else self._submitted_token(),  # empty body = refresh
-        )
+        token = "" if body else self._submitted_token()  # empty body = refresh
+        # depth-infinity exclusivity: a new lock is refused while a DIFFERENT
+        # lock exists anywhere on the path's subtree or its ancestors —
+        # otherwise a child lock would tunnel through a collection lock
+        conflict = self.dav.lock_under(path) or self.dav.lock_covering(path)
+        if conflict is not None and conflict[1] != (token or self._submitted_token()):
+            self._reply(423, b"<?xml version=\"1.0\"?><D:error xmlns:D=\"DAV:\"/>")
+            return
+        granted = self.dav.acquire_lock(path, owner, self._lock_seconds(), token=token)
         if granted is None:
             self._reply(423, b"<?xml version=\"1.0\"?><D:error xmlns:D=\"DAV:\"/>")
             return
@@ -223,7 +250,7 @@ class _Handler(httpd.QuietHandler):
         al = ET.SubElement(ld, f"{{{_DAV}}}activelock")
         ET.SubElement(ET.SubElement(al, f"{{{_DAV}}}locktype"), f"{{{_DAV}}}write")
         ET.SubElement(ET.SubElement(al, f"{{{_DAV}}}lockscope"), f"{{{_DAV}}}exclusive")
-        ET.SubElement(al, f"{{{_DAV}}}depth").text = "0"
+        ET.SubElement(al, f"{{{_DAV}}}depth").text = "infinity"
         if owner:
             ET.SubElement(al, f"{{{_DAV}}}owner").text = owner
         ET.SubElement(al, f"{{{_DAV}}}timeout").text = f"Second-{int(seconds)}"

@@ -98,3 +98,43 @@ def test_cpuprofile_flag(tmp_path, capsys):
 
     stats = pstats.Stats(prof)  # parses -> valid profile
     assert stats.total_calls > 0
+
+
+def test_fs_tree(stack):
+    master, _, fs = stack
+    import io as _io
+
+    fs.write_file("/treedemo/x/one.txt", _io.BytesIO(b"1"))
+    fs.write_file("/treedemo/x/y/two.txt", _io.BytesIO(b"22"))
+    fs.write_file("/treedemo/three.txt", _io.BytesIO(b"333"))
+    with CommandEnv(master.address) as env:
+        out = _run(env, "fs.tree /treedemo")
+        assert "x/" in out and "one.txt" in out and "two.txt" in out
+        assert "2 directories, 3 files" in out
+
+
+def test_s3_bucket_commands(stack):
+    master, _, fs = stack
+    import io as _io
+
+    with CommandEnv(master.address) as env:
+        out = _run(env, "s3.bucket.create -name shellbkt")
+        assert "created bucket shellbkt" in out
+        out = _run(env, "s3.bucket.list")
+        assert "shellbkt" in out and "total" in out
+        # duplicate create refused
+        import pytest as _pytest
+
+        from seaweedfs_tpu.shell import ShellError
+
+        with _pytest.raises(ShellError, match="already exists"):
+            _run(env, "s3.bucket.create -name shellbkt")
+        # non-empty bucket needs -force
+        fs.write_file("/buckets/shellbkt/obj", _io.BytesIO(b"data"))
+        with _pytest.raises(ShellError, match="not empty"):
+            _run(env, "s3.bucket.delete -name shellbkt")
+        out = _run(env, "s3.bucket.delete -name shellbkt -force")
+        assert "deleted bucket" in out
+        assert "shellbkt" not in _run(env, "s3.bucket.list")
+        with _pytest.raises(ShellError, match="not found"):
+            _run(env, "s3.bucket.delete -name shellbkt")

@@ -442,3 +442,224 @@ def test_volume_move_to_named_node(cluster):
     # unknown target is refused
     with pytest.raises(ShellError, match="unknown node"):
         run(env, f"volume.move -volumeId {vid} -target 127.0.0.1:1")
+
+
+def test_cluster_ps_and_raft_ps(cluster):
+    master, servers, client, env = cluster
+    out = run(env, "cluster.raft.ps")
+    assert "raft disabled" in out and master.address in out
+    out = run(env, "cluster.ps")
+    assert out.count("volume server") == 4
+    assert f"master * {master.address}" in out
+
+
+def test_collection_delete(cluster):
+    master, servers, client, env = cluster
+    res = client.submit(b"c" * 300, collection="trash")
+    keep = client.submit(b"k" * 300)
+    run(env, "lock")
+    out = run(env, "collection.delete -collection trash")
+    assert "would delete" in out  # dry run without -force
+    assert client.read(res.fid) == b"c" * 300  # still there
+    out = run(env, "collection.delete -collection trash -force")
+    assert "removed" in out
+    import time as _t
+
+    _t.sleep(0.5)
+    for n in env.topology_nodes():
+        assert not any(
+            v.get("collection") == "trash" for v in n.get("volumes", [])
+        )
+    assert client.read(keep.fid) == b"k" * 300  # other collections untouched
+    with pytest.raises(Exception):
+        client.read(res.fid)
+
+
+def test_volume_delete_empty(cluster):
+    master, servers, client, env = cluster
+    res = client.submit(b"e" * 200)
+    vid = int(res.fid.split(",", 1)[0])
+    import time as _t
+
+    _t.sleep(0.6)  # heartbeat carries the new file_count
+    run(env, "lock")
+    out = run(env, "volume.deleteEmpty -force")
+    # sibling volumes grown alongside ours may legitimately be empty; the
+    # volume with a live needle must survive
+    assert f"removed {vid} from" not in out
+    assert any(
+        int(v["id"]) == vid
+        for n in env.topology_nodes()
+        for v in n.get("volumes", [])
+    )
+    client.delete(res.fid)
+    _t.sleep(0.6)  # heartbeat carries the new delete_count
+    out = run(env, "volume.deleteEmpty")
+    assert f"volume {vid} is empty" in out  # dry run reports
+    out = run(env, "volume.deleteEmpty -force")
+    assert f"removed {vid} from" in out
+    _t.sleep(0.5)
+    assert all(
+        int(v["id"]) != vid
+        for n in env.topology_nodes()
+        for v in n.get("volumes", [])
+    )
+
+
+def test_volume_configure_replication(cluster):
+    master, servers, client, env = cluster
+    res = client.submit(b"r" * 100)
+    vid = int(res.fid.split(",", 1)[0])
+    run(env, "lock")
+    out = run(env, f"volume.configure.replication -volumeId {vid} -replication 001")
+    assert "replication -> 001" in out
+    # persisted in the superblock: visible on the live volume object
+    holder = next(s for s in servers if s.store.get_volume(vid) is not None)
+    assert str(holder.store.get_volume(vid).super_block.replica_placement) == "001"
+    import time as _t
+
+    _t.sleep(0.6)
+    v = next(
+        v
+        for n in env.topology_nodes()
+        for v in n.get("volumes", [])
+        if int(v["id"]) == vid
+    )
+    assert v.get("replica_placement") == "001"
+    with pytest.raises(ShellError, match="no matching volumes"):
+        run(env, "volume.configure.replication -volumeId 9999 -replication 010")
+
+
+def test_volume_check_disk_detects_and_fixes(cluster):
+    import base64
+
+    master, servers, client, env = cluster
+    res = client.submit(b"sync me" * 50, replication="001")
+    vid = int(res.fid.split(",", 1)[0])
+    import time as _t
+
+    _t.sleep(0.6)
+    holders = [s for s in servers if s.store.get_volume(vid) is not None]
+    assert len(holders) == 2  # 001 => two same-DC copies
+    # diverge: write one needle directly to a single replica (bypasses the
+    # HTTP fan-out), as if the other replica missed a write while down
+    lone = f"{vid},deadbeef01020304"
+    from seaweedfs_tpu import rpc
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    with rpc.RpcClient(holders[0].grpc_address) as c:
+        c.call(
+            VOLUME_SERVICE,
+            "WriteNeedle",
+            {"fid": lone, "data": base64.b64encode(b"lone needle").decode()},
+        )
+    run(env, "lock")
+    out = run(env, f"volume.check.disk -volumeId {vid}")
+    assert "missing 1 needles" in out and "0 needles synced" in out
+    out = run(env, f"volume.check.disk -volumeId {vid} -fix")
+    assert "1 needles synced" in out
+    # both replicas now serve the needle with identical bytes
+    for h in holders:
+        n = h.store.read_needle(vid, 0xDEADBEEF)
+        assert n.data == b"lone needle"
+        assert n.cookie == 0x01020304
+    out = run(env, f"volume.check.disk -volumeId {vid}")
+    assert "0 divergent" in out
+
+
+def test_volume_server_evacuate_and_leave(cluster):
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=20, size=600)
+    vid = int(fids[0][0].split(",", 1)[0])
+    run(env, "lock")
+    run(env, f"ec.encode -volumeId {vid} -force")  # give the node EC shards too
+    import time as _t
+
+    _t.sleep(0.8)
+    victim = next(
+        n
+        for n in env.topology_nodes()
+        if n.get("volumes") or n.get("ec_shards")
+    )
+    out = run(env, f"volumeServer.evacuate -node {victim['url']} -noApply")
+    assert "dry" in out
+    out = run(env, f"volumeServer.evacuate -node {victim['url']}")
+    assert "volumeServer.evacuate:" in out
+    _t.sleep(0.8)
+    after = next(n for n in env.topology_nodes() if n["url"] == victim["url"])
+    assert not after.get("volumes") and not after.get("ec_shards"), after
+    for fid, payload in fids:
+        assert client.read(fid) == payload, f"{fid} unreadable after evacuate"
+    # leave: the emptied node departs the topology and stops heartbeating
+    out = run(env, f"volumeServer.leave -node {victim['url']}")
+    assert "left the cluster" in out
+    _t.sleep(0.8)
+    assert all(n["url"] != victim["url"] for n in env.topology_nodes())
+
+
+def test_volume_check_disk_propagates_deletes(cluster):
+    """A replica that missed a DELETE must get the tombstone propagated —
+    never the deleted needle resurrected from the lagging replica."""
+    master, servers, client, env = cluster
+    res = client.submit(b"doomed" * 30, replication="001")
+    vid = int(res.fid.split(",", 1)[0])
+    import time as _t
+
+    _t.sleep(0.6)
+    holders = [s for s in servers if s.store.get_volume(vid) is not None]
+    assert len(holders) == 2
+    # delete on ONE replica only (as if the other was down for the delete)
+    from seaweedfs_tpu import rpc
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    with rpc.RpcClient(holders[0].grpc_address) as c:
+        c.call(VOLUME_SERVICE, "DeleteNeedle", {"fid": res.fid})
+    nid = int(res.fid.split(",", 1)[1][:-8], 16)
+    assert holders[1].store.get_volume(vid).nm.get(nid) is not None
+    run(env, "lock")
+    out = run(env, f"volume.check.disk -volumeId {vid}")
+    assert "outlived its delete" in out
+    out = run(env, f"volume.check.disk -volumeId {vid} -fix")
+    assert "1 needles synced" in out
+    # the delete propagated: gone from BOTH replicas, not resurrected
+    for h in holders:
+        assert h.store.get_volume(vid).nm.get(nid) is None
+    out = run(env, f"volume.check.disk -volumeId {vid}")
+    assert "0 divergent" in out
+
+
+def test_volume_check_disk_rewrite_after_delete_wins(cluster):
+    """A needle re-written AFTER its delete must not be destroyed by the
+    tombstone rule: the rewrite postdates the delete, so check.disk copies
+    the new write to the replica that missed it."""
+    import base64
+
+    master, servers, client, env = cluster
+    res = client.submit(b"first life" * 20, replication="001")
+    vid = int(res.fid.split(",", 1)[0])
+    nid = int(res.fid.split(",", 1)[1][:-8], 16)
+    import time as _t
+
+    _t.sleep(0.6)
+    holders = [s for s in servers if s.store.get_volume(vid) is not None]
+    assert len(holders) == 2
+    # delete everywhere (normal fan-out)...
+    client.delete(res.fid)
+    # ...then re-write the same needle on ONE replica only (replica B was
+    # down for the re-write)
+    from seaweedfs_tpu import rpc
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    with rpc.RpcClient(holders[0].grpc_address) as c:
+        c.call(
+            VOLUME_SERVICE,
+            "WriteNeedle",
+            {"fid": res.fid, "data": base64.b64encode(b"second life").decode()},
+        )
+    run(env, "lock")
+    out = run(env, f"volume.check.disk -volumeId {vid} -fix")
+    assert "1 needles synced" in out and "outlived" not in out
+    # the rewrite won: live with the new bytes on BOTH replicas
+    for h in holders:
+        n = h.store.read_needle(vid, nid)
+        assert n.data == b"second life"

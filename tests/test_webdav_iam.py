@@ -365,3 +365,43 @@ def test_webdav_collection_ops_honor_child_locks(stack):
     _req(base, "MKCOL", "/tree")
     code, _, _ = _req(base, "PUT", "/tree/child.txt", b"fresh")  # no stale 423
     assert code == 201
+
+
+def test_webdav_collection_lock_protects_members(stack):
+    """RFC 4918 §7: an exclusive write lock on a collection protects
+    internal member creation/modification/removal from tokenless writes,
+    while the holder's token covers the whole subtree."""
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    lockinfo = (
+        b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+        b"<D:lockscope><D:exclusive/></D:lockscope>"
+        b"<D:locktype><D:write/></D:locktype></D:lockinfo>"
+    )
+    _req(base, "MKCOL", "/treelock")
+    _req(base, "PUT", "/treelock/child.txt", b"v1")
+    code, headers, _ = _req(base, "LOCK", "/treelock", lockinfo)
+    assert code == 200
+    token = headers["Lock-Token"].strip("<>")
+
+    # tokenless member writes are blocked by the collection lock
+    code, _, _ = _req(base, "PUT", "/treelock/child.txt", b"intruder")
+    assert code == 423
+    code, _, _ = _req(base, "PUT", "/treelock/new.txt", b"intruder")
+    assert code == 423
+    code, _, _ = _req(base, "DELETE", "/treelock/child.txt")
+    assert code == 423
+    code, _, body = _req(base, "GET", "/treelock/child.txt")
+    assert code == 200 and body == b"v1"
+
+    # the holder's token covers members
+    code, _, _ = _req(
+        base, "PUT", "/treelock/child.txt", b"v2", {"If": f"(<{token}>)"}
+    )
+    assert code == 201
+    code, _, _ = _req(
+        base, "UNLOCK", "/treelock", None, {"Lock-Token": f"<{token}>"}
+    )
+    assert code == 204
+    code, _, _ = _req(base, "PUT", "/treelock/child.txt", b"v3")
+    assert code == 201
