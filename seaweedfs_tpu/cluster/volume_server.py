@@ -27,6 +27,8 @@ from typing import Optional
 
 import time
 
+import grpc
+
 from seaweedfs_tpu import rpc, stats
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.security import Guard
@@ -544,9 +546,15 @@ class VolumeServer:
 
         fid = FileId.parse(req["fid"])
         n = Needle(cookie=fid.cookie, id=fid.key, data=base64.b64decode(req["data"]))
-        if req.get("name"):
+        # *_b64 carry raw bytes losslessly (the check.disk repair path);
+        # the plain fields remain for human callers with UTF-8 names
+        if req.get("name_b64"):
+            n.name = base64.b64decode(req["name_b64"])
+        elif req.get("name"):
             n.name = req["name"].encode()
-        if req.get("mime"):
+        if req.get("mime_b64"):
+            n.mime = base64.b64decode(req["mime_b64"])
+        elif req.get("mime"):
             n.mime = req["mime"].encode()
         offset, size = self.store.write_needle(fid.volume_id, n)
         return {"size": size}
@@ -569,8 +577,10 @@ class VolumeServer:
         return {
             "cookie": n.cookie,
             "data": base64.b64encode(n.data).decode(),
-            "name": (n.name or b"").decode("latin1"),
-            "mime": (n.mime or b"").decode("latin1"),
+            # b64, not a lossy text decode: names/mimes are raw bytes, and a
+            # repair must round-trip them verbatim
+            "name_b64": base64.b64encode(n.name or b"").decode(),
+            "mime_b64": base64.b64encode(n.mime or b"").decode(),
         }
 
     def _rpc_volume_configure(self, req: dict, ctx) -> dict:
@@ -579,6 +589,11 @@ class VolumeServer:
         v = self.store.get_volume(int(req["volume_id"]))
         if v is None:
             raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
+        if getattr(v, "tiered", False):
+            raise rpc.RpcFault(
+                f"volume {v.id} is tiered — fetch it local first (volume.tier.fetch)",
+                code=grpc.StatusCode.FAILED_PRECONDITION,
+            )
         v.configure_replication(req["replication"])
         self.heartbeat_once()  # the topology keys layouts by (coll, rp, ttl)
         return {"replication": str(v.super_block.replica_placement)}

@@ -131,6 +131,34 @@ class FilerClient:
             raise
         return base64.b64decode(resp["value"])
 
+    def get_filer_conf(self) -> list[dict]:
+        """Per-path storage rules (fs.configure / filer_conf.go analog)."""
+        return self._rpc.call(FILER_SERVICE, "GetFilerConf", {}).get("rules", [])
+
+    def set_filer_conf(
+        self,
+        location_prefix: str,
+        collection: str = "",
+        replication: str = "",
+        ttl: str = "",
+        read_only: bool = False,
+        delete: bool = False,
+    ) -> list[dict]:
+        """Upsert (or delete) one per-path rule; returns the full rule set."""
+        resp = self._rpc.call(
+            FILER_SERVICE,
+            "SetFilerConf",
+            {
+                "location_prefix": location_prefix,
+                "collection": collection,
+                "replication": replication,
+                "ttl": ttl,
+                "read_only": read_only,
+                "delete": delete,
+            },
+        )
+        return resp.get("rules", [])
+
     def kv_put(self, key: str, value: bytes) -> None:
         self._rpc.call(
             FILER_SERVICE, "KvPut", {"key": key, "value": base64.b64encode(value).decode()}

@@ -77,6 +77,12 @@ class Filer:
     ):
         self.store = store
         self.chunk_io = chunk_io
+        # per-path rules (fs.configure / filer_conf.go): enforcement lives
+        # HERE, not in the HTTP layer, so every mutation surface (HTTP,
+        # gRPC CreateEntry/DeleteEntry/rename, S3, mount) honors read-only
+        from seaweedfs_tpu.filer.filer_conf import FilerConf
+
+        self.path_conf = FilerConf()
         self.notification_queue = notification_queue
         # notifications dispatch off-thread: send_message may do I/O and
         # _notify runs under the filer lock on every mutation
@@ -273,6 +279,7 @@ class Filer:
     def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
         """Insert (or overwrite) an entry; parents are created implicitly,
         like the reference's CreateEntry."""
+        self._check_writable(entry.path)
         with self._lock:
             self.mkdirs(entry.dir)
             old = None
@@ -300,7 +307,15 @@ class Filer:
             self._notify(old, entry)
             return entry
 
+    def _check_writable(self, path: str) -> None:
+        rule = self.path_conf.match(path)
+        if rule is not None and rule.read_only:
+            raise PermissionError(
+                f"{rule.location_prefix} is read-only (fs.configure)"
+            )
+
     def update_entry(self, entry: Entry) -> Entry:
+        self._check_writable(entry.path)
         with self._lock:
             old = self.store.find(entry.path)  # raises if absent
             self.store.update(entry)
@@ -317,6 +332,7 @@ class Filer:
         """Delete an entry; directories require recursive=True when
         non-empty. Chunk needles are reclaimed on the volume tier."""
         path = normalize_path(path)
+        self._check_writable(path)
         with self._lock:
             entry = self.store.find(path)
             if entry.is_directory:
@@ -397,6 +413,8 @@ class Filer:
         rollback."""
         old_path = normalize_path(old_path)
         new_path = normalize_path(new_path)
+        self._check_writable(old_path)  # both ends: a rename mutates both
+        self._check_writable(new_path)
         events: list[tuple[Entry, Entry]] = []
         reclaim: list = []
         with self._lock:

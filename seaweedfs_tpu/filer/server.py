@@ -70,6 +70,17 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.host = host
+        # per-path storage rules (fs.configure), durable in the store's KV;
+        # the live object is Filer.path_conf — enforcement happens in the
+        # Filer core so every surface (gRPC, S3, mount) honors it
+        from seaweedfs_tpu.filer.filer_conf import CONF_KEY, FilerConf
+
+        try:
+            self.filer.path_conf = FilerConf.from_json(
+                self.filer.store.kv_get(CONF_KEY)
+            )
+        except Exception:  # noqa: BLE001 — corrupt conf must not brick startup
+            pass
 
         self._grpc = rpc.RpcServer(port=grpc_port, host=host)
         self._grpc.add_service(self._build_service())
@@ -84,6 +95,11 @@ class FilerServer:
         self._announce_thread = threading.Thread(target=self._announce_loop, daemon=True)
 
     # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def filer_conf(self):
+        """Alias of Filer.path_conf — one live rule-set object."""
+        return self.filer.path_conf
 
     @property
     def url(self) -> str:
@@ -139,6 +155,17 @@ class FilerServer:
         extended: Optional[dict] = None,
         o_excl: bool = False,
     ) -> Entry:
+        # per-path rules (fs.configure / filer_conf.go): explicit request
+        # values win, then the longest-prefix rule, then server defaults
+        rule = self.filer_conf.match(path)
+        if rule is not None:
+            if rule.read_only:
+                raise PermissionError(
+                    f"{rule.location_prefix} is read-only (fs.configure)"
+                )
+            collection = collection or rule.collection
+            replication = replication or rule.replication
+            ttl = ttl or rule.ttl
         collection = collection or self.collection
         replication = replication or self.replication
         chunks, size, md5hex = self.chunk_io.upload_stream(
@@ -191,7 +218,48 @@ class FilerServer:
         add("ReadFileRange", self._rpc_read_file_range, kind="unary_stream", resp_format="bytes")
         add("SubscribeMetadata", self._rpc_subscribe, kind="unary_stream", resp_format="json")
         add("GetFilerConfiguration", self._rpc_configuration)
+        add("GetFilerConf", self._rpc_get_filer_conf)
+        add("SetFilerConf", self._rpc_set_filer_conf)
         return svc
+
+    def _rpc_get_filer_conf(self, req: dict, ctx) -> dict:
+        return {"rules": [r.to_dict() for r in self.filer_conf.rules]}
+
+    def _rpc_set_filer_conf(self, req: dict, ctx) -> dict:
+        """Upsert or delete one per-path rule (fs.configure analog); the
+        whole rule set persists in the store KV so it survives restarts."""
+        from seaweedfs_tpu.filer.filer_conf import CONF_KEY, PathConf
+
+        prefix = req.get("location_prefix", "")
+        if not prefix.startswith("/"):
+            raise rpc.RpcFault(
+                f"location_prefix must be absolute, got {prefix!r}",
+                grpc.StatusCode.INVALID_ARGUMENT,
+            )
+        if req.get("delete"):
+            found = self.filer_conf.delete(prefix)
+            if not found:
+                raise rpc.NotFoundFault(f"no rule for {prefix!r}")
+        else:
+            if req.get("replication"):
+                from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+                ReplicaPlacement.parse(req["replication"])  # validate early
+            if req.get("ttl"):
+                from seaweedfs_tpu.storage.super_block import TTL
+
+                TTL.parse(req["ttl"])
+            self.filer_conf.upsert(
+                PathConf(
+                    location_prefix=prefix,
+                    collection=req.get("collection", ""),
+                    replication=req.get("replication", ""),
+                    ttl=req.get("ttl", ""),
+                    read_only=bool(req.get("read_only", False)),
+                )
+            )
+        self.filer.store.kv_put(CONF_KEY, self.filer_conf.to_json())
+        return {"rules": [r.to_dict() for r in self.filer_conf.rules]}
 
     def _rpc_lookup(self, req: dict, ctx) -> dict:
         try:
@@ -214,6 +282,8 @@ class FilerServer:
         entry = Entry.from_dict(req["entry"])
         try:
             self.filer.create_entry(entry, o_excl=bool(req.get("o_excl", False)))
+        except PermissionError as e:  # fs.configure read-only prefix
+            raise rpc.RpcFault(str(e), grpc.StatusCode.PERMISSION_DENIED)
         except FileExistsError:
             raise rpc.RpcFault(f"{entry.path} exists", grpc.StatusCode.ALREADY_EXISTS)
         except IsADirectoryError:
@@ -226,6 +296,8 @@ class FilerServer:
         entry = Entry.from_dict(req["entry"])
         try:
             self.filer.update_entry(entry)
+        except PermissionError as e:  # fs.configure read-only prefix
+            raise rpc.RpcFault(str(e), grpc.StatusCode.PERMISSION_DENIED)
         except EntryNotFound:
             raise rpc.NotFoundFault(f"{entry.path} not found")
         return {}
@@ -238,6 +310,8 @@ class FilerServer:
                 ignore_recursive_error=bool(req.get("ignore_recursive_error", False)),
                 delete_chunks=bool(req.get("is_delete_data", True)),
             )
+        except PermissionError as e:  # fs.configure read-only prefix
+            raise rpc.RpcFault(str(e), grpc.StatusCode.PERMISSION_DENIED)
         except EntryNotFound:
             raise rpc.NotFoundFault(f"{req['path']} not found")
         except OSError as e:
@@ -247,6 +321,8 @@ class FilerServer:
     def _rpc_rename(self, req: dict, ctx) -> dict:
         try:
             self.filer.rename(req["old_path"], req["new_path"])
+        except PermissionError as e:  # fs.configure read-only prefix
+            raise rpc.RpcFault(str(e), grpc.StatusCode.PERMISSION_DENIED)
         except EntryNotFound:
             raise rpc.NotFoundFault(f"{req['old_path']} not found")
         except IsADirectoryError:
@@ -451,6 +527,9 @@ class _Handler(httpd.QuietHandler):
         except IsADirectoryError:
             self._reply_json(409, {"error": f"{path} is a directory"})
             return
+        except PermissionError as e:  # fs.configure read-only prefix
+            self._reply_json(403, {"error": str(e)})
+            return
         except Exception as e:  # noqa: BLE001 — e.g. no writable volumes:
             # answer 500 instead of killing the keep-alive connection
             self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
@@ -469,6 +548,12 @@ class _Handler(httpd.QuietHandler):
     def do_DELETE(self):
         stats.FilerRequestCounter.labels("delete").inc()
         path, q = self._pq()
+        rule = self.fs.filer_conf.match(path)
+        if rule is not None and rule.read_only:
+            self._reply_json(
+                403, {"error": f"{rule.location_prefix} is read-only (fs.configure)"}
+            )
+            return
         try:
             self.fs.filer.delete_entry(
                 path,

@@ -321,6 +321,11 @@ class _Handler(httpd.QuietHandler):
             if self._auth(ACTION_READ, bucket, b""):
                 self._list_parts(bucket, key, q["uploadId"])
             return
+        if "tagging" in q:
+            stats.S3RequestCounter.labels("GetObjectTagging").inc()
+            if self._auth(ACTION_READ, bucket, b""):
+                self._get_tagging(bucket, key)
+            return
         stats.S3RequestCounter.labels("GetObject").inc()
         if self._auth(ACTION_READ, bucket, b""):
             self._get_object(bucket, key, head=False)
@@ -357,6 +362,11 @@ class _Handler(httpd.QuietHandler):
             stats.S3RequestCounter.labels("UploadPart").inc()
             if self._auth(ACTION_WRITE, bucket, body):
                 self._upload_part(bucket, key, q, body)
+            return
+        if "tagging" in q:
+            stats.S3RequestCounter.labels("PutObjectTagging").inc()
+            if self._auth(ACTION_WRITE, bucket, body):
+                self._put_tagging(bucket, key, body)
             return
         stats.S3RequestCounter.labels("PutObject").inc()
         identity = self._auth(ACTION_WRITE, bucket, body)
@@ -407,6 +417,11 @@ class _Handler(httpd.QuietHandler):
             stats.S3RequestCounter.labels("AbortMultipartUpload").inc()
             if self._auth(ACTION_WRITE, bucket, b""):
                 self._abort_multipart(bucket, key, q["uploadId"])
+            return
+        if "tagging" in q:
+            stats.S3RequestCounter.labels("DeleteObjectTagging").inc()
+            if self._auth(ACTION_WRITE, bucket, b""):
+                self._delete_tagging(bucket, key)
             return
         stats.S3RequestCounter.labels("DeleteObject").inc()
         if self._auth(ACTION_WRITE, bucket, b""):
@@ -537,6 +552,13 @@ class _Handler(httpd.QuietHandler):
         for k, v in self.headers.items():
             if k.lower().startswith("x-amz-meta-"):
                 headers[k] = v
+        tagging = self.headers.get(self.TAGS_KEY, "")
+        if tagging:
+            pairs = urllib.parse.parse_qsl(tagging)
+            if len(pairs) > self.MAX_TAGS:
+                self._error(400, "BadRequest", f"up to {self.MAX_TAGS} tags allowed")
+                return
+            headers[self.TAGS_KEY] = tagging  # filer stores x-amz-* in extended
         req = urllib.request.Request(
             self.s3.filer_url(self.s3.object_path(bucket, key)),
             data=body,
@@ -579,6 +601,11 @@ class _Handler(httpd.QuietHandler):
                 for k, v in r.headers.items():
                     if k.lower().startswith("x-amz-meta-"):
                         out_headers[k] = v
+                tagging = r.headers.get(self.TAGS_KEY, "")
+                if tagging:  # S3 exposes only the count, not the tags
+                    out_headers["x-amz-tagging-count"] = str(
+                        len(urllib.parse.parse_qsl(tagging))
+                    )
                 if r.headers.get("Content-Range"):
                     out_headers["Content-Range"] = r.headers["Content-Range"]
                 if head:
@@ -651,6 +678,86 @@ class _Handler(httpd.QuietHandler):
             self.s3.filer.delete(self.s3.object_path(bucket, key))
         except Exception:  # noqa: BLE001 — S3 delete is idempotent
             pass
+        self._reply(204)
+
+    # -- object tagging (Get/Put/DeleteObjectTagging) --------------------------
+    #
+    # Tags live in the entry's extended attributes under TAGS_KEY as the
+    # same urlencoded k=v&k=v form the x-amz-tagging PUT header uses, so a
+    # tagged upload and a PutObjectTagging produce identical state.
+
+    TAGS_KEY = "x-amz-tagging"
+    MAX_TAGS = 10  # AWS object-tagging limit
+
+    def _lookup_object(self, bucket, key):
+        entry = self.s3.filer.lookup(self.s3.object_path(bucket, key))
+        if entry is None or entry.is_directory:
+            self._error(404, "NoSuchKey", key)
+            return None
+        return entry
+
+    def _entry_tags(self, entry) -> str:
+        """The stored tag string, tolerant of HTTP header-name case (the
+        filer keeps upload headers verbatim, e.g. 'X-amz-tagging')."""
+        for k, v in entry.extended.items():
+            if k.lower() == self.TAGS_KEY:
+                return v
+        return ""
+
+    def _drop_entry_tags(self, entry) -> bool:
+        victims = [k for k in entry.extended if k.lower() == self.TAGS_KEY]
+        for k in victims:
+            del entry.extended[k]
+        return bool(victims)
+
+    def _get_tagging(self, bucket, key):
+        entry = self._lookup_object(bucket, key)
+        if entry is None:
+            return
+        root = _xml("Tagging")
+        tagset = _sub(root, "TagSet")
+        for k, v in urllib.parse.parse_qsl(self._entry_tags(entry)):
+            t = _sub(tagset, "Tag")
+            _sub(t, "Key", k)
+            _sub(t, "Value", v)
+        self._reply(200, _render(root))
+
+    def _put_tagging(self, bucket, key, body):
+        entry = self._lookup_object(bucket, key)
+        if entry is None:
+            return
+        try:
+            tree = ET.fromstring(body)
+        except ET.ParseError:
+            self._error(400, "MalformedXML")
+            return
+        ns = tree.tag[: tree.tag.index("}") + 1] if tree.tag.startswith("{") else ""
+        tags: list[tuple[str, str]] = []
+        for t in tree.findall(f"{ns}TagSet/{ns}Tag"):
+            k_el, v_el = t.find(f"{ns}Key"), t.find(f"{ns}Value")
+            k = (k_el.text or "") if k_el is not None else ""
+            v = (v_el.text or "") if v_el is not None else ""
+            if not k or len(k) > 128 or len(v) > 256:
+                self._error(400, "InvalidTag", k)
+                return
+            tags.append((k, v))
+        if len(tags) > self.MAX_TAGS:
+            self._error(400, "BadRequest", f"up to {self.MAX_TAGS} tags allowed")
+            return
+        if len({k for k, _ in tags}) != len(tags):
+            self._error(400, "InvalidTag", "duplicate tag keys")
+            return
+        self._drop_entry_tags(entry)
+        entry.extended[self.TAGS_KEY] = urllib.parse.urlencode(tags)
+        self.s3.filer.update(entry)
+        self._reply(200)
+
+    def _delete_tagging(self, bucket, key):
+        entry = self._lookup_object(bucket, key)
+        if entry is None:
+            return
+        if self._drop_entry_tags(entry):
+            self.s3.filer.update(entry)
         self._reply(204)
 
     def _delete_objects(self, bucket, body):

@@ -269,3 +269,53 @@ register(
         do_fs_tree,
     )
 )
+
+
+def do_fs_configure(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Per-path storage rules (command_fs_configure.go analog): pin
+    collection/replication/TTL/read-only to a namespace prefix. With no
+    flags, prints the active rule set."""
+    flags, _ = _split(
+        args,
+        bools={"readOnly", "delete", "apply"},
+        valued={"locationPrefix", "collection", "replication", "ttl"},
+    )
+    fc = env.filer_client()
+    if not flags["locationPrefix"]:
+        rules = fc.get_filer_conf()
+        if not rules:
+            w.write("fs.configure: no rules\n")
+        for r in rules:
+            w.write(
+                f"{r['location_prefix']}: collection={r.get('collection', '')!r} "
+                f"replication={r.get('replication', '')!r} ttl={r.get('ttl', '')!r} "
+                f"readOnly={bool(r.get('read_only'))}\n"
+            )
+        return
+    if not flags["apply"]:
+        verb = "delete rule for" if flags["delete"] else "set rule for"
+        w.write(
+            f"fs.configure (dry): would {verb} {flags['locationPrefix']} — "
+            "re-run with -apply\n"
+        )
+        return
+    rules = fc.set_filer_conf(
+        flags["locationPrefix"],
+        collection=str(flags["collection"]),
+        replication=str(flags["replication"]),
+        ttl=str(flags["ttl"]),
+        read_only=bool(flags["readOnly"]),
+        delete=bool(flags["delete"]),
+    )
+    w.write(f"fs.configure: {len(rules)} rules active\n")
+
+
+register(
+    ShellCommand(
+        "fs.configure",
+        "fs.configure [-locationPrefix /path/ [-collection c] [-replication xyz] "
+        "[-ttl 7d] [-readOnly] [-delete] -apply]\n\tper-path storage rules; "
+        "no flags prints the active rules",
+        do_fs_configure,
+    )
+)

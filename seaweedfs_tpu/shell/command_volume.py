@@ -595,6 +595,10 @@ def do_volume_configure_replication(args: list[str], env: CommandEnv, w: TextIO)
                 continue
             if fl.collection and v.get("collection", "") != fl.collection:
                 continue
+            if v.get("disk_type") == "remote":
+                w.write(f"volume {vid} on {n['url']}: tiered, skipped "
+                        f"(volume.tier.fetch first)\n")
+                continue
             env.vs_call(
                 grpc_addr(n),
                 "VolumeConfigure",
@@ -830,10 +834,11 @@ def do_volume_check_disk(args: list[str], env: CommandEnv, w: TextIO) -> None:
                     )
                     fid = f"{vid},{nid:x}{int(blob['cookie']):08x}"
                     req = {"fid": fid, "data": blob["data"]}
-                    if blob.get("name"):
-                        req["name"] = blob["name"]
-                    if blob.get("mime"):
-                        req["mime"] = blob["mime"]
+                    # pass name/mime as b64 so non-UTF-8 bytes survive intact
+                    if blob.get("name_b64"):
+                        req["name_b64"] = blob["name_b64"]
+                    if blob.get("mime_b64"):
+                        req["mime_b64"] = blob["mime_b64"]
                     env.vs_call(grpc_addr(by_url[url]), "WriteNeedle", req)
                     synced += 1
     w.write(
@@ -980,6 +985,136 @@ def do_volume_server_evacuate(args: list[str], env: CommandEnv, w: TextIO) -> No
             w.write(f"evacuate: ec {vid}.{sid} {fl.node} -> {dst['url']}\n")
             moved += 1
     w.write(f"volumeServer.evacuate: {moved} moves\n")
+
+
+def _referenced_needles(env: CommandEnv, w: TextIO) -> dict[int, set[int]]:
+    """vid -> needle ids referenced by the filer namespace, with chunk
+    manifests resolved (filechunk_manifest.go analog: a manifest needle
+    indexes further chunk needles, all of which are live references)."""
+    import json as _json
+
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    fc = env.filer_client()
+    refs: dict[int, set[int]] = {}
+
+    def note(fid: str) -> None:
+        try:
+            f = FileId.parse(fid)
+        except ValueError:
+            return
+        refs.setdefault(f.volume_id, set()).add(f.key)
+
+    def resolve_manifest(fid: str) -> None:
+        note(fid)
+        try:
+            payload = env.client.read(fid)
+            for d in _json.loads(payload.decode()):
+                if d.get("is_chunk_manifest"):
+                    resolve_manifest(d["fid"])
+                else:
+                    note(d["fid"])
+        except Exception as e:  # noqa: BLE001 — unreadable manifest: report, keep going
+            w.write(f"volume.fsck: unreadable manifest {fid}: {e}\n")
+
+    def walk(path: str) -> None:
+        start = ""
+        while True:
+            batch = fc.list(path, start_from=start, limit=1024)
+            if not batch:
+                return
+            for e in batch:
+                if e.is_directory:
+                    walk(e.path)
+                    continue
+                for c in e.chunks:
+                    if c.is_chunk_manifest:
+                        resolve_manifest(c.fid)
+                    else:
+                        note(c.fid)
+            start = batch[-1].name
+
+    walk("/")
+    return refs
+
+
+def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Cross-check filer chunk references against volume contents
+    (command_volume_fsck.go analog): needles no entry references are
+    orphans (reclaimable), references with no needle are data loss.
+    Report-only unless -reallyDeleteFromVolume. EC volumes are skipped
+    (their needles are audited via the .ecx path at ec.encode time)."""
+    fl = parse_flags(args, volumeId=0, reallyDeleteFromVolume=False)
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    refs = _referenced_needles(env, w)
+    stored: dict[int, dict[int, int]] = {}  # vid -> id -> size
+    holders_of: dict[int, list[dict]] = {}
+    for n in nodes:
+        for v in n.get("volumes", []):
+            vid = int(v["id"])
+            if fl.volumeId and vid != fl.volumeId:
+                continue
+            holders_of.setdefault(vid, []).append(n)
+            if len(holders_of[vid]) > 1:
+                continue  # replicas hold the same set; diff once per vid
+            live, _tombs = _needle_ids_of(env, n, vid)
+            stored.setdefault(vid, {}).update(live)
+    # volumes the filer references that the topology no longer serves at
+    # all (every holder dead/lost) — the loudest data-loss signal; EC
+    # volumes still serve reads through the shard path, so they're present,
+    # just unaudited here
+    ec_vids = {
+        int(e["volume_id"]) for n in nodes for e in n.get("ec_shards", [])
+    }
+    orphan_count = orphan_bytes = missing_count = 0
+    for vid in sorted(set(refs) - set(stored) - ec_vids):
+        if fl.volumeId and vid != fl.volumeId:
+            continue
+        missing_count += len(refs[vid])
+        w.write(
+            f"volume {vid}: ABSENT from the topology but {len(refs[vid])} "
+            f"needles referenced (data loss)\n"
+        )
+    for vid in sorted(stored):
+        have = stored[vid]
+        want = refs.get(vid, set())
+        orphans = set(have) - want
+        missing = want - set(have)
+        if orphans:
+            size = sum(have[i] for i in orphans)
+            orphan_count += len(orphans)
+            orphan_bytes += size
+            w.write(
+                f"volume {vid}: {len(orphans)} orphan needles ({size} bytes) "
+                f"not referenced by any filer entry\n"
+            )
+            if fl.reallyDeleteFromVolume:
+                for nid in sorted(orphans):
+                    for h in holders_of[vid]:
+                        env.vs_call(
+                            grpc_addr(h),
+                            "DeleteNeedle",
+                            {"fid": f"{vid},{nid:x}00000000"},
+                        )
+        for nid in sorted(missing):
+            missing_count += 1
+            w.write(f"volume {vid}: needle {nid:x} referenced but MISSING (data loss)\n")
+    verb = "deleted" if fl.reallyDeleteFromVolume else "found"
+    w.write(
+        f"volume.fsck: {verb} {orphan_count} orphan needles "
+        f"({orphan_bytes} bytes), {missing_count} missing references\n"
+    )
+
+
+register(
+    ShellCommand(
+        "volume.fsck",
+        "volume.fsck [-volumeId <id>] [-reallyDeleteFromVolume]\n\tcross-check filer "
+        "chunk references against volume needles; report (or purge) orphans",
+        do_volume_fsck,
+    )
+)
 
 
 register(

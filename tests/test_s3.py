@@ -479,3 +479,64 @@ def _raw_get(url):
             return r.status, r.headers, r.read()
     except urllib.error.HTTPError as e:
         return e.code, e.headers, e.read()
+
+
+def test_object_tagging(stack):
+    s3 = stack
+    _req(s3, "PUT", "/tagbkt")
+    # tags via the x-amz-tagging PUT header
+    code, _, _ = _req(
+        s3, "PUT", "/tagbkt/tagged.txt", b"data",
+        {"x-amz-tagging": "env=prod&team=storage"},
+    )
+    assert code == 200
+    code, headers, _ = _req(s3, "GET", "/tagbkt/tagged.txt")
+    assert code == 200 and headers.get("x-amz-tagging-count") == "2"
+    assert headers.get("x-amz-tagging") is None  # tags never leak as a header
+    code, _, body = _req(s3, "GET", "/tagbkt/tagged.txt", query="tagging")
+    root = _xml(body)
+    ns = root.tag[: root.tag.index("}") + 1]
+    tags = {
+        t.find(f"{ns}Key").text: t.find(f"{ns}Value").text
+        for t in root.findall(f"{ns}TagSet/{ns}Tag")
+    }
+    assert tags == {"env": "prod", "team": "storage"}
+
+    # PutObjectTagging replaces the whole set
+    new = (
+        b'<Tagging xmlns="http://s3.amazonaws.com/doc/2006-03-01/"><TagSet>'
+        b"<Tag><Key>tier</Key><Value>cold</Value></Tag>"
+        b"</TagSet></Tagging>"
+    )
+    code, _, _ = _req(s3, "PUT", "/tagbkt/tagged.txt", new, query="tagging")
+    assert code == 200
+    code, _, body = _req(s3, "GET", "/tagbkt/tagged.txt", query="tagging")
+    assert b"tier" in body and b"env" not in body
+    code, headers, _ = _req(s3, "HEAD", "/tagbkt/tagged.txt")
+    assert headers.get("x-amz-tagging-count") == "1"
+
+    # validation: >10 tags and duplicate keys are rejected
+    many = "&".join(f"k{i}=v" for i in range(11))
+    code, _, _ = _req(
+        s3, "PUT", "/tagbkt/toomany.txt", b"x", {"x-amz-tagging": many}
+    )
+    assert code == 400
+    dup = (
+        b"<Tagging><TagSet>"
+        b"<Tag><Key>a</Key><Value>1</Value></Tag>"
+        b"<Tag><Key>a</Key><Value>2</Value></Tag>"
+        b"</TagSet></Tagging>"
+    )
+    code, _, body = _req(s3, "PUT", "/tagbkt/tagged.txt", dup, query="tagging")
+    assert code == 400 and b"InvalidTag" in body
+
+    # DeleteObjectTagging clears; GET tagging then returns an empty set
+    code, _, _ = _req(s3, "DELETE", "/tagbkt/tagged.txt", query="tagging")
+    assert code == 204
+    code, _, body = _req(s3, "GET", "/tagbkt/tagged.txt", query="tagging")
+    assert code == 200 and b"<Tag>" not in body
+    code, headers, _ = _req(s3, "GET", "/tagbkt/tagged.txt")
+    assert headers.get("x-amz-tagging-count") is None
+    # tagging a missing key 404s
+    code, _, body = _req(s3, "GET", "/tagbkt/ghost.txt", query="tagging")
+    assert code == 404 and b"NoSuchKey" in body
