@@ -273,7 +273,28 @@ class MasterServer:
         svc.add("FilerHeartbeat", self._rpc_filer_heartbeat)
         svc.add("ListClusterNodes", self._rpc_list_cluster_nodes)
         svc.add("RaftListClusterServers", self._rpc_raft_status)
+        svc.add("VolumeGrow", self._rpc_volume_grow)
         return svc
+
+    def _rpc_volume_grow(self, req: dict, ctx) -> dict:
+        """Pre-allocate volumes for a (collection, replication, ttl) layout
+        without waiting for an Assign to trip growth (volume.grow analog)."""
+        if not self.is_leader:
+            raise rpc.RpcFault(
+                f"not the raft leader; leader is {self._leader_address()}",
+                code=grpc.StatusCode.FAILED_PRECONDITION,
+            )
+        collection = req.get("collection", "")
+        replication = req.get("replication") or self.default_replication
+        ttl = req.get("ttl", "")
+        count = max(1, min(int(req.get("count", 1)), 100))
+        layout = self.topology.get_layout(collection, replication, ttl)
+        grown = 0
+        for _ in range(count):
+            grown += 1 if self._grow_volumes(
+                layout, collection, replication, ttl, force=True
+            ) else 0
+        return {"grown": grown}
 
     def _rpc_raft_status(self, req: dict, ctx) -> dict:
         """Raft membership/status for cluster.raft.ps (RaftListClusterServers
@@ -302,10 +323,13 @@ class MasterServer:
     FILER_TTL = 20.0
 
     def _rpc_filer_heartbeat(self, req: dict, ctx) -> dict:
+        """Cluster-node announce for filers AND mq brokers (node_type
+        distinguishes them; default 'filer' keeps old clients working)."""
+        node_type = req.get("node_type", "filer")
         with self._admin_lock_mu:  # small table; reuse the mutex
-            if not hasattr(self, "_filers"):
-                self._filers = {}
-            self._filers[req["http_address"]] = (
+            if not hasattr(self, "_cluster_nodes"):
+                self._cluster_nodes = {}
+            self._cluster_nodes[(node_type, req["http_address"])] = (
                 req.get("grpc_address", ""),
                 time.monotonic(),
             )
@@ -313,13 +337,19 @@ class MasterServer:
 
     def _rpc_list_cluster_nodes(self, req: dict, ctx) -> dict:
         now = time.monotonic()
+        out: dict[str, list] = {"filers": [], "brokers": []}
         with self._admin_lock_mu:
-            filers = [
-                {"http_address": url, "grpc_address": grpc_addr}
-                for url, (grpc_addr, seen) in getattr(self, "_filers", {}).items()
-                if now - seen < self.FILER_TTL
-            ]
-        return {"filers": filers}
+            for (node_type, url), (grpc_addr, seen) in getattr(
+                self, "_cluster_nodes", {}
+            ).items():
+                if now - seen >= self.FILER_TTL:
+                    continue
+                row = {"http_address": url, "grpc_address": grpc_addr}
+                if node_type == "broker":
+                    out["brokers"].append(row)
+                else:
+                    out["filers"].append(row)
+        return out
 
     # -- cluster exclusive lock (wdclient/exclusive_locks analog) -------------
     #
@@ -517,10 +547,19 @@ class MasterServer:
 
     # -- growth (volume_growth.go analog) ------------------------------------
 
-    def _grow_volumes(self, layout: VolumeLayout, collection: str, replication: str, ttl: str) -> int:
-        """Create one new volume (all replicas) via VolumeCreate RPCs."""
+    def _grow_volumes(
+        self,
+        layout: VolumeLayout,
+        collection: str,
+        replication: str,
+        ttl: str,
+        force: bool = False,
+    ) -> int:
+        """Create one new volume (all replicas) via VolumeCreate RPCs.
+        `force` skips the already-writable short-circuit (volume.grow's
+        explicit pre-allocation)."""
         with self._grow_lock:
-            if self.topology.pick_writable(layout, self._rng) is not None:
+            if not force and self.topology.pick_writable(layout, self._rng) is not None:
                 return 0  # raced: someone grew while we waited
             rp = ReplicaPlacement.parse(replication or "000")
             targets = self.topology.place_replicas(rp)

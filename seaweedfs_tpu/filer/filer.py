@@ -307,12 +307,25 @@ class Filer:
             self._notify(old, entry)
             return entry
 
-    def _check_writable(self, path: str) -> None:
-        rule = self.path_conf.match(path)
-        if rule is not None and rule.read_only:
-            raise PermissionError(
-                f"{rule.location_prefix} is read-only (fs.configure)"
+    def _check_writable(self, path: str, subtree: bool = False) -> None:
+        """Refuse mutations covered by a read-only fs.configure rule.
+        Matches the rule's subtree, its root directory itself (a rule
+        '/frozen/' must also protect the entry '/frozen'), and — when
+        `subtree` is set (delete/rename, which operate on whole subtrees)
+        — any ancestor whose removal would take the protected prefix
+        with it."""
+        p = path.rstrip("/") or "/"
+        for rule in self.path_conf.rules:
+            if not rule.read_only:
+                continue
+            pre = rule.location_prefix
+            pre_dir = pre.rstrip("/") or "/"
+            inside = path.startswith(pre) or p == pre_dir
+            contains = subtree and (
+                p == "/" or pre_dir == p or pre_dir.startswith(p + "/")
             )
+            if inside or contains:
+                raise PermissionError(f"{pre} is read-only (fs.configure)")
 
     def update_entry(self, entry: Entry) -> Entry:
         self._check_writable(entry.path)
@@ -332,7 +345,7 @@ class Filer:
         """Delete an entry; directories require recursive=True when
         non-empty. Chunk needles are reclaimed on the volume tier."""
         path = normalize_path(path)
-        self._check_writable(path)
+        self._check_writable(path, subtree=True)
         with self._lock:
             entry = self.store.find(path)
             if entry.is_directory:
@@ -413,8 +426,8 @@ class Filer:
         rollback."""
         old_path = normalize_path(old_path)
         new_path = normalize_path(new_path)
-        self._check_writable(old_path)  # both ends: a rename mutates both
-        self._check_writable(new_path)
+        self._check_writable(old_path, subtree=True)  # both ends mutate
+        self._check_writable(new_path, subtree=True)
         events: list[tuple[Entry, Entry]] = []
         reclaim: list = []
         with self._lock:

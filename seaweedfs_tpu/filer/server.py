@@ -548,18 +548,15 @@ class _Handler(httpd.QuietHandler):
     def do_DELETE(self):
         stats.FilerRequestCounter.labels("delete").inc()
         path, q = self._pq()
-        rule = self.fs.filer_conf.match(path)
-        if rule is not None and rule.read_only:
-            self._reply_json(
-                403, {"error": f"{rule.location_prefix} is read-only (fs.configure)"}
-            )
-            return
         try:
             self.fs.filer.delete_entry(
                 path,
                 recursive=q.get("recursive") == "true",
                 ignore_recursive_error=q.get("ignoreRecursiveError") == "true",
             )
+        except PermissionError as e:  # fs.configure read-only prefix
+            self._reply_json(403, {"error": str(e)})
+            return
         except EntryNotFound:
             self._reply_json(404, {"error": f"{path} not found"})
             return

@@ -126,6 +126,10 @@ class Broker:
         self._grpc = rpc.RpcServer(port=port, host=host)
         self._grpc.add_service(self._build_service())
         self.port = self._grpc.port
+        self._stop = threading.Event()
+        self._announce_thread = threading.Thread(
+            target=self._announce_loop, daemon=True
+        )
 
     @property
     def address(self) -> str:
@@ -133,8 +137,37 @@ class Broker:
 
     def start(self) -> None:
         self._grpc.start()
+        self._announce_thread.start()
+
+    def _announce_loop(self) -> None:
+        """Register with the master cluster-node list (node_type=broker) so
+        shells discover brokers like they discover filers. The masters are
+        learned through the filer's configuration — the broker only ever
+        needs a filer address to join a cluster."""
+        masters: list[str] = []
+        while True:
+            try:
+                if not masters:
+                    masters = self.filer.configuration().get("masters", [])
+                for m in masters:
+                    with rpc.RpcClient(m) as c:
+                        c.call(
+                            "weedtpu.Master",
+                            "FilerHeartbeat",
+                            {
+                                "http_address": self.address,
+                                "grpc_address": self.address,
+                                "node_type": "broker",
+                            },
+                            timeout=5,
+                        )
+            except Exception:  # noqa: BLE001 — filer/master down; retry
+                masters = []
+            if self._stop.wait(5.0):
+                return
 
     def stop(self) -> None:
+        self._stop.set()
         with self._lock:
             parts = list(self._partitions.values())
         for p in parts:

@@ -554,7 +554,7 @@ class _Handler(httpd.QuietHandler):
                 headers[k] = v
         tagging = self.headers.get(self.TAGS_KEY, "")
         if tagging:
-            pairs = urllib.parse.parse_qsl(tagging)
+            pairs = urllib.parse.parse_qsl(tagging, keep_blank_values=True)
             if len(pairs) > self.MAX_TAGS:
                 self._error(400, "BadRequest", f"up to {self.MAX_TAGS} tags allowed")
                 return
@@ -604,7 +604,7 @@ class _Handler(httpd.QuietHandler):
                 tagging = r.headers.get(self.TAGS_KEY, "")
                 if tagging:  # S3 exposes only the count, not the tags
                     out_headers["x-amz-tagging-count"] = str(
-                        len(urllib.parse.parse_qsl(tagging))
+                        len(urllib.parse.parse_qsl(tagging, keep_blank_values=True))
                     )
                 if r.headers.get("Content-Range"):
                     out_headers["Content-Range"] = r.headers["Content-Range"]
@@ -716,7 +716,9 @@ class _Handler(httpd.QuietHandler):
             return
         root = _xml("Tagging")
         tagset = _sub(root, "TagSet")
-        for k, v in urllib.parse.parse_qsl(self._entry_tags(entry)):
+        for k, v in urllib.parse.parse_qsl(
+            self._entry_tags(entry), keep_blank_values=True
+        ):
             t = _sub(tagset, "Tag")
             _sub(t, "Key", k)
             _sub(t, "Value", v)

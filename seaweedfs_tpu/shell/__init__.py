@@ -51,6 +51,7 @@ def commands() -> dict[str, ShellCommand]:
     from seaweedfs_tpu.shell import command_cluster  # noqa: F401
     from seaweedfs_tpu.shell import command_ec  # noqa: F401
     from seaweedfs_tpu.shell import command_fs  # noqa: F401
+    from seaweedfs_tpu.shell import command_mq  # noqa: F401
     from seaweedfs_tpu.shell import command_s3  # noqa: F401
     from seaweedfs_tpu.shell import command_volume  # noqa: F401
 

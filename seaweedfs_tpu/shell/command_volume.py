@@ -522,6 +522,33 @@ register(
 )
 
 
+def do_volume_grow(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Pre-allocate volumes for a layout without waiting for writes to
+    trip automatic growth (command_volume_grow.go analog)."""
+    fl = parse_flags(args, collection="", replication="", ttl="", count=1)
+    env.confirm_locked()
+    resp = env.master_call(
+        "VolumeGrow",
+        {
+            "collection": fl.collection,
+            "replication": fl.replication,
+            "ttl": fl.ttl,
+            "count": fl.count,
+        },
+    )
+    w.write(f"volume.grow: {resp.get('grown', 0)} volumes created\n")
+
+
+register(
+    ShellCommand(
+        "volume.grow",
+        "volume.grow [-collection c] [-replication xyz] [-ttl 7d] [-count N]\n"
+        "\tpre-allocate writable volumes for a layout",
+        do_volume_grow,
+    )
+)
+
+
 def do_collection_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Delete every volume and EC volume of a collection
     (command_collection_delete.go analog). Requires -force to actually
@@ -1047,7 +1074,12 @@ def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
     fl = parse_flags(args, volumeId=0, reallyDeleteFromVolume=False)
     env.confirm_locked()
     nodes = env.topology_nodes()
-    refs = _referenced_needles(env, w)
+    # Scan the volumes BEFORE walking the filer: a file uploaded mid-run
+    # then has its needles absent from `stored` (never an orphan, so never
+    # purged) and present in `refs` (at worst a false MISSING report).
+    # The reverse order would let -reallyDeleteFromVolume destroy a file
+    # written between the walk and the scan. Divergent replicas are
+    # merged (union) so a needle on ANY holder is never called missing.
     stored: dict[int, dict[int, int]] = {}  # vid -> id -> size
     holders_of: dict[int, list[dict]] = {}
     for n in nodes:
@@ -1056,10 +1088,9 @@ def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
             if fl.volumeId and vid != fl.volumeId:
                 continue
             holders_of.setdefault(vid, []).append(n)
-            if len(holders_of[vid]) > 1:
-                continue  # replicas hold the same set; diff once per vid
             live, _tombs = _needle_ids_of(env, n, vid)
             stored.setdefault(vid, {}).update(live)
+    refs = _referenced_needles(env, w)
     # volumes the filer references that the topology no longer serves at
     # all (every holder dead/lost) — the loudest data-loss signal; EC
     # volumes still serve reads through the shard path, so they're present,

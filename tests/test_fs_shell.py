@@ -268,3 +268,28 @@ def test_fs_configure_readonly_enforced_on_grpc_surface(stack):
         _run(env, "fs.configure -locationPrefix /grpclock/ -delete -apply")
         with FilerClient(fs.grpc_address) as fc:
             fc.delete("/grpclock/keep.txt")  # rule gone: delete works
+
+
+def test_fs_configure_readonly_protects_ancestor_ops(stack):
+    """Deleting/renaming the read-only directory itself — or an ancestor
+    subtree containing it — must be refused, not just writes inside it."""
+    import io as _io
+
+    import pytest as _pytest
+
+    master, vs, fs = stack
+    fs.write_file("/anc/frozen/keep.txt", _io.BytesIO(b"x"))
+    with CommandEnv(master.address) as env:
+        _run(env, "fs.configure -locationPrefix /anc/frozen/ -readOnly -apply")
+        try:
+            with _pytest.raises(PermissionError):
+                fs.filer.delete_entry("/anc/frozen", recursive=True)
+            with _pytest.raises(PermissionError):
+                fs.filer.delete_entry("/anc", recursive=True)  # ancestor subtree
+            with _pytest.raises(PermissionError):
+                fs.filer.rename("/anc/frozen", "/thawed")
+            with _pytest.raises(PermissionError):
+                fs.filer.rename("/anc", "/moved")
+            assert fs.filer.find_entry("/anc/frozen/keep.txt")
+        finally:
+            _run(env, "fs.configure -locationPrefix /anc/frozen/ -delete -apply")

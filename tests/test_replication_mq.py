@@ -339,3 +339,37 @@ def test_mq_group_heartbeat_unknown_group_errors(stack):
                 c.group_heartbeat("real", "no-such-group", "x")
             c.leave_group("real", "no-such-group", "x")  # no-op, no state grown
             assert ("default", "real", "no-such-group") not in broker._groups
+
+
+def test_mq_shell_commands_and_broker_discovery(stack):
+    """Brokers announce to the master (node_type=broker) and the shell's
+    mq.* commands drive topic admin through the discovered broker."""
+    import io as _io
+    import time as _time
+
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    master, vs, fs = stack
+    with Broker(fs.url, fs.grpc_address) as broker:
+        deadline = _time.monotonic() + 10
+        found = []
+        while _time.monotonic() < deadline and not found:
+            from seaweedfs_tpu import rpc as _rpc
+
+            with _rpc.RpcClient(master.address) as c:
+                found = c.call("weedtpu.Master", "ListClusterNodes", {}).get(
+                    "brokers", []
+                )
+            _time.sleep(0.2)
+        assert found and found[0]["grpc_address"] == broker.address
+        with CommandEnv(master.address) as env:
+            def run(line):
+                out = _io.StringIO()
+                run_command(env, line, out)
+                return out.getvalue()
+
+            assert broker.address in run("mq.broker.list")
+            out = run("mq.topic.configure -topic events -partitions 3")
+            assert "3 partitions" in out
+            out = run("mq.topic.list")
+            assert "default/events: 3 partitions" in out and "total 1 topics" in out

@@ -540,3 +540,18 @@ def test_object_tagging(stack):
     # tagging a missing key 404s
     code, _, body = _req(s3, "GET", "/tagbkt/ghost.txt", query="tagging")
     assert code == 404 and b"NoSuchKey" in body
+
+
+def test_object_tagging_blank_value(stack):
+    """A tag with an empty value is legal in S3 and must survive the
+    round-trip (parse_qsl drops blank values unless told otherwise)."""
+    s3 = stack
+    _req(s3, "PUT", "/blankbkt")
+    code, _, _ = _req(
+        s3, "PUT", "/blankbkt/o", b"x", {"x-amz-tagging": "flag=&k=v"}
+    )
+    assert code == 200
+    code, headers, _ = _req(s3, "HEAD", "/blankbkt/o")
+    assert headers.get("x-amz-tagging-count") == "2"
+    code, _, body = _req(s3, "GET", "/blankbkt/o", query="tagging")
+    assert b"flag" in body

@@ -663,3 +663,26 @@ def test_volume_check_disk_rewrite_after_delete_wins(cluster):
     for h in holders:
         n = h.store.read_needle(vid, nid)
         assert n.data == b"second life"
+
+
+def test_volume_grow(cluster):
+    master, servers, client, env = cluster
+    run(env, "lock")
+    before = sum(len(n.get("volumes", [])) for n in env.topology_nodes())
+    out = run(env, "volume.grow -count 3 -collection grown")
+    assert "3 volumes created" in out
+    import time as _t
+
+    _t.sleep(0.8)
+    grown = [
+        v
+        for n in env.topology_nodes()
+        for v in n.get("volumes", [])
+        if v.get("collection") == "grown"
+    ]
+    assert len(grown) == 3
+    after = sum(len(n.get("volumes", [])) for n in env.topology_nodes())
+    assert after >= before + 3
+    # grown volumes are immediately writable
+    res = client.submit(b"to a pre-grown volume", collection="grown")
+    assert client.read(res.fid) == b"to a pre-grown volume"
