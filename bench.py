@@ -134,15 +134,19 @@ def _measure_numpy_gbps() -> float:
     return 10 * n / t / 1e9
 
 
-def _measure_avx2() -> tuple[float | None, bool]:
-    """The native C++ library (AVX2 PSHUFB when the host supports it)."""
+def _measure_avx2() -> tuple[float | None, bool, float | None, int]:
+    """The native C++ library (AVX2 PSHUFB when the host supports it):
+    (single-core GB/s, avx2?, all-cores GB/s, host core count). The MT
+    split mirrors the reference codec's WithAutoGoroutines; on a 1-core
+    host the two numbers coincide."""
     import numpy as np
 
     from seaweedfs_tpu.ops import gf8
     from seaweedfs_tpu.utils import native
 
+    cores = os.cpu_count() or 1
     if native.load() is None:
-        return None, False
+        return None, False, None, cores
     n = 8 << 20
     rng = np.random.default_rng(0)
     bufs = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes() for _ in range(10)]
@@ -150,7 +154,15 @@ def _measure_avx2() -> tuple[float | None, bool]:
     t = _median_time(
         lambda: native.gf_matrix_apply_native(pm, bufs, n), iters=5, warmup=1
     )
-    return 10 * n / t / 1e9, native.has_avx2()
+    mt_gbps = None
+    if cores > 1 and native.has_mt():  # a stale pre-MT .so must not report
+        t_mt = _median_time(  # a duplicate ST number as "-mt"
+            lambda: native.gf_matrix_apply_native(pm, bufs, n, threads=0),
+            iters=5,
+            warmup=1,
+        )
+        mt_gbps = 10 * n / t_mt / 1e9
+    return 10 * n / t / 1e9, native.has_avx2(), mt_gbps, cores
 
 
 def _measure_xla_gbps(batch: int, n: int, iters: int, warmup: int) -> float:
@@ -287,10 +299,13 @@ def mode_cpu() -> None:
     except Exception as e:  # noqa: BLE001
         out["numpy_error"] = str(e)[:200]
     try:
-        gbps, avx2 = _measure_avx2()
+        gbps, avx2, mt_gbps, cores = _measure_avx2()
+        out["host_cores"] = cores
         if gbps is not None:
             out["native_gbps"] = round(gbps, 3)
             out["native_avx2"] = avx2
+        if mt_gbps is not None:
+            out["native_mt_gbps"] = round(mt_gbps, 3)
     except Exception as e:  # noqa: BLE001
         out["native_error"] = str(e)[:200]
     try:
@@ -752,9 +767,11 @@ def main() -> None:
         result["backend"] = device.get("best_backend")
     else:
         fb = result.get("fallback", {})
+        native_name = "native-avx2" if fb.get("native_avx2") else "native"
         candidates = {
             "xla-cpu": fb.get("xla_cpu_gbps"),
-            "native-avx2" if fb.get("native_avx2") else "native": fb.get("native_gbps"),
+            native_name: fb.get("native_gbps"),
+            native_name + "-mt": fb.get("native_mt_gbps"),
             "numpy": fb.get("numpy_gbps"),
         }
         best = max(

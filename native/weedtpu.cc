@@ -12,8 +12,11 @@
 //
 // Exposed with a plain C ABI for ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -126,17 +129,65 @@ void weedtpu_gf_mul_xor_slice(uint8_t c, const uint8_t* src, uint8_t* dst,
   for (uint64_t i = 0; i < len; i++) dst[i] ^= row[src[i]];
 }
 
+// One contiguous byte range of the apply: for each output row, zero the
+// range then XOR-accumulate every input slice through its coefficient.
+// Iterating (row, col) inside a bounded range keeps src/dst resident in
+// cache across the inner passes — the same blocking the reference codec
+// gets from its per-goroutine split (WithAutoGoroutines).
+static void gf_matrix_apply_range(const uint8_t* matrix, uint32_t rows,
+                                  uint32_t cols, const uint8_t* const* inputs,
+                                  uint8_t* const* outputs, uint64_t off,
+                                  uint64_t n) {
+  for (uint32_t r = 0; r < rows; r++) {
+    memset(outputs[r] + off, 0, n);
+    for (uint32_t c0 = 0; c0 < cols; c0++) {
+      uint8_t coef = matrix[r * cols + c0];
+      if (coef)
+        weedtpu_gf_mul_xor_slice(coef, inputs[c0] + off, outputs[r] + off, n);
+    }
+  }
+}
+
 // outputs[r] = XOR_c gmul(matrix[r*cols+c], inputs[c]), each slice `len` bytes
 void weedtpu_gf_matrix_apply(const uint8_t* matrix, uint32_t rows, uint32_t cols,
                              const uint8_t* const* inputs, uint8_t* const* outputs,
                              uint64_t len) {
-  for (uint32_t r = 0; r < rows; r++) {
-    memset(outputs[r], 0, len);
-    for (uint32_t c0 = 0; c0 < cols; c0++) {
-      uint8_t coef = matrix[r * cols + c0];
-      if (coef) weedtpu_gf_mul_xor_slice(coef, inputs[c0], outputs[r], len);
-    }
+  gf_matrix_apply_range(matrix, rows, cols, inputs, outputs, 0, len);
+}
+
+// Multithreaded variant: the byte range splits across `threads` workers
+// (0 = hardware concurrency), each running the blocked single-thread body
+// on a disjoint 64B-aligned chunk. Mirrors klauspost/reedsolomon's
+// WithAutoGoroutines data split; output rows are disjoint per range, so
+// no synchronization beyond join is needed.
+void weedtpu_gf_matrix_apply_mt(const uint8_t* matrix, uint32_t rows,
+                                uint32_t cols, const uint8_t* const* inputs,
+                                uint8_t* const* outputs, uint64_t len,
+                                uint32_t threads) {
+  if (threads == 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    threads = hw ? hw : 1;
   }
+  // below ~256 KiB per worker, spawn overhead beats the parallel win
+  uint64_t max_useful = len / (256 * 1024);
+  if (max_useful < threads) threads = (uint32_t)std::max<uint64_t>(1, max_useful);
+  if (threads <= 1) {
+    gf_matrix_apply_range(matrix, rows, cols, inputs, outputs, 0, len);
+    return;
+  }
+  uint64_t chunk = (len / threads + 63) & ~63ull;  // 64B-aligned split
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  uint64_t off = 0;
+  for (uint32_t t = 0; t < threads && off < len; t++) {
+    uint64_t n = std::min(chunk, len - off);
+    pool.emplace_back(gf_matrix_apply_range, matrix, rows, cols, inputs,
+                      outputs, off, n);
+    off += n;
+  }
+  if (off < len)  // remainder from alignment rounding
+    gf_matrix_apply_range(matrix, rows, cols, inputs, outputs, off, len - off);
+  for (auto& th : pool) th.join();
 }
 
 int weedtpu_has_avx2() {

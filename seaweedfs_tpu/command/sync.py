@@ -67,3 +67,39 @@ def _backup_run(args: argparse.Namespace) -> int:
 
 
 register(Command("filer.backup", "apply pending filer events to a local directory", _backup_conf, _backup_run))
+
+
+def _meta_tail_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-filerGrpc", required=True, help="filer grpc host:port")
+    p.add_argument("-prefix", default="/", help="only events under this subtree")
+    p.add_argument("-sinceNs", type=int, default=0, help="replay from this event ts")
+    p.add_argument(
+        "-maxIdleSeconds",
+        type=float,
+        default=0,
+        help="exit after this much quiet (0 = follow forever)",
+    )
+
+
+def _meta_tail_run(args: argparse.Namespace) -> int:
+    """Stream the filer metadata event log to stdout as JSON lines
+    (filer.meta.tail analog) — the operator's live view of namespace
+    mutations, and the same feed replication/mq consume."""
+    import json
+
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    with FilerClient(args.filerGrpc) as fc:
+        try:
+            for ev in fc.subscribe(
+                since_ns=args.sinceNs,
+                path_prefix=args.prefix,
+                max_idle_s=args.maxIdleSeconds,
+            ):
+                print(json.dumps(ev.to_dict()), flush=True)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+register(Command("filer.meta.tail", "stream filer metadata events as JSON lines", _meta_tail_conf, _meta_tail_run))

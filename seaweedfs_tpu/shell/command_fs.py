@@ -271,6 +271,29 @@ register(
 )
 
 
+def do_fs_meta_cat(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Print one entry's full metadata as JSON (fs.meta.cat analog) —
+    chunk list, attributes, extended attrs."""
+    paths = _positional(args)
+    if not paths:
+        raise ShellError("fs.meta.cat <path ...>")
+    fc = env.filer_client()
+    for path in paths:
+        e = fc.lookup(path)
+        if e is None:
+            raise ShellError(f"{path} not found")
+        w.write(json.dumps(e.to_dict(), indent=2, sort_keys=True) + "\n")
+
+
+register(
+    ShellCommand(
+        "fs.meta.cat",
+        "fs.meta.cat <path ...>\n\tprint an entry's metadata (chunks, attributes) as JSON",
+        do_fs_meta_cat,
+    )
+)
+
+
 def do_fs_configure(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Per-path storage rules (command_fs_configure.go analog): pin
     collection/replication/TTL/read-only to a namespace prefix. With no

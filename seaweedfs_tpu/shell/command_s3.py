@@ -92,3 +92,56 @@ register(
         do_s3_bucket_delete,
     )
 )
+
+
+def _list_all(fc, path: str):
+    """Fully paged directory listing (start_from resume, like fs.du)."""
+    start = ""
+    while True:
+        batch = fc.list(path, start_from=start, limit=1024)
+        if not batch:
+            return
+        yield from batch
+        start = batch[-1].name
+
+
+def do_s3_clean_uploads(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Abort multipart uploads older than -timeAgo (s3.clean.uploads
+    analog): a crashed client's staged parts otherwise hold needle space
+    forever. Age is the NEWEST activity under the staging dir (latest
+    part mtime), so an upload still receiving parts is never aborted."""
+    import time as _time
+
+    from seaweedfs_tpu.s3api.server import UPLOADS_ROOT
+
+    fl = parse_flags(args, timeAgoSeconds=24 * 3600)
+    env.confirm_locked()
+    fc = env.filer_client()
+    cutoff = _time.time() - fl.timeAgoSeconds
+    cleaned = kept = 0
+    for b in _list_all(fc, UPLOADS_ROOT):
+        if not b.is_directory:
+            continue
+        for up in _list_all(fc, b.path):
+            if not up.is_directory:
+                continue
+            newest = up.attributes.mtime
+            for part in _list_all(fc, up.path):
+                newest = max(newest, part.attributes.mtime)
+            if newest >= cutoff:
+                kept += 1
+                continue
+            fc.delete(up.path, recursive=True)
+            w.write(f"aborted stale upload {b.name}/{up.name}\n")
+            cleaned += 1
+    w.write(f"s3.clean.uploads: {cleaned} aborted, {kept} kept\n")
+
+
+register(
+    ShellCommand(
+        "s3.clean.uploads",
+        "s3.clean.uploads [-timeAgoSeconds 86400]\n\tabort multipart uploads "
+        "staged longer ago than the cutoff",
+        do_s3_clean_uploads,
+    )
+)

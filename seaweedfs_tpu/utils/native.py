@@ -106,11 +106,13 @@ def crc32c(data: bytes, crc: int = 0) -> int:
     return c ^ 0xFFFFFFFF
 
 
-def gf_matrix_apply_native(matrix, inputs, length: int):
+def gf_matrix_apply_native(matrix, inputs, length: int, threads: int = 1):
     """Native (AVX2 when available) GF matrix apply over byte slices.
 
     matrix: (R, C) uint8 numpy array; inputs: list of C bytes-like of `length`.
-    Returns list of R bytearrays, or None if the library is unavailable.
+    threads: 1 = single core; 0 = all cores; N = exactly N workers (the
+    multithreaded split mirrors the reference codec's WithAutoGoroutines).
+    Returns list of R arrays, or None if the library is unavailable.
     """
     import numpy as np
 
@@ -125,17 +127,36 @@ def gf_matrix_apply_native(matrix, inputs, length: int):
     OutArr = ctypes.c_void_p * rows
     ins = InArr(*[i.ctypes.data_as(ctypes.c_char_p) for i in in_bufs])
     outs = OutArr(*[o.ctypes.data_as(ctypes.c_void_p) for o in out_bufs])
-    lib.weedtpu_gf_matrix_apply(
-        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        ctypes.c_uint32(rows),
-        ctypes.c_uint32(cols),
-        ins,
-        outs,
-        ctypes.c_uint64(length),
-    )
+    mat_ptr = matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    if threads == 1 or not hasattr(lib, "weedtpu_gf_matrix_apply_mt"):
+        lib.weedtpu_gf_matrix_apply(
+            mat_ptr,
+            ctypes.c_uint32(rows),
+            ctypes.c_uint32(cols),
+            ins,
+            outs,
+            ctypes.c_uint64(length),
+        )
+    else:
+        lib.weedtpu_gf_matrix_apply_mt(
+            mat_ptr,
+            ctypes.c_uint32(rows),
+            ctypes.c_uint32(cols),
+            ins,
+            outs,
+            ctypes.c_uint64(length),
+            ctypes.c_uint32(threads),
+        )
     return out_bufs
 
 
 def has_avx2() -> bool:
     lib = load()
     return bool(lib and lib.weedtpu_has_avx2())
+
+
+def has_mt() -> bool:
+    """True when the loaded library exports the multithreaded apply —
+    a stale pre-MT binary would otherwise silently run single-threaded."""
+    lib = load()
+    return bool(lib and hasattr(lib, "weedtpu_gf_matrix_apply_mt"))

@@ -293,3 +293,78 @@ def test_fs_configure_readonly_protects_ancestor_ops(stack):
             assert fs.filer.find_entry("/anc/frozen/keep.txt")
         finally:
             _run(env, "fs.configure -locationPrefix /anc/frozen/ -delete -apply")
+
+
+def test_fs_meta_cat_and_s3_clean_uploads(stack):
+    import io as _io
+    import time as _time
+
+    master, vs, fs = stack
+    fs.write_file("/catdemo/x.bin", _io.BytesIO(b"z" * 123))
+    with CommandEnv(master.address) as env:
+        out = _run(env, "fs.meta.cat /catdemo/x.bin")
+        import json as _json
+
+        meta = _json.loads(out)
+        assert meta["chunks"] and meta["attributes"]["file_size"] == 123
+        import pytest as _pytest
+
+        from seaweedfs_tpu.shell import ShellError
+
+        with _pytest.raises(ShellError, match="not found"):
+            _run(env, "fs.meta.cat /catdemo/ghost")
+
+        # stale multipart staging dirs get aborted; fresh ones survive
+        fs.write_file(
+            "/buckets/.uploads/bkt/stale123/0001.part", _io.BytesIO(b"p")
+        )
+        fs.write_file(
+            "/buckets/.uploads/bkt/fresh456/0001.part", _io.BytesIO(b"p")
+        )
+        # age the dir AND its newest part: liveness is judged by the
+        # latest activity under the staging dir, not dir creation time
+        for p in ("/buckets/.uploads/bkt/stale123",
+                  "/buckets/.uploads/bkt/stale123/0001.part"):
+            e = fs.filer.find_entry(p)
+            e.attributes.mtime = _time.time() - 7200
+            fs.filer.update_entry(e)
+        # a fresh part keeps an otherwise-old upload alive
+        old_dir = fs.filer.find_entry("/buckets/.uploads/bkt/fresh456")
+        old_dir.attributes.mtime = _time.time() - 7200
+        fs.filer.update_entry(old_dir)
+        _run(env, "lock")
+        out = _run(env, "s3.clean.uploads -timeAgoSeconds 3600")
+        assert "aborted stale upload bkt/stale123" in out
+        assert "1 aborted, 1 kept" in out
+        from seaweedfs_tpu.filer.store import EntryNotFound
+
+        with _pytest.raises(EntryNotFound):
+            fs.filer.find_entry("/buckets/.uploads/bkt/stale123")
+        assert fs.filer.find_entry("/buckets/.uploads/bkt/fresh456")
+        _run(env, "unlock")
+
+
+def test_filer_meta_tail_cli(stack, capsys):
+    import io as _io
+    import json as _json
+
+    from seaweedfs_tpu.__main__ import main
+
+    master, vs, fs = stack
+    fs.write_file("/taildemo/a.txt", _io.BytesIO(b"event me"))
+    rc = main(
+        [
+            "filer.meta.tail",
+            "-filerGrpc",
+            fs.grpc_address,
+            "-prefix",
+            "/taildemo",
+            "-maxIdleSeconds",
+            "0.5",
+        ]
+    )
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert any(
+        "/taildemo" == _json.loads(l)["directory"] for l in lines
+    ), lines

@@ -36,3 +36,26 @@ def test_gf_matrix_apply_native_matches_gf8():
     want = gf8.gf_mat_vec(matrix, np.stack(inputs))
     for r in range(rows):
         np.testing.assert_array_equal(np.asarray(outs[r]), want[r])
+
+
+def test_gf_matrix_apply_mt_matches_single_thread():
+    """The multithreaded split (WithAutoGoroutines analog) must be
+    byte-identical to the single-core path at sizes that actually split,
+    including the 64B-alignment remainder."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops import gf8
+    from seaweedfs_tpu.utils import native
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    pm = gf8.parity_matrix(10, 4)
+    rng = np.random.default_rng(7)
+    for n in (1 << 20, (1 << 20) + 37):  # odd tail exercises the remainder
+        ins = [rng.integers(0, 256, n, dtype=np.uint8).tobytes() for _ in range(10)]
+        st = native.gf_matrix_apply_native(pm, ins, n, threads=1)
+        for threads in (0, 2, 3, 8):
+            mt = native.gf_matrix_apply_native(pm, ins, n, threads=threads)
+            assert all((a == b).all() for a, b in zip(st, mt)), threads
