@@ -159,6 +159,14 @@ class FilerClient:
         )
         return resp.get("rules", [])
 
+    def delete_collection(self, collection: str) -> int:
+        """Drop every volume of a collection cluster-wide (via the master);
+        returns the number of volume/shard-set drops."""
+        resp = self._rpc.call(
+            FILER_SERVICE, "DeleteCollection", {"collection": collection}
+        )
+        return int(resp.get("deleted", 0))
+
     def kv_put(self, key: str, value: bytes) -> None:
         self._rpc.call(
             FILER_SERVICE, "KvPut", {"key": key, "value": base64.b64encode(value).decode()}

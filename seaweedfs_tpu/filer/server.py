@@ -156,7 +156,10 @@ class FilerServer:
         o_excl: bool = False,
     ) -> Entry:
         # per-path rules (fs.configure / filer_conf.go): explicit request
-        # values win, then the longest-prefix rule, then server defaults
+        # values win, then the longest-prefix rule, then the per-bucket
+        # collection (objects under /buckets/<name>/ land in collection
+        # <name>, so deleting a bucket is an O(volumes) collection drop),
+        # then server defaults
         rule = self.filer_conf.match(path)
         if rule is not None:
             if rule.read_only:
@@ -166,6 +169,16 @@ class FilerServer:
             collection = collection or rule.collection
             replication = replication or rule.replication
             ttl = ttl or rule.ttl
+        if not collection and path.startswith("/buckets/"):
+            segs = path[len("/buckets/"):].split("/")
+            # multipart parts stage under /buckets/.uploads/<bucket>/…:
+            # they must land in the BUCKET's collection (Complete splices
+            # these very fids into the final object) or the per-bucket
+            # collection drop would never reclaim multipart objects
+            if segs[0] == ".uploads" and len(segs) > 1:
+                segs = segs[1:]
+            if segs[0] and not segs[0].startswith("."):
+                collection = segs[0]
         collection = collection or self.collection
         replication = replication or self.replication
         chunks, size, md5hex = self.chunk_io.upload_stream(
@@ -220,7 +233,34 @@ class FilerServer:
         add("GetFilerConfiguration", self._rpc_configuration)
         add("GetFilerConf", self._rpc_get_filer_conf)
         add("SetFilerConf", self._rpc_set_filer_conf)
+        add("DeleteCollection", self._rpc_delete_collection)
         return svc
+
+    def _rpc_delete_collection(self, req: dict, ctx) -> dict:
+        """Forward a collection drop to the master (the reference's filer
+        DeleteCollection does the same) — gateways only ever talk to the
+        filer, so bucket deletion reclaims volumes through this hop.
+
+        Collision guard: a collection name also serving as the filer's
+        default collection, or pinned to a NON-bucket prefix by an
+        fs.configure rule, holds data that is not the bucket's — dropping
+        its volumes would destroy it. Refuse instead of guessing."""
+        collection = req.get("collection", "")
+        if collection and collection == self.collection:
+            raise rpc.RpcFault(
+                f"collection {collection!r} is this filer's default collection",
+                grpc.StatusCode.FAILED_PRECONDITION,
+            )
+        for rule in self.filer_conf.rules:
+            if rule.collection == collection and not rule.location_prefix.startswith(
+                f"/buckets/{collection}/"
+            ):
+                raise rpc.RpcFault(
+                    f"collection {collection!r} is mapped to "
+                    f"{rule.location_prefix!r} by fs.configure",
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                )
+        return self.master.master_call("CollectionDelete", {"collection": collection})
 
     def _rpc_get_filer_conf(self, req: dict, ctx) -> dict:
         return {"rules": [r.to_dict() for r in self.filer_conf.rules]}

@@ -1,0 +1,112 @@
+"""Ring-pipelined multi-chip rebuild — the ring-attention analog for the
+EC domain (SURVEY.md §5 "long-context" row; [ref: weed/shell/
+command_ec_rebuild.go, mount empty — the reference copies every survivor
+shard to ONE rebuilder node]).
+
+`make_distributed_rebuild_fn` (parallel/sharded.py) flips shard-major
+survivors to byte-major with one `all_to_all`, which materializes every
+chip's full survivor working set at once. This module does the same
+reconstruction as a RING: each chip keeps its resident survivor-shard
+block and rotates it one hop per step with `lax.ppermute`, accumulating
+that block's contribution to its own byte tile before passing it on.
+
+    step k on chip c:
+      block holds the survivor shards originally resident on chip c-k
+      acc ^= decode_cols(owner[block]) x block[:, :, my_byte_tile]
+      block -> ppermute -> chip c+1
+
+After P steps every chip has seen every survivor exactly once. GF(2^8)
+addition is XOR, so the per-owner partial outputs combine exactly.
+Peak per-chip memory is ONE resident block (vs the all_to_all's full
+regrouped survivor set) and each hop's transfer overlaps the matmul of
+the block in hand — the same memory/latency trade ring attention makes
+for KV blocks over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from seaweedfs_tpu.ops import gf8, rs_jax
+
+
+def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
+    """Ring rebuild over the 'sp' mesh axis.
+
+    recon_m: (L, S) GF(2^8) decode matrix (survivors -> lost shards). The
+    survivor axis is zero-padded to a multiple of the ring size (zero
+    matrix columns contribute nothing).
+
+    Returns run(survivors (B, S, N) uint8) -> (B, L, N) device array with
+    N sharded over 'sp' — the same contract as make_distributed_rebuild_fn,
+    so the two are drop-in alternatives and directly comparable.
+    """
+    recon_m = np.asarray(recon_m, dtype=np.uint8)
+    n_lost, n_surv = recon_m.shape
+    sp = mesh.shape["sp"]
+    s_pad = -(-n_surv // sp) * sp
+    padded = np.zeros((n_lost, s_pad), dtype=np.uint8)
+    padded[:, :n_surv] = recon_m
+    b_rec = jnp.asarray(gf8.gf_matrix_to_bits(padded), dtype=jnp.int8)
+    l8 = n_lost * 8
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("dp", "sp", None),),
+        out_specs=P("dp", None, "sp"),
+    )
+    def rebuild(survivors):
+        # local block: (B/dp, s_pad/sp, N) — whole shards, full byte extent
+        b_local, s_local, n = survivors.shape
+        tile = n // sp
+        cols_per = s_local * 8
+        my = jax.lax.axis_index("sp")
+        acc0 = jnp.zeros((b_local, n_lost, tile), dtype=jnp.uint8)
+        # the loop carry varies per device (each chip accumulates its own
+        # tile) — mark the unvarying zeros init accordingly or the scan
+        # carry types mismatch under shard_map's varying-axes checks
+        if hasattr(jax.lax, "pvary"):
+            acc0 = jax.lax.pvary(acc0, ("dp", "sp"))
+
+        def body(k, carry):
+            block, acc = carry
+            owner = (my - k) % sp  # whose shards this block holds
+            cols = jax.lax.dynamic_slice(
+                b_rec, (0, owner * cols_per), (l8, cols_per)
+            )
+            tile_block = jax.lax.dynamic_slice(
+                block, (0, 0, my * tile), (b_local, s_local, tile)
+            )
+            acc = acc ^ rs_jax.gf_apply(cols, tile_block)
+            block = jax.lax.ppermute(block, "sp", perm)
+            return block, acc
+
+        _, acc = jax.lax.fori_loop(0, sp, body, (survivors, acc0))
+        return acc
+
+    def run(survivors: np.ndarray) -> jax.Array:
+        b, s, n = survivors.shape
+        if s != n_surv:
+            raise ValueError(f"want {n_surv} survivor shards, got {s}")
+        dp = mesh.shape["dp"]
+        if b % dp:
+            raise ValueError(f"batch {b} must divide evenly over dp={dp}")
+        if n % sp:
+            raise ValueError(f"shard length {n} must divide evenly over sp={sp}")
+        if s_pad != s:
+            survivors = np.concatenate(
+                [survivors, np.zeros((b, s_pad - s, n), dtype=np.uint8)], axis=1
+            )
+        x = jax.device_put(survivors, NamedSharding(mesh, P("dp", "sp", None)))
+        return rebuild(x)
+
+    return run

@@ -460,6 +460,12 @@ class _Handler(httpd.QuietHandler):
             self._error(409, "BucketNotEmpty")
             return
         self.s3.filer.delete(path, recursive=True)
+        try:
+            # per-bucket collections: drop the bucket's volumes so the
+            # space (incl. tombstoned needles) comes back immediately
+            self.s3.filer.delete_collection(bucket)
+        except Exception:  # noqa: BLE001 — reclamation is best-effort;
+            pass  # auto-vacuum collects stragglers later
         self._reply(204)
 
     # -- listing --------------------------------------------------------------

@@ -207,6 +207,18 @@ def grpc_addr(node: dict) -> str:
     return f"{host}:{node['grpc_port']}"
 
 
+def iter_entries(fc, path: str, page: int = 1024):
+    """Fully paged filer directory listing (exclusive start_from resume)
+    — the one pagination loop every fs/s3 command shares."""
+    start = ""
+    while True:
+        batch = fc.list(path, start_from=start, limit=page)
+        if not batch:
+            return
+        yield from batch
+        start = batch[-1].name
+
+
 def parse_flags(args: Iterable[str], **defaults):
     """Parse `-name value` / `-name=value` flags with typed defaults.
     Returns an attribute namespace; unknown flags raise ShellError."""

@@ -19,6 +19,7 @@ from seaweedfs_tpu.shell import (
     CommandEnv,
     ShellCommand,
     ShellError,
+    iter_entries,
     register,
 )
 
@@ -143,20 +144,14 @@ def do_fs_du(args: list[str], env: CommandEnv, w: TextIO) -> None:
 
     def walk(path: str) -> tuple[int, int]:
         files, size = 0, 0
-        start = ""
-        while True:
-            batch = fc.list(path, start_from=start, limit=1024)
-            if not batch:
-                break
-            for e in batch:
-                if e.is_directory:
-                    f2, s2 = walk(e.path)
-                    files += f2
-                    size += s2
-                else:
-                    files += 1
-                    size += e.size
-            start = batch[-1].name
+        for e in iter_entries(fc, path):
+            if e.is_directory:
+                f2, s2 = walk(e.path)
+                files += f2
+                size += s2
+            else:
+                files += 1
+                size += e.size
         return files, size
 
     for path in paths:
@@ -180,17 +175,11 @@ def do_fs_meta_save(args: list[str], env: CommandEnv, w: TextIO) -> None:
 
         def walk(path: str) -> None:
             nonlocal count
-            start = ""
-            while True:
-                batch = fc.list(path, start_from=start, limit=1024)
-                if not batch:
-                    break
-                for e in batch:
-                    f.write(json.dumps(e.to_dict()) + "\n")
-                    count += 1
-                    if e.is_directory:
-                        walk(e.path)
-                start = batch[-1].name
+            for e in iter_entries(fc, path):
+                f.write(json.dumps(e.to_dict()) + "\n")
+                count += 1
+                if e.is_directory:
+                    walk(e.path)
 
         for r in roots:
             walk(r)
@@ -242,19 +231,13 @@ def do_fs_tree(args: list[str], env: CommandEnv, w: TextIO) -> None:
 
     def walk(path: str, indent: str) -> None:
         nonlocal dirs, files
-        start = ""
-        while True:
-            batch = fc.list(path, start_from=start, limit=1024)
-            if not batch:
-                break
-            for e in batch:
-                w.write(f"{indent}{e.name}{'/' if e.is_directory else ''}\n")
-                if e.is_directory:
-                    dirs += 1
-                    walk(e.path, indent + "  ")
-                else:
-                    files += 1
-            start = batch[-1].name
+        for e in iter_entries(fc, path):
+            w.write(f"{indent}{e.name}{'/' if e.is_directory else ''}\n")
+            if e.is_directory:
+                dirs += 1
+                walk(e.path, indent + "  ")
+            else:
+                files += 1
 
     for p in paths:
         w.write(p + "\n")

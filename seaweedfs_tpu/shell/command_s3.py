@@ -10,7 +10,14 @@ from __future__ import annotations
 from typing import TextIO
 
 from seaweedfs_tpu.filer.entry import Entry
-from seaweedfs_tpu.shell import CommandEnv, ShellCommand, ShellError, parse_flags, register
+from seaweedfs_tpu.shell import (
+    CommandEnv,
+    ShellCommand,
+    ShellError,
+    iter_entries,
+    parse_flags,
+    register,
+)
 
 BUCKETS_ROOT = "/buckets"
 
@@ -26,17 +33,11 @@ def _valid_bucket(name: str) -> bool:
 
 def do_s3_bucket_list(args: list[str], env: CommandEnv, w: TextIO) -> None:
     fc = env.filer_client()
-    start = ""
     count = 0
-    while True:
-        batch = fc.list(BUCKETS_ROOT, start_from=start, limit=1024)
-        if not batch:
-            break
-        for e in batch:
-            if e.is_directory and not e.name.startswith("."):
-                w.write(f"{e.name}\n")
-                count += 1
-        start = batch[-1].name
+    for e in iter_entries(fc, BUCKETS_ROOT):
+        if e.is_directory and not e.name.startswith("."):
+            w.write(f"{e.name}\n")
+            count += 1
     w.write(f"total {count} buckets\n")
 
 
@@ -81,6 +82,12 @@ def do_s3_bucket_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
     if not fl.force and fc.list(path, limit=1):
         raise ShellError(f"bucket {fl.name!r} is not empty; use -force")
     fc.delete(path, recursive=True)
+    try:
+        dropped = fc.delete_collection(fl.name)
+        if dropped:
+            w.write(f"dropped {dropped} volumes of collection {fl.name!r}\n")
+    except Exception:  # noqa: BLE001 — reclamation best-effort
+        pass
     w.write(f"deleted bucket {fl.name}\n")
 
 
@@ -92,17 +99,6 @@ register(
         do_s3_bucket_delete,
     )
 )
-
-
-def _list_all(fc, path: str):
-    """Fully paged directory listing (start_from resume, like fs.du)."""
-    start = ""
-    while True:
-        batch = fc.list(path, start_from=start, limit=1024)
-        if not batch:
-            return
-        yield from batch
-        start = batch[-1].name
 
 
 def do_s3_clean_uploads(args: list[str], env: CommandEnv, w: TextIO) -> None:
@@ -119,14 +115,14 @@ def do_s3_clean_uploads(args: list[str], env: CommandEnv, w: TextIO) -> None:
     fc = env.filer_client()
     cutoff = _time.time() - fl.timeAgoSeconds
     cleaned = kept = 0
-    for b in _list_all(fc, UPLOADS_ROOT):
+    for b in iter_entries(fc, UPLOADS_ROOT):
         if not b.is_directory:
             continue
-        for up in _list_all(fc, b.path):
+        for up in iter_entries(fc, b.path):
             if not up.is_directory:
                 continue
             newest = up.attributes.mtime
-            for part in _list_all(fc, up.path):
+            for part in iter_entries(fc, up.path):
                 newest = max(newest, part.attributes.mtime)
             if newest >= cutoff:
                 kept += 1

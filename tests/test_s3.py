@@ -26,7 +26,12 @@ def stack(tmp_path_factory):
     master = MasterServer(port=0, reap_interval=3600)
     master.start()
     (tmp / "vol").mkdir()
-    vs = VolumeServer([str(tmp / "vol")], master.address, heartbeat_interval=0.4)
+    # per-bucket collections (a volume set per bucket) need headroom on
+    # the single test volume server
+    vs = VolumeServer(
+        [str(tmp / "vol")], master.address, heartbeat_interval=0.4,
+        max_volume_count=200,
+    )
     vs.start()
     fs = FilerServer(master.address, chunk_size=1024 * 1024)
     fs.start()
@@ -555,3 +560,106 @@ def test_object_tagging_blank_value(stack):
     assert headers.get("x-amz-tagging-count") == "2"
     code, _, body = _req(s3, "GET", "/blankbkt/o", query="tagging")
     assert b"flag" in body
+
+
+def test_bucket_collection_mapping_and_reclaim(stack):
+    """Objects land in a collection named after their bucket, and bucket
+    deletion drops those volumes cluster-wide (per-bucket collections)."""
+    s3 = stack
+    _req(s3, "PUT", "/collbkt")
+    code, _, _ = _req(s3, "PUT", "/collbkt/obj1", b"d" * 2048)
+    assert code == 200
+    # the chunk's volume carries the bucket collection
+    entry = s3.filer.lookup("/buckets/collbkt/obj1")
+    assert entry.attributes.collection == "collbkt"
+    import time as _time
+
+    _time.sleep(0.8)  # heartbeat registers the new volume's collection
+    # delete the object then the bucket; the collection's volumes drop
+    _req(s3, "DELETE", "/collbkt/obj1")
+    code, _, _ = _req(s3, "DELETE", "/collbkt")
+    assert code == 204
+    _time.sleep(0.8)
+    from seaweedfs_tpu import rpc as _rpc
+
+    # discover the master through the filer config and check the topology
+    cfg = s3.filer.configuration()
+    with _rpc.RpcClient(cfg["masters"][0]) as c:
+        topo = c.call("weedtpu.Master", "VolumeList", {})
+    colls = {
+        v.get("collection")
+        for racks in topo["data_centers"].values()
+        for nodes in racks.values()
+        for n in nodes
+        for v in n.get("volumes", [])
+    }
+    assert "collbkt" not in colls, colls
+
+
+def test_multipart_parts_inherit_bucket_collection(stack):
+    """Multipart part needles must land in the bucket's collection (they
+    are spliced verbatim into the final object) or the bucket's
+    collection drop could never reclaim multipart objects."""
+    s3 = stack
+    _req(s3, "PUT", "/mpcoll")
+    code, _, body = _req(s3, "POST", "/mpcoll/big.bin", query="uploads")
+    assert code == 200
+    upload_id = _xml(body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId"
+    )
+    part = os.urandom(1024)
+    code, headers, _ = _req(
+        s3, "PUT", "/mpcoll/big.bin", part,
+        query=f"partNumber=1&uploadId={upload_id}",
+    )
+    assert code == 200
+    etag = headers["ETag"]
+    staged = s3.filer.list(f"/buckets/.uploads/mpcoll/{upload_id}", limit=10)
+    assert staged and staged[0].attributes.collection == "mpcoll"
+    complete = (
+        "<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+        f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>"
+    ).encode()
+    code, _, _ = _req(
+        s3, "POST", "/mpcoll/big.bin", complete, query=f"uploadId={upload_id}"
+    )
+    assert code == 200
+    entry = s3.filer.lookup("/buckets/mpcoll/big.bin")
+    vid = int(entry.chunks[0].fid.split(",", 1)[0])
+    # the final object's needles sit in a collection-mpcoll volume
+    cfg = s3.filer.configuration()
+    from seaweedfs_tpu import rpc as _rpc
+
+    import time as _time
+
+    _time.sleep(0.8)
+    with _rpc.RpcClient(cfg["masters"][0]) as c:
+        topo = c.call("weedtpu.Master", "VolumeList", {})
+    vol = next(
+        v
+        for racks in topo["data_centers"].values()
+        for nodes in racks.values()
+        for n in nodes
+        for v in n.get("volumes", [])
+        if int(v["id"]) == vid
+    )
+    assert vol.get("collection") == "mpcoll"
+
+
+def test_delete_collection_guards_default_and_rules(stack):
+    """DeleteCollection must refuse names that would destroy non-bucket
+    data: the filer default collection and fs.configure-pinned ones."""
+    import grpc as _grpc
+    import pytest as _pytest
+
+    s3 = stack
+    fs_client = s3.filer
+    # simulate a filer default collection collision
+    # (the stack's filer has no default; use an fs.configure rule)
+    fs_client.set_filer_conf("/media/", collection="mediacoll")
+    try:
+        with _pytest.raises(_grpc.RpcError) as ei:
+            fs_client.delete_collection("mediacoll")
+        assert ei.value.code() == _grpc.StatusCode.FAILED_PRECONDITION
+    finally:
+        fs_client.set_filer_conf("/media/", delete=True)
