@@ -18,6 +18,7 @@ from typing import Optional
 import grpc
 
 from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.utils import httpd
 from seaweedfs_tpu.cluster.sequence import MemorySequencer
 from seaweedfs_tpu.security.jwt import mint_file_token
 from seaweedfs_tpu.cluster.topology import Topology, VolumeLayout
@@ -41,6 +42,7 @@ class MasterServer:
         election_timeout: tuple[float, float] = (1.0, 2.0),
         garbage_threshold: float = 0.3,
         vacuum_interval: float = 900.0,
+        http_port: Optional[int] = 0,
     ):
         self.guard = guard
         self.topology = Topology(
@@ -64,6 +66,18 @@ class MasterServer:
         self._server.add_service(self._build_service())
         self.host = host
         self.port = self._server.port
+        # HTTP facade (master_server_handlers*.go analog): the reference's
+        # best-known API is `curl master:9333/dir/assign`. None disables.
+        self._http = None
+        if http_port is not None:
+            self._http = _MasterHTTPServer((host, http_port), _MasterHttpHandler)
+            self._http.master = self
+            self.http_port = self._http.server_address[1]
+            self._http_thread = threading.Thread(
+                target=self._http.serve_forever, daemon=True
+            )
+        else:
+            self.http_port = 0
         self._reap_interval = reap_interval
         self.garbage_threshold = garbage_threshold
         self._vacuum_interval = vacuum_interval
@@ -164,6 +178,8 @@ class MasterServer:
 
     def start(self) -> None:
         self._server.start()
+        if self._http is not None:
+            self._http_thread.start()
         if self.raft is not None:
             self.raft.start()
         self._reaper.start()
@@ -171,6 +187,12 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._http is not None:
+            # shutdown() blocks on an event only serve_forever() sets — a
+            # never-started thread (start() raised early) must skip it
+            if self._http_thread.is_alive():
+                self._http.shutdown()
+            self._http.server_close()
         if self.raft is not None:
             self.raft.stop()
         self._server.stop()
@@ -671,3 +693,131 @@ class MasterServer:
                         node.volumes[vid] = vi
                         layout.register(vi, node)
             return len(succeeded)
+
+
+# -- HTTP facade (master_server_handlers*.go analog) --------------------------
+#
+# The reference master's HTTP API is its most-used surface:
+#   GET/POST /dir/assign?count=&collection=&replication=&ttl=
+#   GET      /dir/lookup?volumeId=<vid or fid>
+#   GET      /dir/status           topology dump
+#   GET      /cluster/status       raft leadership
+#   GET      /cluster/healthz      liveness probe
+#   GET      /vol/grow?count=&collection=&replication=&ttl=
+#   GET      /col/delete?collection=
+#   GET      /metrics              Prometheus text
+# Field names follow the reference's JSON (fid/url/publicUrl/count).
+
+
+class _MasterHTTPServer(httpd.ThreadingHTTPServer):
+    master: "MasterServer"
+
+
+class _MasterHttpHandler(httpd.QuietHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def m(self) -> "MasterServer":
+        return self.server.master
+
+    def _json(self, code: int, obj: dict) -> None:
+        import json as _json
+
+        self.send_reply(code, _json.dumps(obj).encode(), "application/json")
+
+    def _route(self):
+        import urllib.parse as _up
+
+        u = _up.urlparse(self.path)
+        q = {k: v[0] for k, v in _up.parse_qs(u.query).items()}
+        path = u.path
+        m = self.m
+        try:
+            if path == "/dir/assign":
+                resp = m._rpc_assign(
+                    {
+                        "count": httpd.safe_int(q.get("count"), 1),
+                        "collection": q.get("collection", ""),
+                        "replication": q.get("replication", ""),
+                        "ttl": q.get("ttl", ""),
+                    },
+                    None,
+                )
+                out = {
+                    "fid": resp.get("fid", ""),
+                    "url": resp.get("url", ""),
+                    "publicUrl": resp.get("public_url", ""),
+                    "count": resp.get("count", 0),
+                }
+                if resp.get("error"):
+                    out["error"] = resp["error"]
+                if resp.get("auth"):
+                    out["auth"] = resp["auth"]
+                self._json(200, out)
+            elif path == "/dir/lookup":
+                vid = q.get("volumeId", "")
+                resp = m._rpc_lookup({"volume_or_file_ids": [vid]}, None)
+                entry = resp["volume_id_locations"][0]
+                out = {
+                    "volumeId": entry["volume_id"],
+                    "locations": [
+                        {"url": l["url"], "publicUrl": l["public_url"]}
+                        for l in entry["locations"]
+                    ],
+                }
+                if entry.get("error"):
+                    out["error"] = entry["error"]
+                self._json(200 if not entry.get("error") else 404, out)
+            elif path == "/dir/status":
+                self._json(200, {"Topology": m.topology.to_dict()})
+            elif path == "/cluster/status":
+                st = m._rpc_raft_status({}, None)
+                self._json(
+                    200,
+                    {
+                        "IsLeader": m.is_leader,
+                        "Leader": st.get("leader"),
+                        "Peers": st.get("servers", []),
+                    },
+                )
+            elif path == "/cluster/healthz":
+                self.send_reply(200, b"ok", "text/plain")
+            elif path == "/vol/grow":
+                resp = m._rpc_volume_grow(
+                    {
+                        "count": httpd.safe_int(q.get("count"), 1),
+                        "collection": q.get("collection", ""),
+                        "replication": q.get("replication", ""),
+                        "ttl": q.get("ttl", ""),
+                    },
+                    None,
+                )
+                self._json(200, resp)
+            elif path == "/col/delete":
+                resp = m._rpc_collection_delete(
+                    {"collection": q.get("collection", "")}, None
+                )
+                self._json(200, resp)
+            elif path == "/metrics":
+                self.send_reply(
+                    200, stats.REGISTRY.expose().encode(),
+                    "text/plain; version=0.0.4",
+                )
+            else:
+                self._json(404, {"error": f"unknown path {path}"})
+        except rpc.RpcFault as e:
+            self._json(412, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — facade must not kill keep-alive
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_GET(self):
+        self._route()
+
+    def do_POST(self):
+        # drain framing; assign params ride the query string. A chunked
+        # body can't be drained (read_body -> None): unread bytes would
+        # desync keep-alive, so answer 411 per the helper's contract.
+        if self.read_body() is None:
+            self.reply_length_required()
+            return
+        self._route()

@@ -78,6 +78,8 @@ def _master_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-vacuumInterval", type=float, default=900.0,
                    help="seconds between automatic vacuum sweeps")
     p.add_argument("-raftDir", default="", help="raft term/vote persistence directory")
+    p.add_argument("-httpPort", type=int, default=0,
+                   help="HTTP API port (/dir/assign, /dir/lookup, ...); 0 = auto")
     p.add_argument("-metricsPort", type=int, default=0)
 
 
@@ -95,10 +97,11 @@ def _master_run(args: argparse.Namespace) -> int:
         raft_dir=args.raftDir,
         garbage_threshold=args.garbageThreshold,
         vacuum_interval=args.vacuumInterval,
+        http_port=args.httpPort,
     )
     m.start()
     _maybe_metrics(args.metricsPort)
-    print(f"master listening on {m.address}")
+    print(f"master listening on {m.address} (http :{m.http_port})")
     _wait_forever()
     m.stop()
     return 0

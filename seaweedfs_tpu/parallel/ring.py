@@ -30,10 +30,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-from seaweedfs_tpu.ops import gf8, rs_jax
+from seaweedfs_tpu.ops import rs_jax
+from seaweedfs_tpu.parallel.sharded import _bits, pad_survivor_matrix, place_survivors
 
 
 def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
@@ -47,13 +48,11 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
     N sharded over 'sp' — the same contract as make_distributed_rebuild_fn,
     so the two are drop-in alternatives and directly comparable.
     """
-    recon_m = np.asarray(recon_m, dtype=np.uint8)
-    n_lost, n_surv = recon_m.shape
+    n_lost, n_surv = np.asarray(recon_m).shape
     sp = mesh.shape["sp"]
-    s_pad = -(-n_surv // sp) * sp
-    padded = np.zeros((n_lost, s_pad), dtype=np.uint8)
-    padded[:, :n_surv] = recon_m
-    b_rec = jnp.asarray(gf8.gf_matrix_to_bits(padded), dtype=jnp.int8)
+    padded = pad_survivor_matrix(recon_m, sp)
+    s_pad = padded.shape[1]
+    b_rec = _bits(padded)
     l8 = n_lost * 8
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -94,19 +93,6 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
         return acc
 
     def run(survivors: np.ndarray) -> jax.Array:
-        b, s, n = survivors.shape
-        if s != n_surv:
-            raise ValueError(f"want {n_surv} survivor shards, got {s}")
-        dp = mesh.shape["dp"]
-        if b % dp:
-            raise ValueError(f"batch {b} must divide evenly over dp={dp}")
-        if n % sp:
-            raise ValueError(f"shard length {n} must divide evenly over sp={sp}")
-        if s_pad != s:
-            survivors = np.concatenate(
-                [survivors, np.zeros((b, s_pad - s, n), dtype=np.uint8)], axis=1
-            )
-        x = jax.device_put(survivors, NamedSharding(mesh, P("dp", "sp", None)))
-        return rebuild(x)
+        return rebuild(place_survivors(mesh, survivors, n_surv, s_pad))
 
     return run

@@ -28,6 +28,40 @@ def _bits(m: np.ndarray) -> jax.Array:
     return jnp.asarray(gf8.gf_matrix_to_bits(np.asarray(m, dtype=np.uint8)), dtype=jnp.int8)
 
 
+def pad_survivor_matrix(recon_m: np.ndarray, sp: int) -> np.ndarray:
+    """Zero-pad a (L, S) decode matrix's survivor axis to a multiple of the
+    'sp' axis size (zero columns contribute nothing). Shared by the
+    all_to_all and ring rebuild formulations."""
+    recon_m = np.asarray(recon_m, dtype=np.uint8)
+    n_lost, n_surv = recon_m.shape
+    s_pad = -(-n_surv // sp) * sp
+    padded = np.zeros((n_lost, s_pad), dtype=np.uint8)
+    padded[:, :n_surv] = recon_m
+    return padded
+
+
+def place_survivors(
+    mesh: Mesh, survivors: np.ndarray, n_surv: int, s_pad: int
+) -> jax.Array:
+    """Validate + zero-pad + device_put survivors SHARD-major for a
+    distributed rebuild: B over 'dp', padded shard rows over 'sp'. The
+    validation/padding contract is identical for the all_to_all and ring
+    paths — one copy, so they can never drift."""
+    b, s, n = survivors.shape
+    if s != n_surv:
+        raise ValueError(f"want {n_surv} survivor shards, got {s}")
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    if b % dp:
+        raise ValueError(f"batch {b} must divide evenly over dp={dp}")
+    if n % sp:
+        raise ValueError(f"shard length {n} must divide evenly over sp={sp}")
+    if s_pad != s:
+        survivors = np.concatenate(
+            [survivors, np.zeros((b, s_pad - s, n), dtype=np.uint8)], axis=1
+        )
+    return jax.device_put(survivors, NamedSharding(mesh, P("dp", "sp", None)))
+
+
 def make_encode_fn(mesh: Mesh, parity_m: np.ndarray):
     """Jitted sharded encode: (B, D, N) uint8 -> (B, D+P, N) uint8, with B on
     'dp' and N on 'sp' (either axis may be size 1)."""
@@ -122,12 +156,9 @@ def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
     Returns run(survivors (B, S, N) uint8) -> (B, L, N) device array.
     B must divide evenly over 'dp' and N over 'sp'.
     """
-    recon_m = np.asarray(recon_m, dtype=np.uint8)
-    n_lost, n_surv = recon_m.shape
-    sp = mesh.shape["sp"]
-    s_pad = -(-n_surv // sp) * sp
-    padded = np.zeros((n_lost, s_pad), dtype=np.uint8)
-    padded[:, :n_surv] = recon_m
+    n_surv = np.asarray(recon_m).shape[1]
+    padded = pad_survivor_matrix(recon_m, mesh.shape["sp"])
+    s_pad = padded.shape[1]
     b_rec = _bits(padded)
 
     @jax.jit
@@ -146,21 +177,6 @@ def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
         return rs_jax.gf_apply(b_rec, regrouped)
 
     def run(survivors: np.ndarray) -> jax.Array:
-        b, s, n = survivors.shape
-        if s != n_surv:
-            raise ValueError(f"want {n_surv} survivor shards, got {s}")
-        dp = mesh.shape["dp"]
-        if b % dp:
-            raise ValueError(f"batch {b} must divide evenly over dp={dp}")
-        if n % sp:
-            raise ValueError(f"shard length {n} must divide evenly over sp={sp}")
-        if s_pad != s:
-            survivors = np.concatenate(
-                [survivors, np.zeros((b, s_pad - s, n), dtype=np.uint8)], axis=1
-            )
-        x = jax.device_put(
-            survivors, NamedSharding(mesh, P("dp", "sp", None))
-        )
-        return rebuild(x)
+        return rebuild(place_survivors(mesh, survivors, n_surv, s_pad))
 
     return run

@@ -461,6 +461,13 @@ class _Handler(httpd.QuietHandler):
             return
         self.s3.filer.delete(path, recursive=True)
         try:
+            # in-flight multipart staging references needles in this
+            # bucket's collection; dropping the collection without it
+            # would leave staged entries pointing at dead volumes
+            self.s3.filer.delete(f"{UPLOADS_ROOT}/{bucket}", recursive=True)
+        except Exception:  # noqa: BLE001 — no staged uploads
+            pass
+        try:
             # per-bucket collections: drop the bucket's volumes so the
             # space (incl. tombstoned needles) comes back immediately
             self.s3.filer.delete_collection(bucket)

@@ -19,7 +19,7 @@ from seaweedfs_tpu.shell import (
     register,
 )
 
-BUCKETS_ROOT = "/buckets"
+from seaweedfs_tpu.s3api.server import BUCKETS_ROOT, UPLOADS_ROOT  # one layout source
 
 
 def _valid_bucket(name: str) -> bool:
@@ -82,6 +82,10 @@ def do_s3_bucket_delete(args: list[str], env: CommandEnv, w: TextIO) -> None:
     if not fl.force and fc.list(path, limit=1):
         raise ShellError(f"bucket {fl.name!r} is not empty; use -force")
     fc.delete(path, recursive=True)
+    try:  # staged multipart parts reference this collection's needles
+        fc.delete(f"{UPLOADS_ROOT}/{fl.name}", recursive=True)
+    except Exception:  # noqa: BLE001 — no staged uploads
+        pass
     try:
         dropped = fc.delete_collection(fl.name)
         if dropped:
@@ -107,8 +111,6 @@ def do_s3_clean_uploads(args: list[str], env: CommandEnv, w: TextIO) -> None:
     forever. Age is the NEWEST activity under the staging dir (latest
     part mtime), so an upload still receiving parts is never aborted."""
     import time as _time
-
-    from seaweedfs_tpu.s3api.server import UPLOADS_ROOT
 
     fl = parse_flags(args, timeAgoSeconds=24 * 3600)
     env.confirm_locked()

@@ -407,3 +407,69 @@ def test_master_auto_vacuum(tmp_path):
         client.close()
         vs.stop()
         master.stop()
+
+
+def test_master_http_api(tmp_path):
+    """The reference's signature HTTP surface on the master: /dir/assign,
+    /dir/lookup, /dir/status, /cluster/status, /cluster/healthz, /metrics,
+    /vol/grow, /col/delete."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "httpvol"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+    vs.start()
+    try:
+        base = f"http://{master.host}:{master.http_port}"
+
+        def get(path, want=200):
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                assert e.code == want, (path, e.code)
+                return e.code, e.read()
+
+        code, body = get("/cluster/healthz")
+        assert code == 200
+        code, body = get("/dir/assign?count=2")
+        assign = _json.loads(body)
+        assert assign["fid"] and assign["url"] == vs.url and assign["count"] == 2
+        # upload through the assigned fid, then lookup by fid AND vid
+        data = b"assigned over http"
+        req = urllib.request.Request(
+            f"http://{assign['url']}/{assign['fid']}", data=data, method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status in (200, 201)
+        vid = assign["fid"].split(",", 1)[0]
+        for q in (vid, assign["fid"]):
+            code, body = get(f"/dir/lookup?volumeId={q}")
+            lk = _json.loads(body)
+            assert lk["locations"][0]["url"] == vs.url, lk
+        code, body = get("/dir/lookup?volumeId=9999", want=404)
+        assert b"not found" in body
+        code, body = get("/dir/status")
+        topo = _json.loads(body)["Topology"]
+        assert topo["data_centers"]
+        code, body = get("/cluster/status")
+        st = _json.loads(body)
+        assert st["IsLeader"] is True and master.address in st["Leader"]
+        code, body = get("/metrics")
+        assert b"weedtpu" in body
+        code, body = get("/vol/grow?count=1&collection=httpgrow")
+        assert _json.loads(body)["grown"] == 1
+        _time.sleep(0.5)
+        code, body = get("/col/delete?collection=httpgrow")
+        assert _json.loads(body)["deleted"] >= 1
+        code, body = get("/nope", want=404)
+    finally:
+        vs.stop()
+        master.stop()

@@ -663,3 +663,21 @@ def test_delete_collection_guards_default_and_rules(stack):
         assert ei.value.code() == _grpc.StatusCode.FAILED_PRECONDITION
     finally:
         fs_client.set_filer_conf("/media/", delete=True)
+
+
+def test_bucket_delete_cleans_staged_uploads(stack):
+    """Deleting a bucket must also clear its multipart staging area —
+    otherwise the collection drop leaves staged entries pointing at dead
+    volumes and a later Complete splices dead fids."""
+    s3 = stack
+    _req(s3, "PUT", "/stagebkt")
+    code, _, body = _req(s3, "POST", "/stagebkt/pending.bin", query="uploads")
+    upload_id = _xml(body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId"
+    )
+    _req(s3, "PUT", "/stagebkt/pending.bin", b"p" * 256,
+         query=f"partNumber=1&uploadId={upload_id}")
+    assert s3.filer.lookup(f"/buckets/.uploads/stagebkt/{upload_id}")
+    code, _, _ = _req(s3, "DELETE", "/stagebkt")
+    assert code == 204
+    assert s3.filer.lookup("/buckets/.uploads/stagebkt") is None
