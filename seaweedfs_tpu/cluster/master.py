@@ -538,7 +538,10 @@ class MasterServer:
         if not self.is_leader:
             # followers redirect: only the leader allocates ids/volumes
             return {**self._not_leader_response(), "count": 0}
-        count = int(req.get("count", 1))
+        # clamped at the RPC layer: a negative count would REWIND the id
+        # sequencer (duplicate fids overwriting live needles), and the
+        # count reaches here unauthenticated via the HTTP facade
+        count = max(1, min(int(req.get("count", 1)), 10000))
         collection = req.get("collection", "")
         replication = req.get("replication") or self.default_replication
         ttl = req.get("ttl", "")
@@ -803,6 +806,45 @@ class _MasterHttpHandler(httpd.QuietHandler):
                     200, stats.REGISTRY.expose().encode(),
                     "text/plain; version=0.0.4",
                 )
+            elif path in ("/", "/ui", "/ui/index.html"):
+                # operator status page (master_server_handlers_ui.go analog)
+                # escaped throughout: dc/rack/url names arrive from
+                # unauthenticated heartbeats and render in a browser
+                from html import escape as _esc
+
+                topo = m.topology.to_dict()
+                node_rows = []
+                for dc, racks in sorted(topo.get("data_centers", {}).items()):
+                    for rack, nodes in sorted(racks.items()):
+                        for n in nodes:
+                            node_rows.append(
+                                f"<tr><td>{_esc(str(dc))}</td>"
+                                f"<td>{_esc(str(rack))}</td>"
+                                f"<td>{_esc(str(n['url']))}</td>"
+                                f"<td>:{int(n['grpc_port'])}</td>"
+                                f"<td>{len(n.get('volumes', []))}"
+                                f"/{int(n.get('max_volume_count', 0))}</td>"
+                                f"<td>{len(n.get('ec_shards', []))}</td></tr>"
+                            )
+                st = m._rpc_raft_status({}, None)
+                html = (
+                    "<!DOCTYPE html><html><head><title>weedtpu master</title>"
+                    "<style>body{font-family:monospace}table{border-collapse:"
+                    "collapse}td,th{border:1px solid #999;padding:2px 8px}"
+                    "</style></head><body>"
+                    f"<h1>Master {_esc(m.address)}</h1>"
+                    f"<p>leader: {_esc(str(st.get('leader')))} &middot; "
+                    f"term {int(st.get('term', 0))}"
+                    f" &middot; volume size limit "
+                    f"{int(topo.get('volume_size_limit', 0))}</p>"
+                    "<h2>Topology</h2><table><tr><th>dc</th><th>rack</th>"
+                    "<th>node</th><th>grpc</th><th>volumes</th><th>ec</th></tr>"
+                    f"{''.join(node_rows)}</table>"
+                    '<p><a href="/dir/status">/dir/status</a> &middot; '
+                    '<a href="/cluster/status">/cluster/status</a> &middot; '
+                    '<a href="/metrics">/metrics</a></p></body></html>'
+                )
+                self.send_reply(200, html.encode(), "text/html; charset=utf-8")
             else:
                 self._json(404, {"error": f"unknown path {path}"})
         except rpc.RpcFault as e:

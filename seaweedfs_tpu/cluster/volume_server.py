@@ -856,6 +856,47 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 head=head,
             )
             return
+        if urllib.parse.urlparse(self.path).path in ("/ui", "/ui/index.html"):
+            # operator status page (volume_server_handlers_ui.go analog).
+            # Every interpolated string is escaped: collection/rack/dc names
+            # arrive from unauthenticated callers and render in a browser.
+            from html import escape as _esc
+
+            vols = self.vs.store.volume_infos()
+            ecs = [i.to_dict() for i in self.vs.store.ec_volume_infos()]
+            rows = "".join(
+                f"<tr><td>{int(v['id'])}</td><td>{_esc(str(v.get('collection','')))}</td>"
+                f"<td>{int(v.get('size',0))}</td><td>{int(v.get('file_count',0))}</td>"
+                f"<td>{float(v.get('garbage_ratio',0)):.2f}</td>"
+                f"<td>{bool(v.get('read_only',False))}</td>"
+                f"<td>{_esc(str(v.get('replica_placement','')))}</td></tr>"
+                for v in sorted(vols, key=lambda v: int(v["id"]))
+            )
+            ec_rows = "".join(
+                f"<tr><td>{int(e['volume_id'])}</td>"
+                f"<td>{_esc(str(e.get('collection','')))}</td>"
+                f"<td>{bin(e.get('shard_bits',0)).count('1')}</td></tr>"
+                for e in sorted(ecs, key=lambda e: int(e["volume_id"]))
+            )
+            html = (
+                "<!DOCTYPE html><html><head><title>weedtpu volume server</title>"
+                "<style>body{font-family:monospace}table{border-collapse:collapse}"
+                "td,th{border:1px solid #999;padding:2px 8px}</style></head><body>"
+                f"<h1>Volume Server {_esc(self.vs.url)}</h1>"
+                f"<p>grpc :{int(self.vs.grpc_port)} &middot; "
+                f"rack {_esc(str(self.vs.rack))} &middot; "
+                f"dc {_esc(str(self.vs.data_center))} &middot; "
+                f"{len(vols)}/{self.vs.max_volume_count} volume slots</p>"
+                "<h2>Volumes</h2><table><tr><th>id</th><th>collection</th>"
+                "<th>size</th><th>files</th><th>garbage</th><th>read-only</th>"
+                f"<th>rp</th></tr>{rows}</table>"
+                "<h2>EC volumes</h2><table><tr><th>id</th><th>collection</th>"
+                f"<th>shards held</th></tr>{ec_rows}</table>"
+                '<p><a href="/status">/status</a> &middot; '
+                '<a href="/metrics">/metrics</a></p></body></html>'
+            )
+            self._reply(200, html.encode(), "text/html; charset=utf-8", head=head)
+            return
         stats.VolumeServerRequestCounter.labels("get").inc()
         fid = self._parse_fid()
         if fid is None:

@@ -159,6 +159,8 @@ register(Command("volume", "run a volume server", _volume_conf, _volume_run))
 def _server_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-masterPort", type=int, default=9333)
+    p.add_argument("-masterHttpPort", type=int, default=0,
+                   help="master HTTP API port (/dir/assign, ...); 0 = auto")
     p.add_argument("-port", type=int, default=8080, help="volume server http port")
     p.add_argument("-dir", action="append", default=None)
     p.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
@@ -184,13 +186,17 @@ def _server_run(args: argparse.Namespace) -> int:
         port=args.masterPort,
         host=args.ip,
         volume_size_limit=args.volumeSizeLimitMB * 1024 * 1024,
+        http_port=args.masterHttpPort,
     )
     m.start()
     vs = VolumeServer(
         args.dir or ["./data"], m.address, port=args.port, host=args.ip
     )
     vs.start()
-    parts = [f"master {m.address}", f"volume http {vs.url} grpc {vs.grpc_address}"]
+    parts = [
+        f"master {m.address} (http :{m.http_port})",
+        f"volume http {vs.url} grpc {vs.grpc_address}",
+    ]
     extras = []
     if args.filer or args.s3 or args.webdav:
         from seaweedfs_tpu.filer import FilerServer

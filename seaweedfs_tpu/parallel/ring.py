@@ -34,7 +34,7 @@ from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from seaweedfs_tpu.ops import rs_jax
-from seaweedfs_tpu.parallel.sharded import _bits, pad_survivor_matrix, place_survivors
+from seaweedfs_tpu.parallel.sharded import matrix_bits, pad_survivor_matrix, place_survivors
 
 
 def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
@@ -52,7 +52,7 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
     sp = mesh.shape["sp"]
     padded = pad_survivor_matrix(recon_m, sp)
     s_pad = padded.shape[1]
-    b_rec = _bits(padded)
+    b_rec = matrix_bits(padded)
     l8 = n_lost * 8
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 

@@ -24,8 +24,12 @@ from jax.sharding import PartitionSpec as P
 from seaweedfs_tpu.ops import gf8, rs_jax
 
 
-def _bits(m: np.ndarray) -> jax.Array:
+def matrix_bits(m: np.ndarray) -> jax.Array:
+    """Device int8 lift of a GF(2^8) matrix (shared by every sharded path)."""
     return jnp.asarray(gf8.gf_matrix_to_bits(np.asarray(m, dtype=np.uint8)), dtype=jnp.int8)
+
+
+_bits = matrix_bits  # internal alias
 
 
 def pad_survivor_matrix(recon_m: np.ndarray, sp: int) -> np.ndarray:

@@ -473,3 +473,38 @@ def test_master_http_api(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_status_ui_pages(tmp_path):
+    """Operator HTML status pages on master (/ui) and volume server (/ui)."""
+    import urllib.request
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "uivol"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+    vs.start()
+    try:
+        from seaweedfs_tpu.cluster.client import MasterClient
+
+        mc = MasterClient(master.address)
+        mc.submit(b"ui demo data")
+        mc.close()
+        import time as _time
+
+        _time.sleep(0.5)
+        with urllib.request.urlopen(
+            f"http://{master.host}:{master.http_port}/ui", timeout=10
+        ) as r:
+            body = r.read().decode()
+        assert "Master" in body and vs.url in body and "Topology" in body
+        with urllib.request.urlopen(f"http://{vs.url}/ui", timeout=10) as r:
+            body = r.read().decode()
+        assert "Volume Server" in body and "<table>" in body and "volume" in body.lower()
+    finally:
+        vs.stop()
+        master.stop()
