@@ -1,0 +1,20 @@
+#!/bin/bash
+# Detached tunnel watcher: probe the axon TPU every 10 min; on the first
+# healthy probe run the kernel sweep (scripts/kernel_sweep.py) and a fresh
+# device bench stage, logging everything to artifacts/. Exits after one
+# successful sweep or when the deadline passes. Never SIGTERMs a device
+# run mid-flight (that wedges the tunnel): the sweep runs unbounded.
+cd /root/repo
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-6} * 3600 ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if bash scripts/probe_device.sh | grep -q "probe ok"; then
+    echo "$(date -u +%FT%TZ) tunnel alive — running kernel sweep" >> artifacts/device_watch.log
+    python scripts/kernel_sweep.py > artifacts/SWEEP_r04.jsonl 2>artifacts/SWEEP_r04.err
+    echo "$(date -u +%FT%TZ) sweep rc=$? — running device bench" >> artifacts/device_watch.log
+    BENCH_MODE=device BENCH_TRACE_DIR="" python bench.py > artifacts/DEVICE_BENCH_late_r04.json 2>/dev/null
+    echo "$(date -u +%FT%TZ) device bench rc=$?" >> artifacts/device_watch.log
+    exit 0
+  fi
+  sleep 600
+done
+echo "$(date -u +%FT%TZ) deadline passed, no tunnel window" >> artifacts/device_watch.log

@@ -343,6 +343,8 @@ class VolumeServer:
         add("VolumeEcShardsDelete", self._rpc_ec_delete)
         add("VolumeTierMove", self._rpc_tier_move)
         add("VolumeTierFetch", self._rpc_tier_fetch)
+        add("VolumeMount", self._rpc_volume_mount)
+        add("VolumeUnmount", self._rpc_volume_unmount)
         add("VolumeConfigure", self._rpc_volume_configure)
         add("VolumeNeedleIds", self._rpc_needle_ids)
         add("ReadNeedle", self._rpc_read_needle)
@@ -582,6 +584,21 @@ class VolumeServer:
             "name_b64": base64.b64encode(n.name or b"").decode(),
             "mime_b64": base64.b64encode(n.mime or b"").decode(),
         }
+
+    def _rpc_volume_mount(self, req: dict, ctx) -> dict:
+        """Re-open an unmounted volume from disk (VolumeMount analog)."""
+        if not self.store.mount_volume(int(req["volume_id"])):
+            raise rpc.NotFoundFault(f"no files for volume {req['volume_id']}")
+        self.heartbeat_once()
+        return {}
+
+    def _rpc_volume_unmount(self, req: dict, ctx) -> dict:
+        """Stop serving a volume but keep its files (VolumeUnmount analog)
+        — operators use it to fence a volume for offline inspection."""
+        if not self.store.unmount_volume(int(req["volume_id"])):
+            raise rpc.NotFoundFault(f"volume {req['volume_id']} not mounted")
+        self.heartbeat_once()
+        return {}
 
     def _rpc_volume_configure(self, req: dict, ctx) -> dict:
         """Change a volume's replica placement in its superblock

@@ -103,3 +103,67 @@ def _meta_tail_run(args: argparse.Namespace) -> int:
 
 
 register(Command("filer.meta.tail", "stream filer metadata events as JSON lines", _meta_tail_conf, _meta_tail_run))
+
+
+def _filer_copy_conf(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-filer", required=True, help="filer http host:port")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("sources", nargs="+", help="local files/directories to copy")
+    p.add_argument("target", help="filer directory (must end with /)")
+
+
+def _filer_copy_run(args: argparse.Namespace) -> int:
+    """Bulk-copy local trees into the filer over HTTP (filer.copy analog)."""
+    import os
+    import urllib.parse
+    import urllib.request
+
+    if not args.target.endswith("/"):
+        print("target must be a filer DIRECTORY path ending with /")
+        return 1
+    q = {}
+    for k in ("collection", "replication", "ttl"):
+        if getattr(args, k):
+            q[k] = getattr(args, k)
+    query = ("?" + urllib.parse.urlencode(q)) if q else ""
+    copied = failed = 0
+
+    def put(local: str, remote: str) -> None:
+        nonlocal copied, failed
+        try:  # one unreadable source must not abort the bulk copy
+            size = os.path.getsize(local)
+            with open(local, "rb") as f:
+                # stream the file object (constant memory on multi-GB
+                # files); explicit Content-Length — the filer refuses
+                # chunked uploads with 411
+                req = urllib.request.Request(
+                    f"http://{args.filer}{urllib.parse.quote(remote)}{query}",
+                    data=f,
+                    method="PUT",
+                    headers={"Content-Length": str(size)},
+                )
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    r.read()
+            copied += 1
+            print(f"{local} -> {remote} ({size} bytes)")
+        except Exception as e:  # noqa: BLE001 — keep copying the rest
+            failed += 1
+            print(f"FAILED {local}: {e}")
+
+    for src in args.sources:
+        if os.path.isdir(src):
+            base = os.path.basename(os.path.abspath(src))
+            for root, _dirs, files in os.walk(src):
+                rel_root = os.path.relpath(root, src)
+                for name in sorted(files):
+                    rel = name if rel_root == "." else f"{rel_root}/{name}"
+                    put(os.path.join(root, name), f"{args.target}{base}/{rel}")
+        else:
+            put(src, f"{args.target}{os.path.basename(src)}")
+    print(f"filer.copy: {copied} copied, {failed} failed")
+    return 0 if failed == 0 else 1
+
+
+register(Command("filer.copy", "bulk-copy local files/directories into the filer", _filer_copy_conf, _filer_copy_run))

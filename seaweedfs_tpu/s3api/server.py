@@ -312,6 +312,14 @@ class _Handler(httpd.QuietHandler):
             if "uploadId" in q:
                 self._error(404, "NoSuchUpload")
                 return
+            if "location" in q:
+                stats.S3RequestCounter.labels("GetBucketLocation").inc()
+                if self._auth(ACTION_READ, bucket, b""):
+                    if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+                        self._error(404, "NoSuchBucket")
+                    else:  # single-region deployment: the us-east-1 form
+                        self._reply(200, _render(_xml("LocationConstraint")))
+                return
             stats.S3RequestCounter.labels("ListObjects").inc()
             if self._auth(ACTION_LIST, bucket, b""):
                 self._list_objects(bucket, q)
@@ -594,6 +602,30 @@ class _Handler(httpd.QuietHandler):
             else:
                 self._error(404, "NoSuchKey", key)
             return
+        # conditional requests (RFC 9110 semantics S3 clients cache with)
+        from seaweedfs_tpu.filer.chunks import etag_of as _etag_of
+
+        etag = _etag_of(entry.chunks, entry.attributes.md5)
+        inm = self.headers.get("If-None-Match", "")
+        if inm:
+            # RFC 9110: when If-None-Match is present, If-Modified-Since
+            # MUST be ignored — a failed ETag match means the client's copy
+            # is stale even if the 1s-granular Last-Modified looks current
+            if inm.strip('"') in (etag, "*"):
+                self._reply(304, headers={"ETag": f'"{etag}"'})
+                return
+        else:
+            ims = self.headers.get("If-Modified-Since", "")
+            if ims:
+                import email.utils as _eut
+
+                try:
+                    since = _eut.parsedate_to_datetime(ims).timestamp()
+                    if int(entry.attributes.mtime) <= int(since):
+                        self._reply(304, headers={"ETag": f'"{etag}"'})
+                        return
+                except (TypeError, ValueError):
+                    pass  # unparseable date: ignore the condition
         fwd = {}
         rng = self.headers.get("Range", "")
         if rng and not head:

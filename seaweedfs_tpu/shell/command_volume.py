@@ -522,6 +522,43 @@ register(
 )
 
 
+def _mount_dispatch(cmd_name: str, method: str):
+    """volume.mount / volume.unmount (command_volume_mount.go analog):
+    fence a volume off a node (files kept) or bring it back."""
+
+    def do(args: list[str], env: CommandEnv, w: TextIO) -> None:
+        fl = parse_flags(args, volumeId=0, node="")
+        env.confirm_locked()
+        if not fl.volumeId or not fl.node:
+            raise ShellError(f"{cmd_name} needs -volumeId and -node <url>")
+        by_url = {n["url"]: n for n in env.topology_nodes()}
+        n = by_url.get(fl.node)
+        if n is None:
+            raise ShellError(f"unknown node {fl.node!r} ({sorted(by_url)})")
+        env.vs_call(grpc_addr(n), method, {"volume_id": fl.volumeId})
+        w.write(f"{cmd_name}: volume {fl.volumeId} on {fl.node}\n")
+
+    return do
+
+
+register(
+    ShellCommand(
+        "volume.mount",
+        "volume.mount -volumeId <id> -node <url>\n\tre-mount an unmounted volume "
+        "from its on-disk files",
+        _mount_dispatch("volume.mount", "VolumeMount"),
+    )
+)
+register(
+    ShellCommand(
+        "volume.unmount",
+        "volume.unmount -volumeId <id> -node <url>\n\tstop serving a volume but "
+        "keep its files on disk",
+        _mount_dispatch("volume.unmount", "VolumeUnmount"),
+    )
+)
+
+
 def do_volume_grow(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Pre-allocate volumes for a layout without waiting for writes to
     trip automatic growth (command_volume_grow.go analog)."""

@@ -217,6 +217,53 @@ class Store:
                     os.remove(p)
         return bool(popped)
 
+    def unmount_volume(self, vid: int) -> bool:
+        """Close a volume and stop serving it, KEEPING its files on disk
+        (VolumeUnmount analog) — the inverse of mount_volume."""
+        popped = []
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    popped.append(v)
+        for v in popped:
+            v.close()
+        return bool(popped)
+
+    def mount_volume(self, vid: int) -> bool:
+        """(Re)open an unmounted volume from its on-disk files
+        (VolumeMount analog). Returns False when no files exist.
+        Volume() replays the index — potentially minutes — so it runs
+        OUTSIDE the store lock (same discipline as remove_volume)."""
+        import glob as _glob
+
+        target: Optional[tuple[DiskLocation, str]] = None
+        with self._lock:
+            for loc in self.locations:
+                if vid in loc.volumes:
+                    return True  # already mounted
+            for loc in self.locations:
+                for path in _glob.glob(os.path.join(loc.directory, "*.dat")) + _glob.glob(
+                    os.path.join(loc.directory, "*.tierinfo")
+                ):
+                    base = os.path.basename(path).rsplit(".", 1)[0]
+                    parsed = parse_base_name(base)
+                    if parsed is not None and parsed[1] == vid:
+                        target = (loc, parsed[0])
+                        break
+                if target:
+                    break
+        if target is None:
+            return False
+        loc, collection = target
+        v = Volume(loc.directory, vid, collection, needle_map_kind=self.needle_map_kind)
+        with self._lock:
+            if vid in loc.volumes:  # raced with another mount: keep theirs
+                v.close()
+            else:
+                loc.volumes[vid] = v
+        return True
+
     def expired_volume_ids(self) -> list[int]:
         """TTL volumes whose NEWEST write has aged out (the reference
         prunes ttl volumes the same way: .dat mtime is the last append,

@@ -368,3 +368,24 @@ def test_filer_meta_tail_cli(stack, capsys):
     assert any(
         "/taildemo" == _json.loads(l)["directory"] for l in lines
     ), lines
+
+
+def test_filer_copy_cli(stack, tmp_path, capsys):
+    from seaweedfs_tpu.__main__ import main
+
+    master, vs, fs = stack
+    src = tmp_path / "copytree"
+    (src / "sub").mkdir(parents=True)
+    (src / "top.txt").write_bytes(b"root file")
+    (src / "sub" / "leaf.bin").write_bytes(b"x" * 2048)
+    rc = main(
+        ["filer.copy", "-filer", fs.url, str(src), "/copied/"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "2 copied, 0 failed" in out
+    assert fs.read_file(fs.filer.find_entry("/copied/copytree/top.txt")) == b"root file"
+    assert (
+        fs.read_file(fs.filer.find_entry("/copied/copytree/sub/leaf.bin"))
+        == b"x" * 2048
+    )

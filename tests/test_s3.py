@@ -681,3 +681,34 @@ def test_bucket_delete_cleans_staged_uploads(stack):
     code, _, _ = _req(s3, "DELETE", "/stagebkt")
     assert code == 204
     assert s3.filer.lookup("/buckets/.uploads/stagebkt") is None
+
+
+def test_conditional_get_and_bucket_location(stack):
+    s3 = stack
+    _req(s3, "PUT", "/condbkt")
+    code, _, body = _req(s3, "GET", "/condbkt", query="location")
+    assert code == 200 and b"LocationConstraint" in body
+    code, _, _ = _req(s3, "GET", "/ghostbkt", query="location")
+    assert code == 404
+    code, headers, _ = _req(s3, "PUT", "/condbkt/c.txt", b"cache me")
+    etag = headers["ETag"].strip('"')
+    # If-None-Match with the current etag -> 304 with no body
+    code, headers, body = _req(
+        s3, "GET", "/condbkt/c.txt", headers={"If-None-Match": f'"{etag}"'}
+    )
+    assert code == 304 and body == b""
+    code, _, body = _req(
+        s3, "GET", "/condbkt/c.txt", headers={"If-None-Match": '"stale"'}
+    )
+    assert code == 200 and body == b"cache me"
+    # If-Modified-Since in the future -> 304; far past -> 200
+    code, _, _ = _req(
+        s3, "GET", "/condbkt/c.txt",
+        headers={"If-Modified-Since": "Tue, 01 Jan 2030 00:00:00 GMT"},
+    )
+    assert code == 304
+    code, _, body = _req(
+        s3, "GET", "/condbkt/c.txt",
+        headers={"If-Modified-Since": "Mon, 01 Jan 2001 00:00:00 GMT"},
+    )
+    assert code == 200 and body == b"cache me"

@@ -686,3 +686,36 @@ def test_volume_grow(cluster):
     # grown volumes are immediately writable
     res = client.submit(b"to a pre-grown volume", collection="grown")
     assert client.read(res.fid) == b"to a pre-grown volume"
+
+
+def test_volume_unmount_and_mount(cluster):
+    """volume.unmount fences a volume (files kept, dropped from topology);
+    volume.mount brings it back with data intact."""
+    master, servers, client, env = cluster
+    res = client.submit(b"fence me" * 10)
+    vid = int(res.fid.split(",", 1)[0])
+    holder = next(s for s in servers if s.store.get_volume(vid) is not None)
+    run(env, "lock")
+    out = run(env, f"volume.unmount -volumeId {vid} -node {holder.url}")
+    assert "volume.unmount" in out
+    assert holder.store.get_volume(vid) is None  # not serving
+    import os as _os
+    import time as _t
+
+    _t.sleep(0.5)
+    assert all(  # gone from the topology
+        int(v["id"]) != vid
+        for n in env.topology_nodes()
+        for v in n.get("volumes", [])
+    )
+    # files still on disk
+    dat = [
+        p
+        for loc in holder.store.locations
+        for p in _os.listdir(loc.directory)
+        if p.endswith(".dat")
+    ]
+    assert dat
+    out = run(env, f"volume.mount -volumeId {vid} -node {holder.url}")
+    assert "volume.mount" in out
+    assert client.read(res.fid) == b"fence me" * 10
