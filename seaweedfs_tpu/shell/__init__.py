@@ -66,6 +66,7 @@ class CommandEnv:
         self.master_address = master_address
         self.client = MasterClient(master_address)
         self.client_name = client_name
+        self.cwd = "/"  # fs.cd/fs.pwd REPL state; fs.* paths resolve against it
         self._lock_token = 0
         self._renew_stop: Optional[threading.Event] = None
         self._renew_thread: Optional[threading.Thread] = None
@@ -93,6 +94,15 @@ class CommandEnv:
         """Master RPC via MasterClient's single failover/redirect path
         (thread-safe: the lock renewer calls this concurrently)."""
         return self.client.master_call(method, req, timeout=timeout)
+
+    def resolve(self, path: str) -> str:
+        """Resolve an fs.* path argument against the REPL's working
+        directory (fs.cd analog of the reference's shell navigation)."""
+        import posixpath
+
+        if not path.startswith("/"):
+            path = posixpath.join(self.cwd, path)
+        return posixpath.normpath(path)
 
     def filer_client(self):
         """FilerClient for a filer discovered through the master's

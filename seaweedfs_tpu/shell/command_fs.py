@@ -54,9 +54,14 @@ def _positional(args: list[str]) -> list[str]:
     return _split(args)[1]
 
 
+def _rp(env: CommandEnv, paths: list[str]) -> list[str]:
+    """Resolve path args against the REPL working directory (fs.cd)."""
+    return [env.resolve(p) for p in paths]
+
+
 def do_fs_ls(args: list[str], env: CommandEnv, w: TextIO) -> None:
     flags, paths = _split(args, bools={"l"})
-    paths = paths or ["/"]
+    paths = _rp(env, paths or ["."])
     fc = env.filer_client()
     for path in paths:
         entries = fc.list(path, limit=10000)
@@ -82,7 +87,7 @@ register(
 
 
 def do_fs_cat(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    paths = _positional(args)
+    paths = _rp(env, _positional(args))
     if not paths:
         raise ShellError("fs.cat needs a path")
     fc = env.filer_client()
@@ -98,7 +103,7 @@ register(ShellCommand("fs.cat", "fs.cat <path ...>\n\tprint file contents", do_f
 
 
 def do_fs_mkdir(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    paths = _positional(args)
+    paths = _rp(env, _positional(args))
     if not paths:
         raise ShellError("fs.mkdir needs a path")
     fc = env.filer_client()
@@ -112,6 +117,7 @@ register(ShellCommand("fs.mkdir", "fs.mkdir <path ...>\n\tcreate directories", d
 
 def do_fs_rm(args: list[str], env: CommandEnv, w: TextIO) -> None:
     flags, paths = _split(args, bools={"r"})
+    paths = _rp(env, paths)
     if not paths:
         raise ShellError("fs.rm needs a path")
     fc = env.filer_client()
@@ -128,7 +134,7 @@ register(
 
 
 def do_fs_mv(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    paths = _positional(args)
+    paths = _rp(env, _positional(args))
     if len(paths) != 2:
         raise ShellError("fs.mv needs <src> <dst>")
     env.filer_client().rename(paths[0], paths[1])
@@ -139,7 +145,7 @@ register(ShellCommand("fs.mv", "fs.mv <src> <dst>\n\tmove/rename an entry", do_f
 
 
 def do_fs_du(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    paths = _positional(args) or ["/"]
+    paths = _rp(env, _positional(args) or ["."])
     fc = env.filer_client()
 
     def walk(path: str) -> tuple[int, int]:
@@ -168,7 +174,7 @@ def do_fs_meta_save(args: list[str], env: CommandEnv, w: TextIO) -> None:
     flags, roots = _split(args, valued={"o"})
     if not flags["o"]:
         raise ShellError("fs.meta.save needs -o <file>")
-    roots = roots or ["/"]
+    roots = _rp(env, roots or ["."])
     fc = env.filer_client()
     count = 0
     with open(flags["o"], "w", encoding="utf-8") as f:
@@ -225,7 +231,7 @@ register(
 
 def do_fs_tree(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Recursive tree view of the namespace (command_fs_tree.go analog)."""
-    paths = _positional(args) or ["/"]
+    paths = _rp(env, _positional(args) or ["."])
     fc = env.filer_client()
     dirs = files = 0
 
@@ -257,7 +263,7 @@ register(
 def do_fs_meta_cat(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Print one entry's full metadata as JSON (fs.meta.cat analog) —
     chunk list, attributes, extended attrs."""
-    paths = _positional(args)
+    paths = _rp(env, _positional(args))
     if not paths:
         raise ShellError("fs.meta.cat <path ...>")
     fc = env.filer_client()
@@ -287,6 +293,13 @@ def do_fs_configure(args: list[str], env: CommandEnv, w: TextIO) -> None:
         valued={"locationPrefix", "collection", "replication", "ttl"},
     )
     fc = env.filer_client()
+    if flags["locationPrefix"]:
+        # resolve against the REPL cwd like every other fs.* path — a
+        # relative prefix would store a rule that never matches anything
+        pfx = env.resolve(str(flags["locationPrefix"]))
+        if str(flags["locationPrefix"]).endswith("/") and not pfx.endswith("/"):
+            pfx += "/"  # normpath strips the trailing slash prefixes rely on
+        flags["locationPrefix"] = pfx
     if not flags["locationPrefix"]:
         rules = fc.get_filer_conf()
         if not rules:
@@ -325,3 +338,27 @@ register(
         do_fs_configure,
     )
 )
+
+
+def do_fs_cd(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Change the REPL working directory (command_fs_cd.go analog);
+    subsequent relative fs.* paths resolve against it."""
+    paths = _positional(args)
+    target = env.resolve(paths[0] if paths else "/")
+    fc = env.filer_client()
+    if target != "/":
+        e = fc.lookup(target)
+        if e is None or not e.is_directory:
+            raise ShellError(f"{target} is not a directory")
+    env.cwd = target
+    w.write(f"cwd: {target}\n")
+
+
+register(ShellCommand("fs.cd", "fs.cd [dir]\n\tchange the shell working directory", do_fs_cd))
+
+
+def do_fs_pwd(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    w.write(env.cwd + "\n")
+
+
+register(ShellCommand("fs.pwd", "fs.pwd\n\tprint the shell working directory", do_fs_pwd))

@@ -389,3 +389,29 @@ def test_filer_copy_cli(stack, tmp_path, capsys):
         fs.read_file(fs.filer.find_entry("/copied/copytree/sub/leaf.bin"))
         == b"x" * 2048
     )
+
+
+def test_fs_cd_pwd_relative_paths(stack):
+    import io as _io
+
+    import pytest as _pytest
+
+    from seaweedfs_tpu.shell import ShellError
+
+    master, _, fs = stack
+    fs.write_file("/nav/inner/deep.txt", _io.BytesIO(b"navigate"))
+    with CommandEnv(master.address) as env:
+        assert _run(env, "fs.pwd") == "/\n"
+        _run(env, "fs.cd /nav")
+        assert _run(env, "fs.pwd") == "/nav\n"
+        assert "inner/" in _run(env, "fs.ls")           # relative default "."
+        assert _run(env, "fs.cat inner/deep.txt") == "navigate"
+        _run(env, "fs.cd inner")                         # relative cd
+        assert _run(env, "fs.pwd") == "/nav/inner\n"
+        assert _run(env, "fs.cat deep.txt") == "navigate"
+        _run(env, "fs.cd ..")
+        assert _run(env, "fs.pwd") == "/nav\n"
+        with _pytest.raises(ShellError, match="not a directory"):
+            _run(env, "fs.cd inner/deep.txt")
+        _run(env, "fs.cd")                               # bare cd -> /
+        assert _run(env, "fs.pwd") == "/\n"
