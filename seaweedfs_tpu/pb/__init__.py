@@ -73,6 +73,7 @@ class VolumeInformation:
     version: int = 3
     disk_type: str = ""
     garbage_ratio: float = 0.0  # dead fraction of .dat; auto-vacuum signal
+    last_modified: int = 0      # unix secs of the last append (.dat mtime)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -90,6 +91,7 @@ class VolumeInformation:
             ttl=d.get("ttl", ""),
             version=int(d.get("version", 3)),
             disk_type=d.get("disk_type", ""),
+            last_modified=int(d.get("last_modified", 0)),
             garbage_ratio=float(d.get("garbage_ratio", 0.0)),
         )
 

@@ -228,6 +228,7 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
         volumeId=0,
         collection="",
         fullPercent=95.0,
+        quietFor=0,  # seconds since the last write; 0 disables the filter
         force=False,
         largeBlockSize=0,
         smallBlockSize=0,
@@ -249,16 +250,29 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
             raise ShellError(f"volume {fl.volumeId} not found on any node")
         vids = [fl.volumeId]
     else:
-        seen = set()
+        import time as _time
+
+        now = _time.time()
+        # aggregate across replicas FIRST: the quiet check must see the
+        # NEWEST write on any replica — a stale replica's old mtime would
+        # otherwise select a volume that is actively taking writes
+        sizes: dict[int, int] = {}
+        newest: dict[int, int] = {}
         for n in nodes:
             for v in n.get("volumes", []):
-                if int(v["id"]) in seen:
-                    continue
+                vid = int(v["id"])
                 if v.get("collection", "") != fl.collection:
                     continue
-                if fl.force or int(v.get("size", 0)) >= limit * fl.fullPercent / 100.0:
-                    seen.add(int(v["id"]))
-        vids = sorted(seen)
+                sizes[vid] = max(sizes.get(vid, 0), int(v.get("size", 0)))
+                newest[vid] = max(newest.get(vid, 0), int(v.get("last_modified", 0)))
+        vids = sorted(
+            vid
+            for vid, size in sizes.items()
+            if (fl.force or size >= limit * fl.fullPercent / 100.0)
+            # -quietFor: a volume still taking writes must not be EC-frozen
+            # (the reference's default encode safety filter)
+            and not (fl.quietFor and now - newest[vid] < fl.quietFor)
+        )
     if not vids:
         w.write("ec.encode: no matching volumes\n")
         return
@@ -271,6 +285,7 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
             {
                 "collection": fl.collection,
                 "fullPercent": fl.fullPercent,
+                "quietFor": fl.quietFor,
                 "force": bool(fl.force),
             },
         )
@@ -303,8 +318,8 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
 register(
     ShellCommand(
         "ec.encode",
-        "ec.encode -volumeId <id> | -collection <name> [-fullPercent 95] [-force]"
-        " [-checkpoint <file>]\n"
+        "ec.encode -volumeId <id> | -collection <name> [-fullPercent 95] "
+        "[-quietFor <secs>] [-force] [-checkpoint <file>]\n"
         "\tencode a volume into 14 EC shards, spread them, delete the original;\n"
         "\tbatch runs checkpoint per-volume progress and resume on rerun",
         do_ec_encode,

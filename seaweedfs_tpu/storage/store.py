@@ -279,11 +279,8 @@ class Store:
                     ttl_s = v.super_block.ttl.seconds
                     if not ttl_s:
                         continue
-                    try:
-                        mtime = os.path.getmtime(v.dat_path)
-                    except OSError:
-                        continue
-                    if mtime + ttl_s < _time.time():
+                    mtime = v.last_modified()
+                    if mtime and mtime + ttl_s < _time.time():
                         expired.append(vid)
         return expired
 
@@ -307,6 +304,7 @@ class Store:
                 # lock-free snapshot: the heartbeat must not block behind a
                 # long-running compaction's volume lock
                 size, count, garbage = v.stats_snapshot()
+                last_modified = v.last_modified()  # ec.encode -quietFor input
                 out.append(
                     {
                         "id": vid,
@@ -319,6 +317,7 @@ class Store:
                         "version": v.version,
                         "disk_type": "remote" if v.tiered else "",
                         "garbage_ratio": round(garbage, 4),
+                        "last_modified": last_modified,
                     }
                 )
         return out

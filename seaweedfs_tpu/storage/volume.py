@@ -272,10 +272,17 @@ class Volume:
             return False
         import time as _time
 
+        lm = self.last_modified()
+        return bool(lm) and lm + ttl_s < _time.time()
+
+    def last_modified(self) -> int:
+        """Unix seconds of the last append (.dat mtime; 0 when unreadable)
+        — the one definition shared by TTL expiry, heartbeat volume info,
+        and ec.encode's -quietFor filter."""
         try:
-            return os.path.getmtime(self.dat_path) + ttl_s < _time.time()
+            return int(os.path.getmtime(self.dat_path))
         except OSError:
-            return False
+            return 0
 
     def garbage_ratio(self) -> float:
         """Fraction of the .dat body that is dead (deleted/overwritten

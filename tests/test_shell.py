@@ -719,3 +719,18 @@ def test_volume_unmount_and_mount(cluster):
     out = run(env, f"volume.mount -volumeId {vid} -node {holder.url}")
     assert "volume.mount" in out
     assert client.read(res.fid) == b"fence me" * 10
+
+
+def test_ec_encode_quiet_for_filter(cluster):
+    """-quietFor skips volumes with recent writes (the reference's encode
+    safety filter: a volume still taking writes must not be EC-frozen)."""
+    master, servers, client, env = cluster
+    _upload_some(client, n=4)
+    import time as _t
+
+    _t.sleep(0.6)  # heartbeat carries last_modified
+    run(env, "lock")
+    out = run(env, "ec.encode -quietFor 3600 -force")
+    assert "no matching volumes" in out  # everything was just written
+    out = run(env, "ec.encode -force")  # filter disabled: encodes
+    assert "ec.encode volume" in out
