@@ -511,6 +511,45 @@ register(
 # -- ec.balance --------------------------------------------------------------
 
 
+def pick_balance_move(
+    placement: dict[str, dict[int, set]],
+    by_url: dict[str, dict],
+    heaviest: str,
+    lightest: str,
+    colls: dict[int, str],
+    collection_filter: str,
+):
+    """Choose which (vid, shard) to move heaviest -> lightest. Among the
+    volumes with a movable shard, prefer the one whose shards are most
+    CONCENTRATED in the heavy node's rack relative to the light node's —
+    the move then also improves rack spread (command_ec_balance.go
+    balances racks before nodes). Pure so the ordering is unit-testable.
+    Returns (vid, sid) or None."""
+
+    def rack_shards(vid: int, rack: str) -> int:
+        return sum(
+            len(placement[u].get(vid, ()))
+            for u in placement
+            if by_url[u]["rack"] == rack
+        )
+
+    src_rack = by_url[heaviest]["rack"]
+    dst_rack = by_url[lightest]["rack"]
+    candidates = []
+    for vid, sids in placement[heaviest].items():
+        if collection_filter and colls.get(vid, "") != collection_filter:
+            continue
+        movable = sids - placement[lightest].get(vid, set())
+        if not movable:
+            continue
+        spread_gain = rack_shards(vid, src_rack) - rack_shards(vid, dst_rack)
+        candidates.append((-spread_gain, vid, min(movable)))
+    if not candidates:
+        return None
+    _key, vid, sid = min(candidates)
+    return vid, sid
+
+
 def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
     fl = parse_flags(args, collection="")
     env.confirm_locked()
@@ -537,47 +576,40 @@ def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
         lightest, heaviest = urls[0], urls[-1]
         if load(heaviest) - load(lightest) <= 1:
             break
-        # move one shard of some volume from heaviest to lightest
-        moved = False
-        for vid, sids in sorted(placement[heaviest].items()):
-            if fl.collection and colls.get(vid, "") != fl.collection:
-                continue
-            movable = sids - placement[lightest].get(vid, set())
-            if not movable:
-                continue
-            sid = min(movable)
-            collection = colls.get(vid, "")
-            src, dst = by_url[heaviest], by_url[lightest]
-            env.vs_call(
-                grpc_addr(dst),
-                "VolumeEcShardsCopy",
-                {
-                    "volume_id": vid,
-                    "collection": collection,
-                    "shard_ids": [sid],
-                    "source_data_node": grpc_addr(src),
-                    "copy_ecx_file": not placement[lightest].get(vid),
-                },
-            )
-            env.vs_call(
-                grpc_addr(dst),
-                "VolumeEcShardsMount",
-                {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
-            )
-            env.vs_call(
-                grpc_addr(src),
-                "VolumeEcShardsDelete",
-                {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
-            )
-            placement[heaviest][vid].discard(sid)
-            if not placement[heaviest][vid]:
-                del placement[heaviest][vid]
-            placement[lightest].setdefault(vid, set()).add(sid)
-            moves += 1
-            moved = True
+        picked = pick_balance_move(
+            placement, by_url, heaviest, lightest, colls, fl.collection
+        )
+        if picked is None:
             break
-        if not moved:
-            break
+        vid, sid = picked
+        collection = colls.get(vid, "")
+        src, dst = by_url[heaviest], by_url[lightest]
+        env.vs_call(
+            grpc_addr(dst),
+            "VolumeEcShardsCopy",
+            {
+                "volume_id": vid,
+                "collection": collection,
+                "shard_ids": [sid],
+                "source_data_node": grpc_addr(src),
+                "copy_ecx_file": not placement[lightest].get(vid),
+            },
+        )
+        env.vs_call(
+            grpc_addr(dst),
+            "VolumeEcShardsMount",
+            {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+        )
+        env.vs_call(
+            grpc_addr(src),
+            "VolumeEcShardsDelete",
+            {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+        )
+        placement[heaviest][vid].discard(sid)
+        if not placement[heaviest][vid]:
+            del placement[heaviest][vid]
+        placement[lightest].setdefault(vid, set()).add(sid)
+        moves += 1
     w.write(f"ec.balance: moved {moves} shards\n")
 
 

@@ -734,3 +734,75 @@ def test_ec_encode_quiet_for_filter(cluster):
     assert "no matching volumes" in out  # everything was just written
     out = run(env, "ec.encode -force")  # filter disabled: encodes
     assert "ec.encode volume" in out
+
+
+def test_ec_balance_improves_rack_spread(cluster):
+    """ec.balance must prefer moves that spread a volume's shards across
+    racks, not just even per-node counts (failure independence)."""
+    master, servers, client, env = cluster
+    fids = _upload_some(client, n=12)
+    vid = int(fids[0][0].split(",", 1)[0])
+    run(env, "lock")
+    run(env, f"ec.encode -volumeId {vid} -force")
+    # concentrate everything onto rack0's two nodes (racks are i%2)
+    rack0 = [s for i, s in enumerate(servers) if i % 2 == 0]
+    rack1 = [s for i, s in enumerate(servers) if i % 2 == 1]
+    import time as _t
+
+    _t.sleep(0.8)
+    spread = _ec_shard_spread(env, vid)
+    for s in rack1:
+        sids = spread.get(s.url, [])
+        if not sids:
+            continue
+        env.vs_call(
+            rack0[0].grpc_address, "VolumeEcShardsCopy",
+            {"volume_id": vid, "collection": "", "shard_ids": sids,
+             "source_data_node": s.grpc_address, "copy_ecx_file": False},
+        )
+        env.vs_call(
+            rack0[0].grpc_address, "VolumeEcShardsMount",
+            {"volume_id": vid, "collection": "", "shard_ids": sids},
+        )
+        env.vs_call(
+            s.grpc_address, "VolumeEcShardsDelete",
+            {"volume_id": vid, "collection": "", "shard_ids": sids},
+        )
+    _t.sleep(0.8)
+    spread = _ec_shard_spread(env, vid)
+    rack1_before = sum(len(spread.get(s.url, [])) for s in rack1)
+    assert rack1_before == 0  # fully concentrated in rack0
+    run(env, "ec.balance")
+    _t.sleep(0.8)
+    spread = _ec_shard_spread(env, vid)
+    rack1_after = sum(len(spread.get(s.url, [])) for s in rack1)
+    assert rack1_after >= 5, spread  # balance pushed shards back across racks
+    for fid, payload in fids:
+        assert client.read(fid) == payload
+
+
+def test_pick_balance_move_prefers_rack_spread():
+    """Unit-pin the rack-preference ordering: with two candidate volumes,
+    the one concentrated in the heavy node's rack moves first."""
+    from seaweedfs_tpu.shell.command_ec import pick_balance_move
+
+    by_url = {
+        "a:1": {"rack": "r0"},
+        "b:1": {"rack": "r0"},
+        "c:1": {"rack": "r1"},
+    }
+    # vid 7: all shards in rack r0 (concentrated); vid 9: already spread
+    placement = {
+        "a:1": {7: {0, 1, 2}, 9: {0, 1}},
+        "b:1": {7: {3, 4}},
+        "c:1": {9: {2, 3}},
+    }
+    picked = pick_balance_move(placement, by_url, "a:1", "c:1", {}, "")
+    assert picked is not None and picked[0] == 7  # spread gain wins
+    # collection filter excludes vid 7 -> vid 9 is the only candidate
+    picked = pick_balance_move(
+        placement, by_url, "a:1", "c:1", {7: "x", 9: "y"}, "y"
+    )
+    assert picked is not None and picked[0] == 9
+    # nothing movable -> None
+    assert pick_balance_move({"a:1": {}, "c:1": {}}, by_url, "a:1", "c:1", {}, "") is None
