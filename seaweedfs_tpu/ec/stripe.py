@@ -76,6 +76,21 @@ def _encode_rows(
     batch_cap = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
     # iterate segments in global order (row-major, then segment within block)
     pending: list[tuple[int, int]] = []  # (row, seg)
+    # one-deep pipeline (SURVEY §7.1 double buffering): batch N's parity
+    # computes on-device (async dispatch) while batch N+1's disk reads run;
+    # the np.asarray in drain() is the synchronization point
+    inflight: list[tuple[np.ndarray, object]] = []  # [(data, parity_handle)]
+
+    def drain() -> None:
+        if not inflight:
+            return
+        data, parity = inflight.pop()
+        parity_np = np.asarray(parity)
+        for bi in range(data.shape[0]):
+            for s in range(DATA_SHARDS_COUNT):
+                outputs[s].write(data[bi, s].tobytes())
+            for p in range(parity_np.shape[1]):
+                outputs[DATA_SHARDS_COUNT + p].write(parity_np[bi, p].tobytes())
 
     def flush(batch: list[tuple[int, int]]):
         if not batch:
@@ -98,10 +113,9 @@ def _encode_rows(
                 )
                 data[i : j + 1, d] = slab.reshape(nseg, buffer_size)
             i = j + 1
-        stacked = enc.encode_batch(data)
-        for bi in range(len(batch)):
-            for s in range(TOTAL_SHARDS_COUNT):
-                outputs[s].write(stacked[bi, s].tobytes())
+        parity = enc.encode_parity_lazy(data)  # async: returns pre-compute
+        drain()  # materialize + write the PREVIOUS batch while this one runs
+        inflight.append((data, parity))
 
     for row in range(n_rows):
         for seg in range(segs_per_row):
@@ -110,6 +124,7 @@ def _encode_rows(
                 flush(pending)
                 pending = []
     flush(pending)
+    drain()
 
 
 def write_ec_files(

@@ -93,20 +93,27 @@ class Encoder:
 
     # -- kernel dispatch ----------------------------------------------------
 
-    def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
-        """Apply GF matrix m (R x C) to a shard stack (C, N) -> (R, N) or a
-        batched stack (B, C, N) -> (B, R, N)."""
+    def _apply_lazy(self, m: np.ndarray, shards: np.ndarray):
+        """Apply GF matrix m without forcing the result to the host: the
+        jax/pallas backends return a device array (async dispatch), numpy
+        an ndarray. The ONE backend dispatch point — _apply and
+        encode_parity_lazy are both defined in terms of it."""
         if self.backend == "pallas":
             from seaweedfs_tpu.ops import rs_pallas
 
-            return np.asarray(rs_pallas.apply_matrix(m, shards))
+            return rs_pallas.apply_matrix(m, shards)
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_jax
 
-            return np.asarray(rs_jax.apply_matrix(m, shards))
+            return rs_jax.apply_matrix(m, shards)
         if shards.ndim == 3:
             return np.moveaxis(gf8.gf_mat_vec(m, np.moveaxis(shards, 0, 1)), 1, 0)
         return gf8.gf_mat_vec(m, shards)
+
+    def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        """Apply GF matrix m (R x C) to a shard stack (C, N) -> (R, N) or a
+        batched stack (B, C, N) -> (B, R, N), materialized on the host."""
+        return np.asarray(self._apply_lazy(m, shards))
 
     # -- public API (reedsolomon.Encoder parity) ----------------------------
 
@@ -128,10 +135,23 @@ class Encoder:
 
         One device dispatch for the whole batch — the TPU-first replacement
         for the reference's per-segment goroutine loop (SURVEY.md §2.5)."""
+        return np.concatenate(
+            [np.asarray(data, dtype=np.uint8),
+             np.asarray(self.encode_parity_lazy(data))],
+            axis=1,
+        )
+
+    def encode_parity_lazy(self, data: np.ndarray):
+        """Batched parity WITHOUT forcing the result to the host:
+        (B, data_shards, N) -> (B, parity_shards, N) device array (jax/
+        pallas backends) or ndarray (numpy). JAX's async dispatch returns
+        immediately, so the caller can overlap the NEXT batch's disk reads
+        with this batch's device compute (SURVEY §7.1 double buffering);
+        np.asarray() on the result is the synchronization point."""
         data = np.asarray(data, dtype=np.uint8)
         if data.ndim != 3 or data.shape[1] != self.data_shards:
             raise ValueError(f"want (B, {self.data_shards}, N), got {data.shape}")
-        return np.concatenate([data, self._apply(self.parity_matrix, data)], axis=1)
+        return self._apply_lazy(self.parity_matrix, data)
 
     def _pick_survivors(self, shards: Sequence[Optional[np.ndarray]]) -> list[int]:
         present = [i for i, s in enumerate(shards) if s is not None]

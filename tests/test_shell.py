@@ -737,8 +737,9 @@ def test_ec_encode_quiet_for_filter(cluster):
 
 
 def test_ec_balance_improves_rack_spread(cluster):
-    """ec.balance must prefer moves that spread a volume's shards across
-    racks, not just even per-node counts (failure independence)."""
+    """Integration: ec.balance's move path (copy/mount/delete RPCs) spreads
+    a rack-concentrated volume back across racks; the candidate ORDERING
+    itself is pinned by test_pick_balance_move_prefers_rack_spread."""
     master, servers, client, env = cluster
     fids = _upload_some(client, n=12)
     vid = int(fids[0][0].split(",", 1)[0])
