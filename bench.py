@@ -275,6 +275,36 @@ def _measure_reconstruct_latency(tmpdir: str) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _measure_file_encode_e2e(td: str) -> dict:
+    """BASELINE config-1 end-to-end: synthetic .dat file -> 14 shard files
+    through write_ec_files (reads + kernel + writes + pipeline overlap),
+    with the auto backend (native AVX2 on CPU, pallas on TPU)."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec import stripe
+    from seaweedfs_tpu.ops.rs_codec import new_encoder
+
+    size = 128 << 20  # dat bytes; tmpfs-backed in most CI images
+    base = os.path.join(td, "9")
+    rng = np.random.default_rng(5)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    enc = new_encoder()
+    t0 = time.perf_counter()
+    stripe.write_ec_files(
+        base,
+        large_block_size=4 << 20,
+        small_block_size=1 << 20,
+        encoder=enc,
+    )
+    dt = time.perf_counter() - t0
+    return {
+        "file_encode_e2e_gbps": round(size / dt / 1e9, 3),
+        "file_encode_backend": enc.backend,
+        "file_encode_dat_mib": size >> 20,
+    }
+
+
 def mode_cpu() -> None:
     import tempfile
 
@@ -313,6 +343,11 @@ def mode_cpu() -> None:
             out.update(_measure_reconstruct_latency(td))
     except Exception as e:  # noqa: BLE001
         out["reconstruct_error"] = str(e)[:200]
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            out.update(_measure_file_encode_e2e(td))
+    except Exception as e:  # noqa: BLE001
+        out["file_encode_error"] = str(e)[:200]
     _emit(out)
 
 

@@ -190,6 +190,50 @@ void weedtpu_gf_matrix_apply_mt(const uint8_t* matrix, uint32_t rows,
   for (auto& th : pool) th.join();
 }
 
+// Batched apply: `batch` independent stacks sharing one matrix.
+// inputs holds batch*cols slice pointers, outputs batch*rows; workers split
+// over batch elements — one pool for the whole flush instead of one per
+// element, and no host-side repacking (each slice pointer is used as-is).
+void weedtpu_gf_matrix_apply_batch(const uint8_t* matrix, uint32_t rows,
+                                   uint32_t cols,
+                                   const uint8_t* const* inputs,
+                                   uint8_t* const* outputs, uint64_t len,
+                                   uint32_t batch, uint32_t threads) {
+  if (threads == 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    threads = hw ? hw : 1;
+  }
+  if (threads > batch) {
+    // fewer elements than workers: the per-element byte-range split keeps
+    // the whole machine busy (a batch of 2 large stacks on 16 cores would
+    // otherwise run on 2 threads)
+    for (uint32_t b = 0; b < batch; b++)
+      weedtpu_gf_matrix_apply_mt(matrix, rows, cols, inputs + (uint64_t)b * cols,
+                                 outputs + (uint64_t)b * rows, len, threads);
+    return;
+  }
+  uint64_t max_useful = (uint64_t)batch * cols * len / (256 * 1024);
+  if (max_useful < threads) threads = (uint32_t)std::max<uint64_t>(1, max_useful);
+  auto run_span = [&](uint32_t b0, uint32_t b1) {
+    for (uint32_t b = b0; b < b1; b++)
+      gf_matrix_apply_range(matrix, rows, cols, inputs + (uint64_t)b * cols,
+                            outputs + (uint64_t)b * rows, 0, len);
+  };
+  if (threads <= 1) {
+    run_span(0, batch);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  uint32_t per = (batch + threads - 1) / threads;
+  for (uint32_t t = 0; t < threads; t++) {
+    uint32_t b0 = t * per, b1 = std::min(batch, b0 + per);
+    if (b0 >= b1) break;
+    pool.emplace_back(run_span, b0, b1);
+  }
+  for (auto& th : pool) th.join();
+}
+
 int weedtpu_has_avx2() {
 #if defined(__x86_64__)
   return __builtin_cpu_supports("avx2") ? 1 : 0;

@@ -86,6 +86,13 @@ def _encode_rows(
             return
         data, parity = inflight.pop()
         parity_np = np.asarray(parity)
+        if DATA_SHARDS_COUNT + parity_np.shape[1] != len(outputs):
+            # a geometry-mismatched encoder must fail loudly, not leave
+            # trailing .ecNN files silently empty
+            raise ValueError(
+                f"encoder produced {parity_np.shape[1]} parity shards; "
+                f"layout wants {len(outputs) - DATA_SHARDS_COUNT}"
+            )
         for bi in range(data.shape[0]):
             for s in range(DATA_SHARDS_COUNT):
                 outputs[s].write(data[bi, s].tobytes())

@@ -82,9 +82,10 @@ class Encoder:
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
-        if backend not in ("numpy", "jax", "pallas"):
+        if backend not in ("numpy", "native", "jax", "pallas"):
             raise ValueError(
-                f"unknown backend {backend!r} (want 'numpy', 'jax' or 'pallas')"
+                f"unknown backend {backend!r} "
+                "(want 'numpy', 'native', 'jax' or 'pallas')"
             )
         self.matrix_kind = matrix_kind
         self.backend = backend
@@ -95,8 +96,8 @@ class Encoder:
 
     def _apply_lazy(self, m: np.ndarray, shards: np.ndarray):
         """Apply GF matrix m without forcing the result to the host: the
-        jax/pallas backends return a device array (async dispatch), numpy
-        an ndarray. The ONE backend dispatch point — _apply and
+        jax/pallas backends return a device array (async dispatch), numpy/
+        native an ndarray. The ONE backend dispatch point — _apply and
         encode_parity_lazy are both defined in terms of it."""
         if self.backend == "pallas":
             from seaweedfs_tpu.ops import rs_pallas
@@ -106,9 +107,31 @@ class Encoder:
             from seaweedfs_tpu.ops import rs_jax
 
             return rs_jax.apply_matrix(m, shards)
+        if self.backend == "native":
+            out = self._apply_native(m, shards)
+            if out is not None:
+                return out
+            # library unavailable/unbuildable: numpy keeps serving
         if shards.ndim == 3:
             return np.moveaxis(gf8.gf_mat_vec(m, np.moveaxis(shards, 0, 1)), 1, 0)
         return gf8.gf_mat_vec(m, shards)
+
+    @staticmethod
+    def _apply_native(m: np.ndarray, shards: np.ndarray):
+        """C++ AVX2 PSHUFB apply (utils/native, all cores) — ~30x the
+        numpy table path on CPU-only volume servers. None when the
+        library can't load (caller falls back to numpy)."""
+        from seaweedfs_tpu.utils import native as native_mod
+
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if shards.ndim == 2:
+            outs = native_mod.gf_matrix_apply_native(
+                m, list(shards), shards.shape[1], threads=0
+            )
+            return None if outs is None else np.stack(outs)
+        # batched: one library call with per-element slice pointers — one
+        # worker pool for the whole flush and zero host-side repacking
+        return native_mod.gf_matrix_apply_batch_native(m, shards, threads=0)
 
     def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
         """Apply GF matrix m (R x C) to a shard stack (C, N) -> (R, N) or a
@@ -200,8 +223,9 @@ class Encoder:
         return shards
 
     def _bucket_for(self, n: int) -> Optional[int]:
-        if self.backend == "numpy" or n == 0:
-            return None  # numpy has no compile cache to miss
+        if self.backend in ("numpy", "native") or n == 0:
+            return None  # host backends have no compile cache to miss —
+            # padding would only make the AVX2 kernel chew dead bytes
         for b in self.RECONSTRUCT_BUCKETS:
             if n <= b:
                 return b
@@ -224,9 +248,9 @@ class Encoder:
         """Pre-compile the bucketed reconstruct shapes so the first degraded
         read never pays an XLA compile (jit caches key on shapes only — any
         GF matrix of the right shape covers every decode matrix). Returns
-        the number of shapes compiled (0 on the numpy backend)."""
-        if self.backend == "numpy":
-            return 0
+        the number of shapes compiled (0 on the host backends)."""
+        if self.backend in ("numpy", "native"):
+            return 0  # no XLA compile cache to warm
         count = 0
         for L in wanted_counts:
             m = self.gen_matrix[: max(1, L), : self.data_shards]
@@ -287,6 +311,16 @@ class Encoder:
         return np.concatenate([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]]).tobytes()[:out_size]
 
 
+def _cpu_backend() -> str:
+    """Best CPU path: the C++ AVX2 library when it loads, else numpy."""
+    try:
+        from seaweedfs_tpu.utils import native as native_mod
+
+        return "native" if native_mod.load() is not None else "numpy"
+    except Exception:  # noqa: BLE001 — any loader surprise: numpy serves
+        return "numpy"
+
+
 def new_encoder(
     data_shards: int = 10,
     parity_shards: int = 4,
@@ -296,7 +330,8 @@ def new_encoder(
     """Encoder factory — the backend-selection seam (SURVEY.md §1, §7.1 step 5).
 
     backend: "auto" picks the fused Pallas kernel on TPU, the XLA path on
-    other accelerators, numpy on plain CPU; explicit values force a path.
+    other accelerators, and the C++ AVX2 library (numpy if it can't load)
+    on plain CPU — the reference's SIMD role; explicit values force a path.
     """
     if backend == "auto":
         try:
@@ -313,7 +348,7 @@ def new_encoder(
             elif d.platform != "cpu":
                 backend = "jax"
             else:
-                backend = "numpy"
+                backend = _cpu_backend()
         except Exception:
-            backend = "numpy"
+            backend = _cpu_backend()
     return Encoder(data_shards, parity_shards, matrix_kind=matrix_kind, backend=backend)

@@ -150,6 +150,48 @@ def gf_matrix_apply_native(matrix, inputs, length: int, threads: int = 1):
     return out_bufs
 
 
+def gf_matrix_apply_batch_native(matrix, shards, threads: int = 0):
+    """Batched native apply: shards (B, C, N) uint8 -> (B, R, N), one
+    library call (one worker pool over batch elements, zero repacking —
+    the per-element slice pointers index straight into `shards`).
+    Returns None when the library (or the batch symbol) is unavailable."""
+    import numpy as np
+
+    lib = load()
+    if lib is None or not hasattr(lib, "weedtpu_gf_matrix_apply_batch"):
+        return None
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    rows, cols = matrix.shape
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    batch, c, n = shards.shape
+    if c != cols:
+        raise ValueError(f"matrix wants {cols} inputs, stack has {c}")
+    out = np.zeros((batch, rows, n), dtype=np.uint8)
+    InArr = ctypes.c_char_p * (batch * cols)
+    OutArr = ctypes.c_void_p * (batch * rows)
+    base_in = shards.ctypes.data
+    base_out = out.ctypes.data
+    ins = InArr(*[
+        ctypes.c_char_p(base_in + (b * cols + ci) * n)
+        for b in range(batch)
+        for ci in range(cols)
+    ])
+    outs = OutArr(*[
+        base_out + (b * rows + r) * n for b in range(batch) for r in range(rows)
+    ])
+    lib.weedtpu_gf_matrix_apply_batch(
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_uint32(rows),
+        ctypes.c_uint32(cols),
+        ins,
+        outs,
+        ctypes.c_uint64(n),
+        ctypes.c_uint32(batch),
+        ctypes.c_uint32(threads),
+    )
+    return out
+
+
 def has_avx2() -> bool:
     lib = load()
     return bool(lib and lib.weedtpu_has_avx2())

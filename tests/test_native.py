@@ -59,3 +59,27 @@ def test_gf_matrix_apply_mt_matches_single_thread():
         for threads in (0, 2, 3, 8):
             mt = native.gf_matrix_apply_native(pm, ins, n, threads=threads)
             assert all((a == b).all() for a, b in zip(st, mt)), threads
+
+
+def test_gf_matrix_apply_batch_matches_per_stack():
+    """The batched entry point (per-element pointers, one pool) must be
+    byte-identical to per-stack applies."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops import gf8
+    from seaweedfs_tpu.utils import native
+
+    if native.load() is None or not native.has_mt():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    pm = gf8.parity_matrix(10, 4)
+    rng = np.random.default_rng(31)
+    shards = rng.integers(0, 256, (5, 10, 4097), dtype=np.uint8)
+    got = native.gf_matrix_apply_batch_native(pm, shards)
+    assert got is not None and got.shape == (5, 4, 4097)
+    for b in range(5):
+        want = native.gf_matrix_apply_native(
+            pm, [bytes(shards[b, c]) for c in range(10)], 4097
+        )
+        assert all(np.array_equal(got[b, r], want[r]) for r in range(4))
