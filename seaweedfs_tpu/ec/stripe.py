@@ -95,9 +95,11 @@ def _encode_rows(
             )
         for bi in range(data.shape[0]):
             for s in range(DATA_SHARDS_COUNT):
-                outputs[s].write(data[bi, s].tobytes())
+                # contiguous row views write via the buffer protocol —
+                # no tobytes() copy per (batch, shard)
+                outputs[s].write(data[bi, s])
             for p in range(parity_np.shape[1]):
-                outputs[DATA_SHARDS_COUNT + p].write(parity_np[bi, p].tobytes())
+                outputs[DATA_SHARDS_COUNT + p].write(parity_np[bi, p])
 
     def flush(batch: list[tuple[int, int]]):
         if not batch:
@@ -278,7 +280,7 @@ def rebuild_ec_files(
                 shards[s] = read_padded(ins[s], off, n)
             rec = enc.reconstruct(shards, wanted=missing)
             for s in missing:
-                outs[s].write(rec[s].tobytes())
+                outs[s].write(np.ascontiguousarray(rec[s]))  # buffer-protocol write
     return missing
 
 
