@@ -405,3 +405,35 @@ def test_webdav_collection_lock_protects_members(stack):
     assert code == 204
     code, _, _ = _req(base, "PUT", "/treelock/child.txt", b"v3")
     assert code == 201
+
+
+def test_webdav_child_lock_cannot_tunnel_collection_lock(stack):
+    """A client must not bypass an exclusive collection lock by taking its
+    own lock on a child — conflicting LOCK grants are refused in both
+    directions (ancestor and descendant)."""
+    fs, dav, _ = stack
+    base = f"http://{dav.url}"
+    lockinfo = (
+        b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+        b"<D:lockscope><D:exclusive/></D:lockscope>"
+        b"<D:locktype><D:write/></D:locktype></D:lockinfo>"
+    )
+    _req(base, "MKCOL", "/lockcol")
+    _req(base, "PUT", "/lockcol/f.txt", b"v1")
+    code, headers, _ = _req(base, "LOCK", "/lockcol", lockinfo)
+    assert code == 200
+    token = headers["Lock-Token"].strip("<>")
+    # child lock under a locked collection: refused
+    code, _, _ = _req(base, "LOCK", "/lockcol/f.txt", lockinfo)
+    assert code == 423
+    code, _, _ = _req(
+        base, "UNLOCK", "/lockcol", None, {"Lock-Token": f"<{token}>"}
+    )
+    assert code == 204
+    # now the child lock grants; an ancestor lock is then refused
+    code, headers, _ = _req(base, "LOCK", "/lockcol/f.txt", lockinfo)
+    assert code == 200
+    child_token = headers["Lock-Token"].strip("<>")
+    code, _, _ = _req(base, "LOCK", "/lockcol", lockinfo)
+    assert code == 423
+    _req(base, "UNLOCK", "/lockcol/f.txt", None, {"Lock-Token": f"<{child_token}>"})
