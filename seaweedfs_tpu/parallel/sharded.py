@@ -103,14 +103,19 @@ def make_apply_fn(mesh: Mesh, matrix: np.ndarray):
 def make_ec_cycle_fn(mesh: Mesh, parity_m: np.ndarray, recon_m: np.ndarray, lost_ids, survivor_ids):
     """The full-step function the driver dry-runs: encode -> lose shards ->
     reconstruct -> global integrity psum. Exercises dp x sp sharding plus an
-    ICI collective, on one jit.
+    ICI collective, on one jit. On a mesh WITH a 'dcn' axis the batch also
+    shards over it and the reduction is staged: intra-slice psum over ICI
+    axes first, then one scalar psum across 'dcn' — the only thing that
+    crosses DCN (SURVEY §2.6 pod↔pod).
 
     Returns fn(data (B, D, N)) -> (shards (B, T, N), global_mismatches ())."""
     b_enc = _bits(parity_m)
     b_rec = _bits(recon_m)
     lost_ids = tuple(lost_ids)
     survivor_ids = tuple(survivor_ids)
-    spec = P("dp", None, "sp")
+    has_dcn = "dcn" in mesh.axis_names
+    spec = P(("dcn", "dp") if has_dcn else "dp", None, "sp")
+    ici_axes = tuple(a for a in mesh.axis_names if a != "dcn")
 
     @jax.jit
     @functools.partial(
@@ -125,9 +130,10 @@ def make_ec_cycle_fn(mesh: Mesh, parity_m: np.ndarray, recon_m: np.ndarray, lost
         survivors = shards[:, survivor_ids, :]
         rebuilt = rs_jax.gf_apply(b_rec, survivors)
         want = shards[:, lost_ids, :]
-        local_bad = jnp.sum(rebuilt != want)
-        global_bad = jax.lax.psum(local_bad, ("dp", "sp"))
-        return shards, global_bad
+        bad = jax.lax.psum(jnp.sum(rebuilt != want), ici_axes)
+        if has_dcn:
+            bad = jax.lax.psum(bad, "dcn")
+        return shards, bad
 
     return step
 
@@ -135,6 +141,39 @@ def make_ec_cycle_fn(mesh: Mesh, parity_m: np.ndarray, recon_m: np.ndarray, lost
 def shard_batch(mesh: Mesh, data: np.ndarray) -> jax.Array:
     """Place a (B, C, N) host array onto the mesh with B on dp, N on sp."""
     return jax.device_put(data, NamedSharding(mesh, P("dp", None, "sp")))
+
+
+def make_multislice_ec_cycle_fn(
+    mesh: Mesh,
+    parity_m: np.ndarray,
+    recon_m: np.ndarray,
+    lost_ids,
+    survivor_ids,
+):
+    """Host-facing wrapper of make_ec_cycle_fn for a ('dcn', 'dp', 'sp')
+    mesh (SURVEY §2.6 pod↔pod: jax multi-slice over DCN for rack-scale
+    rebuild fan-out). Slices own disjoint volume sub-batches, heavy
+    collectives ride ICI, one scalar crosses DCN — see make_ec_cycle_fn.
+    On hardware, 'dcn' maps to slices (mesh_utils
+    create_hybrid_device_mesh); the CPU test mesh simulates it with the
+    outermost axis, exercising identical sharding/collective structure."""
+    if "dcn" not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no 'dcn' axis")
+    step = make_ec_cycle_fn(mesh, parity_m, recon_m, lost_ids, survivor_ids)
+    spec = P(("dcn", "dp"), None, "sp")
+    batch_div = mesh.shape["dcn"] * mesh.shape["dp"]
+    sp = mesh.shape["sp"]
+
+    def run(data: np.ndarray):
+        b, _c, n = data.shape
+        if b % batch_div:
+            raise ValueError(f"batch {b} must divide evenly over dcn*dp={batch_div}")
+        if n % sp:
+            raise ValueError(f"shard length {n} must divide evenly over sp={sp}")
+        x = jax.device_put(data, NamedSharding(mesh, spec))
+        return step(x)
+
+    return run
 
 
 def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
