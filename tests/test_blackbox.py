@@ -67,7 +67,9 @@ def stack(tmp_path_factory):
     (tmp / "meta").mkdir()
     procs = []
     try:
-        procs.append(_spawn(["master", "-port", "29333"], str(tmp)))
+        procs.append(
+            _spawn(["master", "-port", "29333", "-httpPort", "29433"], str(tmp))
+        )
         time.sleep(1)
         procs.append(
             _spawn(
@@ -210,3 +212,26 @@ def test_filer_restart_preserves_namespace(stack):
             p.wait(10)
         except subprocess.TimeoutExpired:
             p.kill()
+
+
+def test_master_http_api_across_processes(stack):
+    """The reference curl workflow against a REAL master process:
+    /dir/assign -> POST the blob to the assigned volume server ->
+    /dir/lookup resolves it -> /cluster/healthz answers."""
+    import json as _json
+
+    code, body = _http("GET", "http://127.0.0.1:29433/dir/assign")
+    assert code == 200, body
+    assign = _json.loads(body)
+    assert assign["fid"] and assign["url"]
+    code, _ = _http(
+        "POST", f"http://{assign['url']}/{assign['fid']}", b"curl workflow"
+    )
+    assert code in (200, 201)
+    vid = assign["fid"].split(",", 1)[0]
+    code, body = _http("GET", f"http://127.0.0.1:29433/dir/lookup?volumeId={vid}")
+    assert code == 200 and assign["url"] in body.decode()
+    code, body = _http("GET", f"http://{assign['url']}/{assign['fid']}")
+    assert code == 200 and body == b"curl workflow"
+    code, _ = _http("GET", "http://127.0.0.1:29433/cluster/healthz")
+    assert code == 200
