@@ -278,7 +278,8 @@ def _measure_reconstruct_latency(tmpdir: str) -> dict:
 def _measure_file_encode_e2e(td: str) -> dict:
     """BASELINE config-1 end-to-end: synthetic .dat file -> 14 shard files
     through write_ec_files (reads + kernel + writes + pipeline overlap),
-    with the auto backend (native AVX2 on CPU, pallas on TPU)."""
+    with the auto backend (native AVX2 on CPU, XLA bit-plane on TPU —
+    the measured-fastest path per DEVICE_MEASUREMENT_r04)."""
     import numpy as np
 
     from seaweedfs_tpu.ec import stripe

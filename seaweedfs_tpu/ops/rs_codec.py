@@ -329,9 +329,16 @@ def new_encoder(
 ) -> Encoder:
     """Encoder factory — the backend-selection seam (SURVEY.md §1, §7.1 step 5).
 
-    backend: "auto" picks the fused Pallas kernel on TPU, the XLA path on
-    other accelerators, and the C++ AVX2 library (numpy if it can't load)
-    on plain CPU — the reference's SIMD role; explicit values force a path.
+    backend: "auto" picks the measured-fastest device path on TPU, the XLA
+    path on other accelerators, and the C++ AVX2 library (numpy if it can't
+    load) on plain CPU — the reference's SIMD role; explicit values force a
+    path.
+
+    On TPU, auto resolves to the XLA bit-plane path: on-chip measurement
+    (artifacts/DEVICE_MEASUREMENT_r04.json) has XLA at 31-32 GB/s steady
+    vs the fused Pallas kernel's 18.7. Production must never select the
+    slower kernel; flip this back only with a newer committed measurement
+    where Pallas wins. backend="pallas" still forces the fused kernel.
     """
     if backend == "auto":
         try:
@@ -344,7 +351,7 @@ def new_encoder(
             honor_platform_env()
             d = jax.devices()[0]
             if is_tpu_device(d):
-                backend = "pallas"
+                backend = "jax"
             elif d.platform != "cpu":
                 backend = "jax"
             else:

@@ -172,3 +172,20 @@ def test_auto_backend_on_cpu_prefers_native():
     if native_mod.load() is None:
         pytest.skip("native library unavailable")
     assert new_encoder().backend == "native"  # conftest pins cpu
+
+
+def test_auto_backend_on_tpu_prefers_measured_fastest(monkeypatch):
+    """On TPU, auto must resolve to the XLA bit-plane path, not pallas:
+    on-chip measurement (artifacts/DEVICE_MEASUREMENT_r04.json) has XLA at
+    31-32 GB/s steady vs pallas 18.7. Guard against a regression that
+    re-selects the slower kernel in production."""
+    import jax
+
+    from seaweedfs_tpu.ops.rs_codec import new_encoder
+
+    class _FakeTpu:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeTpu()])
+    assert new_encoder().backend == "jax"
