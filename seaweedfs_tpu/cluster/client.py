@@ -103,9 +103,15 @@ class MasterClient:
                 detail = e.details() or ""
                 if (
                     e.code() == _grpc.StatusCode.FAILED_PRECONDITION
-                    and "not the raft leader; leader is " in detail
+                    and "not the raft leader" in detail
                 ):
-                    leader = detail.rsplit("leader is ", 1)[1].strip()
+                    # "…; leader is <addr>" when one is known; an election
+                    # in flight says "no leader elected yet" — keep trying
+                    leader = (
+                        detail.rsplit("leader is ", 1)[1].strip()
+                        if "leader is " in detail
+                        else ""
+                    )
                     if leader and leader not in tried:
                         candidates.append(leader)
                     last_err = e

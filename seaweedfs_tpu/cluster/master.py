@@ -172,7 +172,10 @@ class MasterServer:
         return self.raft.leader or ""
 
     def _not_leader_response(self) -> dict:
-        return {"error": "not the raft leader", "leader": self._leader_address()}
+        # "Leader" (capitalized) rides along for curl-level clients that
+        # follow the reference's HTTP error shape
+        addr = self._leader_address()
+        return {"error": "not the raft leader", "leader": addr, "Leader": addr}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -311,10 +314,7 @@ class MasterServer:
                 "collection name required", code=grpc.StatusCode.INVALID_ARGUMENT
             )
         if not self.is_leader:
-            raise rpc.RpcFault(
-                f"not the raft leader; leader is {self._leader_address()}",
-                code=grpc.StatusCode.FAILED_PRECONDITION,
-            )
+            raise rpc.NotLeaderFault(self._leader_address())
         with self.topology._lock:
             by_addr: dict[str, list[tuple[int, str]]] = {}
             for node in self.topology.nodes.values():
@@ -368,10 +368,7 @@ class MasterServer:
         """Pre-allocate volumes for a (collection, replication, ttl) layout
         without waiting for an Assign to trip growth (volume.grow analog)."""
         if not self.is_leader:
-            raise rpc.RpcFault(
-                f"not the raft leader; leader is {self._leader_address()}",
-                code=grpc.StatusCode.FAILED_PRECONDITION,
-            )
+            raise rpc.NotLeaderFault(self._leader_address())
         collection = req.get("collection", "")
         replication = req.get("replication") or self.default_replication
         ttl = req.get("ttl", "")
@@ -456,10 +453,7 @@ class MasterServer:
 
     def _rpc_lease_admin_token(self, req: dict, ctx) -> dict:
         if not self.is_leader:
-            raise rpc.RpcFault(
-                f"not the raft leader; leader is {self._leader_address()}",
-                code=grpc.StatusCode.FAILED_PRECONDITION,
-            )
+            raise rpc.NotLeaderFault(self._leader_address())
         name = req.get("lock_name", "admin")
         prev = int(req.get("previous_token", 0))
         now = time.monotonic()
@@ -503,10 +497,7 @@ class MasterServer:
         if not self.is_leader:
             # must land on the leader: a follower-local delete is lost and
             # the replicated lock table keeps the cluster locked till TTL
-            raise rpc.RpcFault(
-                f"not the raft leader; leader is {self._leader_address()}",
-                code=grpc.StatusCode.FAILED_PRECONDITION,
-            )
+            raise rpc.NotLeaderFault(self._leader_address())
         name = req.get("lock_name", "admin")
         prev = int(req.get("previous_token", 0))
         with self._admin_lock_mu:
@@ -754,6 +745,10 @@ class _MasterHttpHandler(httpd.QuietHandler):
                 }
                 if resp.get("error"):
                     out["error"] = resp["error"]
+                    # follower answering: name the leader so curl-level
+                    # clients can fail over (reference HTTP error shape)
+                    if resp.get("Leader") or resp.get("leader"):
+                        out["Leader"] = resp.get("Leader") or resp["leader"]
                 if resp.get("auth"):
                     out["auth"] = resp["auth"]
                 self._json(200, out)
@@ -847,6 +842,12 @@ class _MasterHttpHandler(httpd.QuietHandler):
                 self.send_reply(200, html.encode(), "text/html; charset=utf-8")
             else:
                 self._json(404, {"error": f"unknown path {path}"})
+        except rpc.NotLeaderFault as e:
+            # the reference's HTTP masters answer follower hits with the
+            # leader in the JSON shape so curl-level clients can fail over
+            # ([ref: weed/server/master_server_handlers_admin.go — mount
+            # empty]); a bare 412 left HA clients with an opaque failure
+            self._json(200, {"error": e.detail, "Leader": e.leader})
         except rpc.RpcFault as e:
             self._json(412, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — facade must not kill keep-alive

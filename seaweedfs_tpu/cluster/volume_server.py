@@ -43,6 +43,7 @@ from seaweedfs_tpu.security import tls
 
 _COPY_CHUNK = 1024 * 1024
 _EC_EXTS = [".ecx", ".ecj", ".eci"]
+EC_SHARD_READ_TIMEOUT = 10.0  # s; per-holder cap on one interval read
 
 
 class VolumeServer:
@@ -295,6 +296,12 @@ class VolumeServer:
                                 "offset": offset,
                                 "size": size,
                             },
+                            # one interval, not a bulk copy: a hung holder
+                            # must not pin a degraded read for the 600s
+                            # bulk-stream default — the recover fan-out
+                            # treats a timeout as a miss and uses another
+                            # survivor
+                            timeout=EC_SHARD_READ_TIMEOUT,
                         )
                         buf = b"".join(chunks)
                         if len(buf) == size:
@@ -347,6 +354,7 @@ class VolumeServer:
         add("VolumeUnmount", self._rpc_volume_unmount)
         add("VolumeConfigure", self._rpc_volume_configure)
         add("VolumeNeedleIds", self._rpc_needle_ids)
+        add("VolumeNeedleTs", self._rpc_needle_ts)
         add("ReadNeedle", self._rpc_read_needle)
         add("VolumeServerLeave", self._rpc_server_leave)
         return svc
@@ -583,6 +591,9 @@ class VolumeServer:
             # repair must round-trip them verbatim
             "name_b64": base64.b64encode(n.name or b"").decode(),
             "mime_b64": base64.b64encode(n.mime or b"").decode(),
+            # volume.fsck's -cutoffTimeAgo filter reads this to spare
+            # needles written while the check was running
+            "append_at_ns": n.append_at_ns,
         }
 
     def _rpc_volume_mount(self, req: dict, ctx) -> dict:
@@ -632,6 +643,16 @@ class VolumeServer:
             return {"deleted": rows, "deleted_truncated": truncated}
         entries, truncated = v.needle_entries_page(int(req.get("start_from", 0)), limit)
         return {"entries": entries, "truncated": truncated}
+
+    def _rpc_needle_ts(self, req: dict, ctx) -> dict:
+        """Batch append_at_ns lookup (8-byte read per needle, no payload)
+        — volume.fsck's -cutoffTimeAgo filter dates orphan candidates with
+        one RPC per volume instead of a full ReadNeedle per orphan."""
+        v = self.store.get_volume(int(req["volume_id"]))
+        if v is None:
+            raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
+        ts = v.needle_append_ts([int(n) for n in req.get("needle_ids", [])])
+        return {"ts": {str(k): v_ for k, v_ in ts.items()}}
 
     def _rpc_server_leave(self, req: dict, ctx) -> dict:
         """Stop heartbeating and depart the master's topology

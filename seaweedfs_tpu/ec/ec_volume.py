@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Optional
 
 import numpy as np
@@ -57,11 +58,19 @@ class EcVolume:
         shard_size: Optional[int] = None,
         warm_on_mount: bool = True,
         ecj_compact_threshold: int = 1 << 20,
+        recover_fetch_parallelism: int = 8,
+        recover_fetch_deadline: float = 30.0,
     ):
         self.base = base_file_name
         self.encoder = encoder or new_encoder()
         self.remote_reader = remote_reader
         self.version = version
+        # degraded-read survivor fan-out (lazily built: most volumes never
+        # take a reconstructing read, and a pool per mount would leak threads)
+        self.recover_fetch_parallelism = recover_fetch_parallelism
+        self.recover_fetch_deadline = recover_fetch_deadline
+        self._fetch_pool: Optional[ThreadPoolExecutor] = None
+        self._fetch_pool_lock = threading.Lock()
         # recorded stripe geometry (.eci) wins over constructor defaults —
         # opening shards with the wrong geometry would mis-map every interval
         info = stripe.read_ec_info(base_file_name)
@@ -130,6 +139,10 @@ class EcVolume:
         for f in self._shard_files.values():
             f.close()
         self._shard_files.clear()
+        with self._fetch_pool_lock:
+            pool, self._fetch_pool = self._fetch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def __enter__(self):
         return self
@@ -194,7 +207,10 @@ class EcVolume:
         if data is not None:
             return data
         if self.remote_reader is not None:
-            raw = self.remote_reader(shard_id, offset, size)
+            try:
+                raw = self.remote_reader(shard_id, offset, size)
+            except Exception:  # noqa: BLE001 — a down holder is a miss,
+                raw = None  # not a failed read: survivors can still serve it
             if raw is not None:
                 return np.frombuffer(raw, dtype=np.uint8).copy()
         return self._recover_interval(shard_id, offset, size)
@@ -210,6 +226,15 @@ class EcVolume:
         finally:
             stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
 
+    def _fetch_executor(self) -> ThreadPoolExecutor:
+        with self._fetch_pool_lock:
+            if self._fetch_pool is None:
+                self._fetch_pool = ThreadPoolExecutor(
+                    max_workers=self.recover_fetch_parallelism,
+                    thread_name_prefix=f"ec-fetch-{os.path.basename(self.base)}",
+                )
+            return self._fetch_pool
+
     def _recover_interval_inner(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
         have = 0
@@ -221,14 +246,47 @@ class EcVolume:
             if buf is not None:
                 shards[s] = buf
                 have += 1
-        if self.remote_reader is not None:
-            for s in range(TOTAL_SHARDS_COUNT):
-                if s == shard_id or shards[s] is not None or have >= DATA_SHARDS_COUNT:
-                    continue
-                raw = self.remote_reader(s, offset, size)
-                if raw is not None and len(raw) == size:
-                    shards[s] = np.frombuffer(raw, dtype=np.uint8).copy()
-                    have += 1
+        need = DATA_SHARDS_COUNT - have
+        if need > 0 and self.remote_reader is not None:
+            # Fan out to ALL remaining survivors at once and take the first
+            # `need` arrivals — the reference reads the same interval from
+            # >=10 shards with parallel goroutines
+            # (recoverOneRemoteEcShardInterval [ref: weed/storage/
+            # store_ec.go — mount empty, SURVEY.md §3.2]); serial fetches
+            # cost one RTT per survivor and dominated the reconstruct p50.
+            # Late arrivals beyond `need` are ignored; a hung peer is cut by
+            # the overall deadline rather than stalling the read forever.
+            candidates = [
+                s
+                for s in range(TOTAL_SHARDS_COUNT)
+                if s != shard_id and shards[s] is None
+            ]
+            pool = self._fetch_executor()
+            futs = {
+                pool.submit(self.remote_reader, s, offset, size): s
+                for s in candidates
+            }
+            pending = set(futs)
+            import time as _time
+
+            deadline = _time.monotonic() + self.recover_fetch_deadline
+            while pending and have < DATA_SHARDS_COUNT:
+                budget = deadline - _time.monotonic()
+                if budget <= 0:
+                    break
+                done, pending = wait(pending, timeout=budget, return_when=FIRST_COMPLETED)
+                if not done:
+                    break
+                for fut in done:
+                    try:
+                        raw = fut.result()
+                    except Exception:  # noqa: BLE001 — a failed peer is a miss
+                        raw = None
+                    if raw is not None and len(raw) == size:
+                        shards[futs[fut]] = np.frombuffer(raw, dtype=np.uint8).copy()
+                        have += 1
+            for fut in pending:
+                fut.cancel()
         if have < DATA_SHARDS_COUNT:
             raise IOError(
                 f"shard {shard_id}: only {have} surviving shards reachable, need {DATA_SHARDS_COUNT}"

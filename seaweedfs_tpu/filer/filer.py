@@ -31,13 +31,7 @@ from seaweedfs_tpu.filer.store import EntryNotFound, FilerStore
 _META_RING = 8192
 
 
-def _prefix_match(directory: str, prefix: str) -> bool:
-    """Path-boundary prefix match: '/data' matches '/data' and '/data/x'
-    but not '/database'."""
-    if prefix == "/":
-        return True
-    prefix = prefix.rstrip("/")
-    return directory == prefix or directory.startswith(prefix + "/")
+from seaweedfs_tpu.filer.filer_conf import path_prefix_match as _prefix_match
 
 
 @dataclass
@@ -320,7 +314,8 @@ class Filer:
                 continue
             pre = rule.location_prefix
             pre_dir = pre.rstrip("/") or "/"
-            inside = path.startswith(pre) or p == pre_dir
+            # segment-boundary match: '/frozen' must not freeze '/frozen2'
+            inside = _prefix_match(p, pre_dir)
             contains = subtree and (
                 p == "/" or pre_dir == p or pre_dir.startswith(p + "/")
             )

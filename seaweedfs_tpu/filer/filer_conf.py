@@ -20,6 +20,16 @@ from typing import Optional
 CONF_KEY = "filer.conf"
 
 
+def path_prefix_match(path: str, prefix: str) -> bool:
+    """Path-boundary prefix match: '/data' matches '/data' and '/data/x'
+    but not '/database' — the ONE spelling of this rule shared by rule
+    matching, read-only enforcement, and meta-event subscriptions."""
+    if prefix == "/":
+        return True
+    prefix = prefix.rstrip("/")
+    return path == prefix or path.startswith(prefix + "/")
+
+
 @dataclass
 class PathConf:
     location_prefix: str
@@ -47,18 +57,28 @@ class FilerConf:
     rules: list[PathConf] = field(default_factory=list)
 
     def match(self, path: str) -> Optional[PathConf]:
-        """Longest matching location_prefix wins (filer_conf.go semantics)."""
+        """Longest matching location_prefix wins (filer_conf.go semantics).
+
+        Prefixes match on path-segment boundaries: a rule stored as
+        /buckets/logs (the shell keeps the trailing slash only if the
+        operator typed one) governs /buckets/logs and /buckets/logs/x but
+        never the sibling /buckets/logs2/x — raw startswith would apply
+        collection/TTL/read-only policy to the wrong subtree."""
         best: Optional[PathConf] = None
         for r in self.rules:
-            if path.startswith(r.location_prefix) and (
+            if path_prefix_match(path, r.location_prefix or "/") and (
                 best is None or len(r.location_prefix) > len(best.location_prefix)
             ):
                 best = r
         return best
 
     def upsert(self, rule: PathConf) -> None:
-        self.delete(rule.location_prefix)
-        self.rules.append(rule)
+        # single atomic rebind: request threads iterate self.rules without a
+        # lock, and a delete-then-append window would let a mutation slip
+        # past an updated read-only rule
+        self.rules = [
+            r for r in self.rules if r.location_prefix != rule.location_prefix
+        ] + [rule]
 
     def delete(self, location_prefix: str) -> bool:
         before = len(self.rules)

@@ -73,7 +73,9 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
         # the loop carry varies per device (each chip accumulates its own
         # tile) — mark the unvarying zeros init accordingly or the scan
         # carry types mismatch under shard_map's varying-axes checks
-        if hasattr(jax.lax, "pvary"):
+        if hasattr(jax.lax, "pcast"):  # jax>=0.9 spelling
+            acc0 = jax.lax.pcast(acc0, ("dp", "sp"), to="varying")
+        elif hasattr(jax.lax, "pvary"):  # deprecated predecessor
             acc0 = jax.lax.pvary(acc0, ("dp", "sp"))
 
         def body(k, carry):

@@ -66,6 +66,19 @@ class NotFoundFault(RpcFault):
         super().__init__(detail, grpc.StatusCode.NOT_FOUND)
 
 
+class NotLeaderFault(RpcFault):
+    """Raised by a raft follower for leader-only operations; carries the
+    current leader so facades can point clients at it in a structured way
+    instead of burying the address in free text."""
+
+    def __init__(self, leader: str):
+        detail = f"not the raft leader; leader is {leader}" if leader else (
+            "not the raft leader; no leader elected yet"
+        )
+        super().__init__(detail, grpc.StatusCode.FAILED_PRECONDITION)
+        self.leader = leader
+
+
 class Method:
     def __init__(
         self,

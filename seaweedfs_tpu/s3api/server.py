@@ -25,6 +25,7 @@ when a sibling name extends a directory name with a byte < '/'.
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import re
 import threading
@@ -943,16 +944,29 @@ class _Handler(httpd.QuietHandler):
         # (optionally a range) instead of the request body
         copy_src = self.headers.get("x-amz-copy-source", "")
         was_copy = bool(copy_src)
+        src_resp = None
+        put_headers: dict[str, str] = {}
         if was_copy:
-            body = self._read_copy_source(copy_src, identity)
-            if body is None:
+            opened = self._open_copy_source(copy_src, identity)
+            if opened is None:
                 return  # error already replied
+            # stream the source straight through to the staging path: parts
+            # can be up to 5 GiB and buffering one in gateway memory is an
+            # OOM (r4 advisor finding) — urllib takes a file-like body when
+            # the length is pinned by an explicit Content-Length
+            src_resp, length = opened
+            body = src_resp
+            put_headers["Content-Length"] = str(length)
         path = f"{self._upload_dir(bucket, upload_id)}/part{part:05d}"
-        req = urllib.request.Request(
-            self.s3.filer_url(path), data=body, method="PUT"
-        )
-        with tls.urlopen(req, timeout=60) as r:
-            meta = json.loads(r.read())
+        try:
+            req = urllib.request.Request(
+                self.s3.filer_url(path), data=body, headers=put_headers, method="PUT"
+            )
+            with tls.urlopen(req, timeout=600 if was_copy else 60) as r:
+                meta = json.loads(r.read())
+        finally:
+            if src_resp is not None:
+                src_resp.close()
         etag = meta.get("etag", "")
         if was_copy:  # CopyPartResult body, per the API shape
             root = _xml("CopyPartResult")
@@ -962,10 +976,11 @@ class _Handler(httpd.QuietHandler):
         else:
             self._reply(200, headers={"ETag": f'"{etag}"'})
 
-    def _read_copy_source(self, src: str, identity) -> Optional[bytes]:
-        """Resolve x-amz-copy-source [+ x-amz-copy-source-range] to bytes
-        for UploadPartCopy (shared parse/auth/existence via
-        _resolve_copy_source). Replies the error itself; None on failure."""
+    def _open_copy_source(self, src: str, identity):
+        """Resolve x-amz-copy-source [+ x-amz-copy-source-range] to an OPEN
+        streaming response for UploadPartCopy (shared parse/auth/existence
+        via _resolve_copy_source) -> (file-like, length). The caller owns
+        closing it. Replies the error itself; None on failure."""
         resolved = self._resolve_copy_source(src, identity)
         if resolved is None:
             return None
@@ -975,14 +990,21 @@ class _Handler(httpd.QuietHandler):
         if rng:
             headers["Range"] = rng
         try:
-            with tls.urlopen(
+            r = tls.urlopen(
                 urllib.request.Request(
                     self.s3.filer_url(self.s3.object_path(s_bucket, s_key)),
                     headers=headers,
                 ),
-                timeout=60,
-            ) as r:
-                return r.read()
+                timeout=600,
+            )
+            length = r.headers.get("Content-Length")
+            if length is None:
+                # a filer that doesn't pin the length forces a buffered
+                # fallback — urllib needs Content-Length for file-like data
+                buf = r.read()
+                r.close()
+                return io.BytesIO(buf), len(buf)
+            return r, int(length)
         except urllib.error.HTTPError as e:
             if e.code == 416:
                 self._error(416, "InvalidRange")

@@ -5,6 +5,7 @@ mirroring weed/shell/command_volume_*.go and command_collection_list.go
 
 from __future__ import annotations
 
+import time
 from typing import TextIO
 
 from seaweedfs_tpu.ec.shard_bits import ShardBits
@@ -1102,14 +1103,47 @@ def _referenced_needles(env: CommandEnv, w: TextIO) -> dict[int, set[int]]:
     return refs
 
 
+def _orphans_after_cutoff(
+    env: CommandEnv, holders: list[dict], vid: int, nids: list[int], cutoff_ns: int
+) -> set[int]:
+    """The subset of `nids` appended after the cutoff on ANY replica — a
+    post-cutoff copy on one divergent holder is enough to spare the needle
+    everywhere (the delete loop hits every holder). Needles no reachable
+    holder can date are spared too. One batched VolumeNeedleTs per holder;
+    pre-ts (v2) needles report 0 and stay deletable: the cutoff protects
+    in-flight uploads, which land on current-version volumes."""
+    newest: dict[int, int] = {}
+    answered = False
+    for h in holders:
+        try:
+            resp = env.vs_call(
+                grpc_addr(h), "VolumeNeedleTs", {"volume_id": vid, "needle_ids": nids}
+            )
+        except Exception:  # noqa: BLE001 — holder down: others may answer
+            continue
+        answered = True
+        for k, ts in resp.get("ts", {}).items():
+            nid = int(k)
+            newest[nid] = max(newest.get(nid, 0), int(ts or 0))
+    if not answered:
+        return set(nids)
+    return {nid for nid in nids if newest.get(nid, 0) > cutoff_ns}
+
+
 def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
     """Cross-check filer chunk references against volume contents
     (command_volume_fsck.go analog): needles no entry references are
     orphans (reclaimable), references with no needle are data loss.
     Report-only unless -reallyDeleteFromVolume. EC volumes are skipped
     (their needles are audited via the .ecx path at ec.encode time)."""
-    fl = parse_flags(args, volumeId=0, reallyDeleteFromVolume=False)
+    fl = parse_flags(args, volumeId=0, reallyDeleteFromVolume=False, cutoffTimeAgo=300)
     env.confirm_locked()
+    # An upload racing the run (chunks written before the volume scan, filer
+    # entry created after the walk) looks exactly like an orphan; the
+    # reference guards this with -cutoffTimeAgo [ref: weed/shell/
+    # command_volume_fsck.go — mount empty, SURVEY §2.1]. Record the cutoff
+    # BEFORE the scan so every needle appended after it is spared.
+    cutoff_ns = int((time.time() - max(fl.cutoffTimeAgo, 0)) * 1e9)
     nodes = env.topology_nodes()
     # Scan the volumes BEFORE walking the filer: a file uploaded mid-run
     # then has its needles absent from `stored` (never an orphan, so never
@@ -1150,6 +1184,18 @@ def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
         orphans = set(have) - want
         missing = want - set(have)
         if orphans:
+            # date candidates in BOTH modes so the report an operator sizes
+            # a cleanup from agrees with what a purge would actually delete
+            fresh = _orphans_after_cutoff(
+                env, holders_of[vid], vid, sorted(orphans), cutoff_ns
+            )
+            for nid in sorted(fresh):
+                w.write(
+                    f"volume {vid}: needle {nid:x} appended after the "
+                    f"cutoff — spared (likely an upload in flight)\n"
+                )
+            orphans -= fresh
+        if orphans:
             size = sum(have[i] for i in orphans)
             orphan_count += len(orphans)
             orphan_bytes += size
@@ -1178,8 +1224,9 @@ def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
 register(
     ShellCommand(
         "volume.fsck",
-        "volume.fsck [-volumeId <id>] [-reallyDeleteFromVolume]\n\tcross-check filer "
-        "chunk references against volume needles; report (or purge) orphans",
+        "volume.fsck [-volumeId <id>] [-reallyDeleteFromVolume] "
+        "[-cutoffTimeAgo <secs>]\n\tcross-check filer chunk references against "
+        "volume needles; report (or purge) orphans older than the cutoff",
         do_volume_fsck,
     )
 )

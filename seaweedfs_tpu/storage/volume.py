@@ -237,6 +237,33 @@ class Volume:
                     break
             return out, len(out) >= limit
 
+    def needle_append_ts(self, needle_ids: list[int]) -> dict[int, int]:
+        """append_at_ns for each requested LIVE needle, 0 when the volume
+        predates v3 timestamps, absent when the needle isn't in the map.
+        One 8-byte read per needle — the ts sits at a fixed position
+        (header + body + checksum) — so volume.fsck's cutoff filter never
+        pays a full-payload ReadNeedle per orphan."""
+        out: dict[int, int] = {}
+        with self._lock:
+            for nid in needle_ids:
+                loc = self.nm.get(nid)
+                if loc is None:
+                    continue
+                if self.version < 3:
+                    out[nid] = 0
+                    continue
+                stored, size = loc
+                pos = (
+                    types.offset_to_actual(stored)
+                    + types.NEEDLE_HEADER_SIZE
+                    + max(size, 0)
+                    + types.NEEDLE_CHECKSUM_SIZE
+                )
+                self._dat.seek(pos)
+                raw = self._dat.read(8)
+                out[nid] = int.from_bytes(raw, "big") if len(raw) == 8 else 0
+        return out
+
     def tombstone_history(self, start: int = 0, limit: int = 0) -> tuple[list[list[int]], bool]:
         """Ids (ascending from `start`) with a tombstone anywhere in the
         .idx history, each paired with whether the FINAL state is deleted

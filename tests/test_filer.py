@@ -491,3 +491,42 @@ def test_chunk_cache_serves_filer_rereads(stack):
     assert got == payload
     assert reads["n"] == 0, "re-read went to the volume tier despite cache"
     assert cache.hits > h0
+
+
+def test_filer_conf_matches_on_segment_boundaries():
+    """A rule stored without a trailing slash ('/buckets/logs') must govern
+    its subtree only — raw startswith would also hit the sibling
+    '/buckets/logs2/x' and apply collection/TTL/read-only policy to the
+    wrong tree (r4 advisor finding)."""
+    from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
+
+    conf = FilerConf()
+    conf.upsert(PathConf(location_prefix="/buckets/logs", collection="logs"))
+    conf.upsert(PathConf(location_prefix="/buckets/logs/hot/", ttl="1d"))
+    assert conf.match("/buckets/logs").collection == "logs"
+    assert conf.match("/buckets/logs/a.txt").collection == "logs"
+    assert conf.match("/buckets/logs/hot/x").ttl == "1d"  # longest wins
+    assert conf.match("/buckets/logs2/x") is None
+    assert conf.match("/buckets/logsx") is None
+    # a root rule still matches everything
+    conf.upsert(PathConf(location_prefix="/", replication="001"))
+    assert conf.match("/anything").replication == "001"
+    assert conf.match("/buckets/logs/a.txt").collection == "logs"
+
+
+def test_filer_readonly_rule_respects_segment_boundaries():
+    """'/frozen' read-only must not freeze writes under '/frozen2'."""
+    import pytest as _pytest
+
+    from seaweedfs_tpu.filer.filer import Entry, Filer
+    from seaweedfs_tpu.filer.filer_conf import PathConf
+    from seaweedfs_tpu.filer.store import MemoryStore
+
+    f = Filer(MemoryStore())
+    f.path_conf.upsert(PathConf(location_prefix="/frozen", read_only=True))
+    with _pytest.raises(PermissionError):
+        f.create_entry(Entry(path="/frozen/a"))
+    with _pytest.raises(PermissionError):
+        f.create_entry(Entry(path="/frozen"))
+    f.create_entry(Entry(path="/frozen2/a"))  # sibling stays writable
+    assert f.find_entry("/frozen2/a")

@@ -261,3 +261,27 @@ def test_admin_lock_survives_leader_failover(quorum):
         intruder.close()
         env._renew_stop and env._renew_stop.set()
         env.close()
+
+
+def test_follower_http_names_leader_in_json(quorum):
+    """The HTTP facade on a raft follower must answer leader-only calls
+    with the reference's failover shape — {"error": ..., "Leader": addr} —
+    not an opaque 412 (r4 advisor finding): curl-level HA clients read
+    the Leader field to retry against the right master."""
+    import json as _json
+    import urllib.request
+
+    leader = _wait_for_leader(quorum)
+    follower = next(m for m in quorum if m is not leader)
+    base = f"http://{follower.host}:{follower.http_port}"
+
+    # /vol/grow raises the leader-only fault
+    with urllib.request.urlopen(base + "/vol/grow?count=1", timeout=10) as r:
+        assert r.status == 200
+        d = _json.loads(r.read())
+    assert d["Leader"] == leader.address and "not the raft leader" in d["error"]
+
+    # /dir/assign answers through the Assign dict shape: same fields
+    with urllib.request.urlopen(base + "/dir/assign?count=1", timeout=10) as r:
+        d = _json.loads(r.read())
+    assert d["Leader"] == leader.address and "not the raft leader" in d["error"]

@@ -387,3 +387,18 @@ def test_ttl_volume_reaped_when_newest_write_ages_out(tmp_path):
     assert store.get_volume(20) is None
     assert not os.path.exists(v.dat_path)
     assert store.read_needle(21, 2).data == b"keeper"
+
+
+def test_needle_append_ts_batch_matches_read_needle(tmp_path):
+    """needle_append_ts must agree with the full record parse, skip
+    unknown ids, and survive needles with names/mimes (the ts offset is
+    computed from (offset, size), not by parsing the body)."""
+    with Volume(str(tmp_path), 9) as v:
+        n1 = Needle(cookie=1, id=1, data=b"plain" * 40)
+        n2 = Needle(cookie=2, id=2, data=b"x", name=b"file.txt", mime=b"text/plain")
+        v.write_needle(n1)
+        v.write_needle(n2)
+        ts = v.needle_append_ts([1, 2, 777])
+        assert set(ts) == {1, 2}
+        assert ts[1] == v.read_needle(1).append_at_ns > 0
+        assert ts[2] == v.read_needle(2).append_at_ns > 0
