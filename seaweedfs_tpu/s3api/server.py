@@ -40,12 +40,14 @@ from typing import Iterator, Optional
 from seaweedfs_tpu import stats
 from seaweedfs_tpu.filer.client import FilerClient
 from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.s3api import policy as policy_mod
 from seaweedfs_tpu.s3api.auth import (
     ACTION_LIST,
     ACTION_READ,
     ACTION_WRITE,
     ACTION_ADMIN,
     Iam,
+    Identity,
     load_identities,
     save_identities,
 )
@@ -63,14 +65,26 @@ _XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 _UPLOAD_ID_RE = re.compile(r"^[0-9a-f]{32}$")
 
 
+VERSIONS_SUFFIX = ".s3versions"
+# ids this gateway mints (hex time_ns + random) or AWS's pre-versioning
+# "null" — anything else in ?versionId is attacker-controlled path
+# material (a '..' would normalize out of the version archive and read or
+# delete entries in other buckets)
+_VERSION_ID_RE = re.compile(r"^(?:[0-9a-f]{24}|null)$")
+
+
 def _valid_path(bucket: str, key: str) -> bool:
     """Reject bucket/key pairs whose filer path would normalize outside
     /buckets/<bucket>/ — '.'/'..'/empty segments and dot-prefixed bucket
-    names (which would collide with the .uploads staging area)."""
+    names (which would collide with the .uploads staging area). Segments
+    ending in the reserved .s3versions suffix are refused on every
+    surface: they are the per-key version archives."""
     if bucket.startswith("."):
         return False
     segs = key.split("/") if key else []
     if any(s in ("", ".", "..") for s in segs[:-1]):
+        return False
+    if any(s.endswith(VERSIONS_SUFFIX) for s in segs):
         return False
     # a single trailing "" segment is a folder-marker key ("a/b/")
     return not (segs and segs[-1] in (".", ".."))
@@ -94,6 +108,8 @@ class S3ApiServer:
         # pre-lowercased here so the per-request compare is a set lookup
         self.extra_hosts = {h.lower() for h in (extra_hosts or ())}
         self._iam_checked_at = 0.0
+        self._policy_cache: dict[str, tuple[float, Optional[dict]]] = {}
+        self._versioning_cache: dict[str, tuple[float, str]] = {}
         self.host = host
         self._http = _ThreadingHTTPServer((host, port), _Handler)
         tls.maybe_wrap_https(self._http)  # data-path HTTPS when configured
@@ -137,6 +153,92 @@ class S3ApiServer:
     def __exit__(self, *exc):
         self.stop()
 
+    # -- bucket policies ------------------------------------------------------
+
+    POLICY_KEY = "s3_policy"
+    _POLICY_TTL = 5.0  # s; policies are read per request, entries are not
+
+    def get_bucket_policy(self, bucket: str) -> Optional[dict]:
+        """The bucket's policy document, or None — cached briefly so the
+        per-request evaluation doesn't pay a filer lookup per call."""
+        now = time.monotonic()
+        cached = self._policy_cache.get(bucket)
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        entry = self.filer.lookup(self.bucket_path(bucket))
+        doc = None
+        if entry is not None:
+            raw = entry.extended.get(self.POLICY_KEY)
+            if raw:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    doc = None  # unreadable stored policy must not 500 reads
+        self._policy_cache[bucket] = (now + self._POLICY_TTL, doc)
+        return doc
+
+    def put_bucket_policy(self, bucket: str, doc: dict) -> bool:
+        entry = self.filer.lookup(self.bucket_path(bucket))
+        if entry is None or not entry.is_directory:
+            return False
+        entry.extended[self.POLICY_KEY] = json.dumps(doc)
+        self.filer.update(entry)
+        self._policy_cache.pop(bucket, None)
+        return True
+
+    def delete_bucket_policy(self, bucket: str) -> bool:
+        entry = self.filer.lookup(self.bucket_path(bucket))
+        if entry is None or not entry.is_directory:
+            return False
+        if self.POLICY_KEY in entry.extended:
+            del entry.extended[self.POLICY_KEY]
+            self.filer.update(entry)
+        self._policy_cache.pop(bucket, None)
+        return True
+
+    # -- object versioning ----------------------------------------------------
+    #
+    # Layout ([ref: weed/s3api versioning — mount empty]; reference keeps a
+    # hidden .versions folder per key): the PLAIN path always holds the
+    # latest real version; every older version — and every delete marker —
+    # lives in a sibling directory `<key>.s3versions/` keyed by version id.
+    # A marker as "latest" therefore shows as: plain path absent, marker
+    # entry newest in the archive. Version ids are zero-padded hex
+    # time_ns + random, so lexical order is creation order.
+
+    VERSIONING_KEY = "s3_versioning"
+    MARKER_KEY = "s3_delete_marker"
+    VID_KEY = "x-amz-version-id"
+
+    def get_bucket_versioning(self, bucket: str) -> str:
+        """'' | 'Enabled' | 'Suspended' (briefly cached like policies)."""
+        now = time.monotonic()
+        cached = self._versioning_cache.get(bucket)
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        entry = self.filer.lookup(self.bucket_path(bucket))
+        status = ""
+        if entry is not None:
+            status = entry.extended.get(self.VERSIONING_KEY, "")
+        self._versioning_cache[bucket] = (now + self._POLICY_TTL, status)
+        return status
+
+    def set_bucket_versioning(self, bucket: str, status: str) -> bool:
+        entry = self.filer.lookup(self.bucket_path(bucket))
+        if entry is None or not entry.is_directory:
+            return False
+        entry.extended[self.VERSIONING_KEY] = status
+        self.filer.update(entry)
+        self._versioning_cache.pop(bucket, None)
+        return True
+
+    def versions_dir(self, bucket: str, key: str) -> str:
+        return self.object_path(bucket, key) + VERSIONS_SUFFIX
+
+    @staticmethod
+    def new_version_id() -> str:
+        return f"{time.time_ns():016x}{uuid.uuid4().hex[:8]}"
+
     # -- filer helpers --------------------------------------------------------
 
     def bucket_path(self, bucket: str) -> str:
@@ -174,6 +276,8 @@ class S3ApiServer:
                     return
                 for e in batch:
                     key = e.path[len(root) + 1 :]
+                    if e.is_directory and e.name.endswith(VERSIONS_SUFFIX):
+                        continue  # per-key version archives are not keys
                     if e.is_directory:
                         probe = key + "/"
                         if after and after > probe and not after.startswith(probe):
@@ -254,11 +358,55 @@ class _Handler(httpd.QuietHandler):
         _sub(root, "Message", message or s3_code)
         self._reply(code, _render(root))
 
+    def _s3_action_name(self, action: str, key: str, query: str) -> str:
+        """Map this request's coarse action to the s3:* name bucket
+        policies speak. Admin (bucket-management) operations return "" —
+        they stay identity-only, which keeps Get/Put/DeleteBucketPolicy
+        out of the policy's own reach (no AWS-style deny-yourself
+        lockout). Bucket-level reads approximate to s3:ListBucket."""
+        if action == ACTION_LIST:
+            return "s3:ListBucket"
+        if action == ACTION_READ:
+            return "s3:GetObject" if key else "s3:ListBucket"
+        if action == ACTION_WRITE:
+            qkeys = {
+                k for k, _ in urllib.parse.parse_qsl(query, keep_blank_values=True)
+            }
+            if self.command == "DELETE" or (self.command == "POST" and "delete" in qkeys):
+                return "s3:DeleteObject"
+            return "s3:PutObject"
+        return ""
+
+    @staticmethod
+    def _is_anonymous(identity) -> bool:
+        return not identity.access_key and identity.name == "anonymous"
+
+    def _policy_verdict(self, bucket, key, identity, s3_action):
+        """Evaluate the bucket's policy for one (identity, action,
+        resource): False = explicit deny, True = allow, None = no
+        statement matched (or no policy)."""
+        pol = self.s3.get_bucket_policy(bucket)
+        if pol is None:
+            return None
+        resource = policy_mod.ARN_PREFIX + (f"{bucket}/{key}" if key else bucket)
+        return policy_mod.evaluate(
+            pol,
+            identity_name=identity.name,
+            access_key=identity.access_key,
+            anonymous=self._is_anonymous(identity),
+            action=s3_action,
+            resource=resource,
+        )
+
     def _auth(self, action: str, bucket: str, payload: bytes):
         """Authenticate + authorize; returns the resolved Identity (truthy)
         or None after replying 403/501 — callers needing a second
         authorization check (CopyObject's source-bucket Read) reuse the
-        identity instead of re-verifying the signature."""
+        identity instead of re-verifying the signature.
+
+        Authorization order (IAM semantics): bucket policy explicit Deny
+        -> refuse, policy Allow -> grant (this is how anonymous access to
+        a public-read bucket works), else identity grants."""
         u = urllib.parse.urlparse(self.path)
         headers = {k.lower(): v for k, v in self.headers.items()}
         path = urllib.parse.unquote(u.path) or "/"
@@ -289,8 +437,35 @@ class _Handler(httpd.QuietHandler):
                     self.command, path, u.query, headers, payload,
                     expect_service="s3", expect_hosts=expect_hosts,
                 )
+        anonymous = False
+        if (
+            identity is None
+            and "authorization" not in headers
+            and "X-Amz-Signature=" not in u.query
+        ):
+            # truly unsigned request (no auth material at all): not an auth
+            # failure yet — a bucket policy may grant the anonymous
+            # principal (public-read buckets). A SIGNED request missing a
+            # required header keeps its original 403.
+            identity = Identity("anonymous", "", "", [])
+            anonymous = True
         if identity is None:
             self._error(403, err)
+            return None
+        # derive the object key from the path: policy resources are
+        # key-granular while callers authorize at bucket granularity
+        parts = path.lstrip("/").split("/", 1)
+        req_key = parts[1] if len(parts) > 1 else ""
+        s3_act = self._s3_action_name(action, req_key, u.query)
+        if bucket and s3_act:
+            verdict = self._policy_verdict(bucket, req_key, identity, s3_act)
+            if verdict is False:
+                self._error(403, "AccessDenied", "denied by bucket policy")
+                return None
+            if verdict is True:
+                return identity
+        if anonymous:
+            self._error(403, "AccessDenied", "anonymous access not granted")
             return None
         if not identity.can_do(action, bucket):
             self._error(403, "AccessDenied", f"no {action} on {bucket}")
@@ -329,6 +504,41 @@ class _Handler(httpd.QuietHandler):
                     else:
                         self._get_acl()
                 return
+            if "policy" in q:
+                stats.S3RequestCounter.labels("GetBucketPolicy").inc()
+                if self._auth(ACTION_ADMIN, bucket, b""):
+                    if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+                        self._error(404, "NoSuchBucket")
+                    else:
+                        pol = self.s3.get_bucket_policy(bucket)
+                        if pol is None:
+                            self._error(
+                                404, "NoSuchBucketPolicy",
+                                "the bucket policy does not exist",
+                            )
+                        else:
+                            self._reply(
+                                200, json.dumps(pol).encode(),
+                                ctype="application/json",
+                            )
+                return
+            if "versioning" in q:
+                stats.S3RequestCounter.labels("GetBucketVersioning").inc()
+                if self._auth(ACTION_READ, bucket, b""):
+                    if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+                        self._error(404, "NoSuchBucket")
+                    else:
+                        root = _xml("VersioningConfiguration")
+                        status = self.s3.get_bucket_versioning(bucket)
+                        if status:
+                            _sub(root, "Status", status)
+                        self._reply(200, _render(root))
+                return
+            if "versions" in q:
+                stats.S3RequestCounter.labels("ListObjectVersions").inc()
+                if self._auth(ACTION_LIST, bucket, b""):
+                    self._list_object_versions(bucket, q)
+                return
             stats.S3RequestCounter.labels("ListObjects").inc()
             if self._auth(ACTION_LIST, bucket, b""):
                 self._list_objects(bucket, q)
@@ -351,7 +561,7 @@ class _Handler(httpd.QuietHandler):
             return
         stats.S3RequestCounter.labels("GetObject").inc()
         if self._auth(ACTION_READ, bucket, b""):
-            self._get_object(bucket, key, head=False)
+            self._get_object(bucket, key, head=False, version_id=q.get("versionId", ""))
 
     def do_HEAD(self):
         parsed = self._parse()
@@ -366,7 +576,7 @@ class _Handler(httpd.QuietHandler):
                     self._reply(200)
             return
         if self._auth(ACTION_READ, bucket, b""):
-            self._get_object(bucket, key, head=True)
+            self._get_object(bucket, key, head=True, version_id=q.get("versionId", ""))
 
     def do_PUT(self):
         parsed = self._parse()
@@ -392,6 +602,38 @@ class _Handler(httpd.QuietHandler):
                     self._error(404, "NoSuchKey", key)
                 else:
                     self._reply(200)
+            return
+        if not key and "versioning" in q:
+            stats.S3RequestCounter.labels("PutBucketVersioning").inc()
+            if self._auth(ACTION_ADMIN, bucket, body):
+                try:
+                    tree = ET.fromstring(body)
+                except ET.ParseError:
+                    self._error(400, "MalformedXML")
+                    return
+                ns = tree.tag[: tree.tag.index("}") + 1] if tree.tag.startswith("{") else ""
+                el = tree.find(f"{ns}Status")
+                status = (el.text or "").strip() if el is not None else ""
+                if status not in ("Enabled", "Suspended"):
+                    self._error(400, "MalformedXML", "Status must be Enabled|Suspended")
+                    return
+                if not self.s3.set_bucket_versioning(bucket, status):
+                    self._error(404, "NoSuchBucket")
+                else:
+                    self._reply(200)
+            return
+        if not key and "policy" in q:
+            stats.S3RequestCounter.labels("PutBucketPolicy").inc()
+            if self._auth(ACTION_ADMIN, bucket, body):
+                try:
+                    doc = policy_mod.parse_policy(body, bucket)
+                except policy_mod.PolicyError as e:
+                    self._error(400, "MalformedPolicy", str(e))
+                    return
+                if not self.s3.put_bucket_policy(bucket, doc):
+                    self._error(404, "NoSuchBucket")
+                else:
+                    self._reply(204)
             return
         if not key:
             stats.S3RequestCounter.labels("CreateBucket").inc()
@@ -429,8 +671,9 @@ class _Handler(httpd.QuietHandler):
             return
         if not key and "delete" in q:
             stats.S3RequestCounter.labels("DeleteObjects").inc()
-            if self._auth(ACTION_WRITE, bucket, body):
-                self._delete_objects(bucket, body)
+            identity = self._auth(ACTION_WRITE, bucket, body)
+            if identity:
+                self._delete_objects(bucket, body, identity)
             return
         if key and "uploads" in q:
             stats.S3RequestCounter.labels("CreateMultipartUpload").inc()
@@ -449,6 +692,14 @@ class _Handler(httpd.QuietHandler):
         if parsed is None:
             return
         bucket, key, q = parsed
+        if not key and "policy" in q:
+            stats.S3RequestCounter.labels("DeleteBucketPolicy").inc()
+            if self._auth(ACTION_ADMIN, bucket, b""):
+                if not self.s3.delete_bucket_policy(bucket):
+                    self._error(404, "NoSuchBucket")
+                else:
+                    self._reply(204)
+            return
         if not key:
             stats.S3RequestCounter.labels("DeleteBucket").inc()
             if self._auth(ACTION_ADMIN, bucket, b""):
@@ -466,7 +717,7 @@ class _Handler(httpd.QuietHandler):
             return
         stats.S3RequestCounter.labels("DeleteObject").inc()
         if self._auth(ACTION_WRITE, bucket, b""):
-            self._delete_object(bucket, key)
+            self._delete_object(bucket, key, q.get("versionId", ""))
 
     # -- buckets --------------------------------------------------------------
 
@@ -501,6 +752,10 @@ class _Handler(httpd.QuietHandler):
             self._error(409, "BucketNotEmpty")
             return
         self.s3.filer.delete(path, recursive=True)
+        # a same-named bucket created within the cache TTL must not
+        # inherit the dead bucket's policy or versioning state
+        self.s3._policy_cache.pop(bucket, None)
+        self.s3._versioning_cache.pop(bucket, None)
         try:
             # in-flight multipart staging references needles in this
             # bucket's collection; dropping the collection without it
@@ -594,6 +849,109 @@ class _Handler(httpd.QuietHandler):
             _sub(p, "Prefix", cp)
         self._reply(200, _render(root))
 
+    def _walk_version_rows(self, bucket, prefix):
+        """Yield (key, [(vid, is_marker, entry)] newest-first) in key order
+        per directory — both live keys AND keys whose only remains are
+        archived versions/markers (those have no plain entry, so
+        walk_keys alone would never surface them)."""
+        root = self.s3.bucket_path(bucket)
+
+        def rec(dir_path, base):
+            per_key: dict[str, dict] = {}
+            subdirs: dict[str, object] = {}
+            start = ""
+            while True:
+                batch = self.s3.filer.list(dir_path, start_from=start, limit=256)
+                if not batch:
+                    break
+                for e in batch:
+                    if e.is_directory and e.name.endswith(VERSIONS_SUFFIX):
+                        per_key.setdefault(
+                            base + e.name[: -len(VERSIONS_SUFFIX)], {}
+                        )["vdir"] = e
+                    elif e.is_directory:
+                        subdirs[base + e.name + "/"] = e
+                    else:
+                        per_key.setdefault(base + e.name, {})["plain"] = e
+                start = batch[-1].name
+                if len(batch) < 256:
+                    break
+            for name in sorted(set(per_key) | set(subdirs)):
+                if name in subdirs:
+                    if name.startswith(prefix) or prefix.startswith(name):
+                        yield from rec(subdirs[name].path, name)
+                    continue
+                if not name.startswith(prefix):
+                    continue
+                recs = []
+                plain = per_key[name].get("plain")
+                if plain is not None:
+                    recs.append((self._entry_vid(plain), False, plain))
+                if "vdir" in per_key[name]:
+                    archived = [
+                        e
+                        for e in self.s3.filer.list(
+                            per_key[name]["vdir"].path, limit=10000
+                        )
+                        if not e.is_directory
+                    ]
+                    archived.sort(
+                        key=lambda e: (e.attributes.mtime, e.name), reverse=True
+                    )
+                    recs.extend((e.name, self._is_marker(e), e) for e in archived)
+                if recs:
+                    yield name, recs
+
+        yield from rec(root, "")
+
+    def _list_object_versions(self, bucket, q):
+        """ListObjectVersions: every version and delete marker, newest
+        first per key. Honors prefix, max-keys, and key-marker; truncation
+        cuts at KEY boundaries and names NextKeyMarker, so SDK paginators
+        make progress (version-id-marker sub-pagination is not
+        implemented — a single key's versions always ship whole)."""
+        if self.s3.filer.lookup(self.s3.bucket_path(bucket)) is None:
+            self._error(404, "NoSuchBucket")
+            return
+        prefix = q.get("prefix", "")
+        max_keys = httpd.safe_int(q.get("max-keys"), 1000)
+        key_marker = q.get("key-marker", "")
+        root = _xml("ListVersionsResult")
+        _sub(root, "Name", bucket)
+        _sub(root, "Prefix", prefix)
+        _sub(root, "MaxKeys", str(max_keys))
+        if key_marker:
+            _sub(root, "KeyMarker", key_marker)
+        emitted = 0
+        truncated = False
+        last_key = ""
+        for key, recs in self._walk_version_rows(bucket, prefix):
+            if key_marker and key <= key_marker:
+                continue
+            if emitted and emitted + len(recs) > max_keys:
+                truncated = True
+                break
+            for i, (vid, is_marker, entry) in enumerate(recs):
+                el = _sub(root, "DeleteMarker" if is_marker else "Version")
+                _sub(el, "Key", key)
+                _sub(el, "VersionId", vid)
+                _sub(el, "IsLatest", "true" if i == 0 else "false")
+                _sub(el, "LastModified", _iso(entry.attributes.mtime))
+                if not is_marker:
+                    _sub(el, "ETag", f'"{entry.attributes.md5 or ""}"')
+                    _sub(el, "Size", str(entry.size))
+                    _sub(el, "StorageClass", "STANDARD")
+                emitted += 1
+            last_key = key
+            if emitted >= max_keys:
+                # stop scanning; whether anything follows decides truncation
+                truncated = True
+                break
+        if truncated and last_key:
+            _sub(root, "NextKeyMarker", last_key)
+        _sub(root, "IsTruncated", "true" if truncated else "false")
+        self._reply(200, _render(root))
+
     # -- objects --------------------------------------------------------------
 
     def _put_object(self, bucket, key, body):
@@ -613,27 +971,71 @@ class _Handler(httpd.QuietHandler):
                 self._error(400, "BadRequest", f"up to {self.MAX_TAGS} tags allowed")
                 return
             headers[self.TAGS_KEY] = tagging  # filer stores x-amz-* in extended
-        req = urllib.request.Request(
-            self.s3.filer_url(self.s3.object_path(bucket, key)),
-            data=body,
-            method="PUT",
-            headers=headers,
-        )
-        try:
+        meta: dict = {}
+
+        def write(filer_path, vid_headers):
+            req = urllib.request.Request(
+                self.s3.filer_url(filer_path),
+                data=body,
+                method="PUT",
+                headers={**headers, **vid_headers},  # x-amz-* land in extended
+            )
             with tls.urlopen(req, timeout=60) as r:
-                meta = json.loads(r.read())
+                meta.update(json.loads(r.read()))
+
+        try:
+            vid_headers = self._versioned_commit(bucket, key, write)
         except urllib.error.URLError as e:
             self._error(500, "InternalError", str(e))
             return
-        self._reply(200, headers={"ETag": f'"{meta.get("etag", "")}"'})
+        self._reply(
+            200, headers={"ETag": f'"{meta.get("etag", "")}"', **vid_headers}
+        )
 
-    def _get_object(self, bucket, key, head: bool):
-        entry = self.s3.filer.lookup(self.s3.object_path(bucket, key))
+    def _get_object(self, bucket, key, head: bool, version_id: str = ""):
+        if version_id and not _VERSION_ID_RE.fullmatch(version_id):
+            self._reply(400) if head else self._error(
+                400, "InvalidArgument", "invalid versionId"
+            )
+            return
+        filer_path = self.s3.object_path(bucket, key)
+        entry = self.s3.filer.lookup(filer_path)
+        if version_id and not (
+            entry is not None
+            and not entry.is_directory
+            and self._entry_vid(entry) == version_id
+        ):
+            # not the latest: serve out of the version archive
+            filer_path = f"{self.s3.versions_dir(bucket, key)}/{version_id}"
+            entry = self.s3.filer.lookup(filer_path)
+            if entry is None or entry.is_directory:
+                self._reply(404) if head else self._error(
+                    404, "NoSuchVersion", version_id
+                )
+                return
+            if self._is_marker(entry):
+                # AWS: GET on a delete-marker version is 405
+                self._reply(
+                    405, headers={"x-amz-delete-marker": "true", "Allow": "DELETE"}
+                ) if head else self._error(405, "MethodNotAllowed", "delete marker")
+                return
         if entry is None or entry.is_directory:
+            marker_headers = {}
+            if self.s3.get_bucket_versioning(bucket):
+                # latest may be a delete marker: 404, but say so
+                versions = self._key_versions(bucket, key)
+                if versions and versions[0][1]:
+                    marker_headers = {
+                        "x-amz-delete-marker": "true",
+                        self.s3.VID_KEY: versions[0][0],
+                    }
             if head:
-                self._reply(404)
+                self._reply(404, headers=marker_headers)
             else:
-                self._error(404, "NoSuchKey", key)
+                root = _xml("Error", ns=False)
+                _sub(root, "Code", "NoSuchKey")
+                _sub(root, "Message", key)
+                self._reply(404, _render(root), headers=marker_headers)
             return
         # conditional requests (RFC 9110 semantics S3 clients cache with)
         from seaweedfs_tpu.filer.chunks import etag_of as _etag_of
@@ -664,7 +1066,7 @@ class _Handler(httpd.QuietHandler):
         if rng and not head:
             fwd["Range"] = rng
         req = urllib.request.Request(
-            self.s3.filer_url(self.s3.object_path(bucket, key)),
+            self.s3.filer_url(filer_path),
             headers=fwd,
             method="HEAD" if head else "GET",
         )
@@ -677,7 +1079,9 @@ class _Handler(httpd.QuietHandler):
                     "Accept-Ranges": "bytes",
                 }
                 for k, v in r.headers.items():
-                    if k.lower().startswith("x-amz-meta-"):
+                    if k.lower().startswith("x-amz-meta-") or (
+                        k.lower() == self.s3.VID_KEY
+                    ):
                         out_headers[k] = v
                 tagging = r.headers.get(self.TAGS_KEY, "")
                 if tagging:  # S3 exposes only the count, not the tags
@@ -723,7 +1127,14 @@ class _Handler(httpd.QuietHandler):
         if not s_key or not _valid_path(s_bucket, s_key):
             self._error(400, "InvalidArgument", "invalid copy source")
             return None
-        if not identity.can_do(ACTION_READ, s_bucket):
+        # the SOURCE bucket's policy binds here too: a denied direct GET
+        # must not be readable by copying it into a bucket the caller can
+        # write ([ref: weed/s3api — mount empty]; IAM evaluation order)
+        verdict = self._policy_verdict(s_bucket, s_key, identity, "s3:GetObject")
+        if verdict is False:
+            self._error(403, "AccessDenied", "denied by source bucket policy")
+            return None
+        if verdict is not True and not identity.can_do(ACTION_READ, s_bucket):
             self._error(403, "AccessDenied", f"no Read on {s_bucket}")
             return None
         s_entry = self.s3.filer.lookup(self.s3.object_path(s_bucket, s_key))
@@ -748,25 +1159,168 @@ class _Handler(httpd.QuietHandler):
         except urllib.error.URLError as e:
             self._error(500, "InternalError", str(e))
             return
-        req = urllib.request.Request(
-            self.s3.filer_url(self.s3.object_path(bucket, key)),
-            data=data,
-            method="PUT",
-            headers={"Content-Type": ctype},
-        )
-        with tls.urlopen(req, timeout=60) as r:
-            meta = json.loads(r.read())
+        meta: dict = {}
+
+        def write(filer_path, vid_headers):
+            req = urllib.request.Request(
+                self.s3.filer_url(filer_path),
+                data=data,
+                method="PUT",
+                headers={"Content-Type": ctype, **vid_headers},
+            )
+            with tls.urlopen(req, timeout=60) as r:
+                meta.update(json.loads(r.read()))
+
+        vid_headers = self._versioned_commit(bucket, key, write)
         root = _xml("CopyObjectResult")
         _sub(root, "ETag", f'"{meta.get("etag", "")}"')
         _sub(root, "LastModified", _iso(time.time()))
-        self._reply(200, _render(root))
+        self._reply(200, _render(root), headers=vid_headers)
 
-    def _delete_object(self, bucket, key):
+    # -- versioning plumbing ---------------------------------------------------
+
+    def _entry_vid(self, entry) -> str:
+        """The stored version id of an entry; 'null' for objects written
+        while versioning was off/suspended (AWS's pre-versioning id)."""
+        for k, v in entry.extended.items():
+            if k.lower() == self.s3.VID_KEY:
+                return v
+        return "null"
+
+    def _is_marker(self, entry) -> bool:
+        return self.s3.MARKER_KEY in entry.extended
+
+    def _archive_current(self, bucket, key, status, drop_null: bool = False) -> None:
+        """Move the plain-path entry (the latest version) into the version
+        archive under its own id, clearing the way for a new latest.
+        Under Suspended, the 'null' version is overwritten in place (AWS
+        semantics), so only real-id versions are archived — unless
+        drop_null asks for the delete-path behavior, where the null
+        version is permanently removed."""
+        plain = self.s3.object_path(bucket, key)
+        cur = self.s3.filer.lookup(plain)
+        if cur is None or cur.is_directory:
+            return
+        vid = self._entry_vid(cur)
+        if status == "Suspended" and vid == "null":
+            if drop_null:
+                self.s3.filer.delete(plain)
+            return
+        self.s3.filer.rename(plain, f"{self.s3.versions_dir(bucket, key)}/{vid}")
+
+    def _versioned_commit(self, bucket, key, write_fn) -> dict[str, str]:
+        """Orchestrate any write that replaces the plain path (PutObject,
+        CopyObject, CompleteMultipartUpload). write_fn(filer_path,
+        vid_headers) performs the actual write at the path it is given.
+
+        Versioned buckets stage the new object INSIDE the archive first,
+        then move the old latest aside, then rename the staged write into
+        place — so a failed write leaves the previous latest untouched
+        instead of already-archived (a plain-path-first ordering would
+        turn a 500 into a 404 for readers). Returns the version headers
+        the caller's reply must carry."""
+        status = self.s3.get_bucket_versioning(bucket)
+        plain = self.s3.object_path(bucket, key)
+        if status not in ("Enabled", "Suspended"):
+            write_fn(plain, {})
+            return {}
+        vid = self.s3.new_version_id() if status == "Enabled" else "null"
+        vid_headers = {self.s3.VID_KEY: vid}
+        staging = f"{self.s3.versions_dir(bucket, key)}/{vid}"
+        write_fn(staging, vid_headers)
+        self._archive_current(bucket, key, status)
+        self.s3.filer.rename(staging, plain)
+        return vid_headers
+
+    def _archived_records(self, vdir_path) -> list[tuple[str, bool, object]]:
+        """[(vid, is_marker, entry)] of the version archive, newest first —
+        the ONE ordering shared by listings, promotion, and marker
+        detection (ties break on the time-ordered hex id)."""
+        archived = [
+            e for e in self.s3.filer.list(vdir_path, limit=10000) if not e.is_directory
+        ]
+        archived.sort(key=lambda e: (e.attributes.mtime, e.name), reverse=True)
+        return [(e.name, self._is_marker(e), e) for e in archived]
+
+    def _key_versions(self, bucket, key) -> list[tuple[str, bool, object]]:
+        """[(vid, is_marker, entry)] newest first. The plain entry (when
+        present) is always the newest real version by the layout
+        invariant; archived entries order by mtime."""
+        out = []
+        plain = self.s3.filer.lookup(self.s3.object_path(bucket, key))
+        if plain is not None and not plain.is_directory:
+            out.append((self._entry_vid(plain), False, plain))
+        out.extend(self._archived_records(self.s3.versions_dir(bucket, key)))
+        return out
+
+    def _promote_newest(self, bucket, key) -> None:
+        """After the current latest was permanently deleted: if the newest
+        archived record is a REAL version, rename it back to the plain
+        path so reads keep working (a marker stays archived — the key
+        reads as deleted)."""
+        vdir = self.s3.versions_dir(bucket, key)
+        records = self._archived_records(vdir)
+        if records and not records[0][1]:
+            self.s3.filer.rename(
+                f"{vdir}/{records[0][0]}", self.s3.object_path(bucket, key)
+            )
+
+    def _delete_object_versioned(self, bucket, key, version_id: str) -> dict:
+        """Shared by DeleteObject and DeleteObjects. Returns the reply
+        headers (version id / delete-marker) — S3 deletes are idempotent,
+        so missing things succeed quietly."""
+        from seaweedfs_tpu.filer.entry import Attributes as _A
+        from seaweedfs_tpu.filer.entry import Entry as _E
+
+        status = self.s3.get_bucket_versioning(bucket)
+        plain = self.s3.object_path(bucket, key)
+        if version_id and not _VERSION_ID_RE.fullmatch(version_id):
+            raise ValueError("invalid versionId")
+        if version_id:
+            # permanent delete of one version
+            cur = self.s3.filer.lookup(plain)
+            if cur is not None and not cur.is_directory and self._entry_vid(cur) == version_id:
+                self.s3.filer.delete(plain)
+                self._promote_newest(bucket, key)
+                return {self.s3.VID_KEY: version_id}
+            vpath = f"{self.s3.versions_dir(bucket, key)}/{version_id}"
+            ventry = self.s3.filer.lookup(vpath)
+            headers = {self.s3.VID_KEY: version_id}
+            if ventry is not None:
+                if self._is_marker(ventry):
+                    headers["x-amz-delete-marker"] = "true"
+                self.s3.filer.delete(vpath)
+                if self._is_marker(ventry) and self.s3.filer.lookup(plain) is None:
+                    # removing the masking marker can re-expose a version
+                    self._promote_newest(bucket, key)
+            return headers
+        if status in ("Enabled", "Suspended"):
+            # logical delete: archive the latest, leave a marker. Under
+            # Suspended the 'null' version is REMOVED (AWS: the null
+            # marker replaces it) — archiving-by-overwrite alone would
+            # leave the plain path serving the supposedly deleted bytes.
+            self._archive_current(bucket, key, status, drop_null=True)
+            vid = self.s3.new_version_id() if status == "Enabled" else "null"
+            marker = _E(
+                path=f"{self.s3.versions_dir(bucket, key)}/{vid}",
+                attributes=_A(mtime=time.time()),
+                extended={self.s3.VID_KEY: vid, self.s3.MARKER_KEY: "1"},
+            )
+            self.s3.filer.create(marker)  # replaces a prior 'null' marker
+            return {self.s3.VID_KEY: vid, "x-amz-delete-marker": "true"}
         try:
-            self.s3.filer.delete(self.s3.object_path(bucket, key))
+            self.s3.filer.delete(plain)
         except Exception:  # noqa: BLE001 — S3 delete is idempotent
             pass
-        self._reply(204)
+        return {}
+
+    def _delete_object(self, bucket, key, version_id: str = ""):
+        try:
+            headers = self._delete_object_versioned(bucket, key, version_id)
+        except ValueError:
+            self._error(400, "InvalidArgument", "invalid versionId")
+            return
+        self._reply(204, headers=headers)
 
     # -- object tagging (Get/Put/DeleteObjectTagging) --------------------------
     #
@@ -867,7 +1421,7 @@ class _Handler(httpd.QuietHandler):
             self.s3.filer.update(entry)
         self._reply(204)
 
-    def _delete_objects(self, bucket, body):
+    def _delete_objects(self, bucket, body, identity):
         try:
             tree = ET.fromstring(body)
         except ET.ParseError:
@@ -886,12 +1440,36 @@ class _Handler(httpd.QuietHandler):
                 _sub(err, "Key", key_el.text)
                 _sub(err, "Code", "InvalidArgument")
                 continue
+            # the bucket-level _auth saw resource arn:...:bucket; per-key
+            # denies (s3:DeleteObject on a prefix) must still bind here
+            verdict = self._policy_verdict(
+                bucket, key_el.text, identity, "s3:DeleteObject"
+            )
+            if verdict is False or (
+                self._is_anonymous(identity) and verdict is not True
+            ):
+                err = _sub(root, "Error")
+                _sub(err, "Key", key_el.text)
+                _sub(err, "Code", "AccessDenied")
+                continue
+            vid_el = obj.find(f"{ns}VersionId")
+            vid = (vid_el.text or "").strip() if vid_el is not None else ""
             try:
-                self.s3.filer.delete(self.s3.object_path(bucket, key_el.text))
+                headers = self._delete_object_versioned(bucket, key_el.text, vid)
+            except ValueError:
+                err = _sub(root, "Error")
+                _sub(err, "Key", key_el.text)
+                _sub(err, "Code", "InvalidArgument")
+                continue
             except Exception:  # noqa: BLE001
-                pass
+                headers = {}
             d = _sub(root, "Deleted")
             _sub(d, "Key", key_el.text)
+            if headers.get("x-amz-delete-marker"):
+                _sub(d, "DeleteMarker", "true")
+                _sub(d, "DeleteMarkerVersionId", headers.get(self.s3.VID_KEY, ""))
+            elif vid:
+                _sub(d, "VersionId", vid)
         self._reply(200, _render(root))
 
     # -- multipart ------------------------------------------------------------
@@ -1101,18 +1679,30 @@ class _Handler(httpd.QuietHandler):
             etag_md5.update(bytes.fromhex(p.attributes.md5))
         meta = json.loads(dir_entry.extended.get("s3", "{}"))
         etag = f"{etag_md5.hexdigest()}-{len(parts)}"
-        entry = _E(
-            path=self.s3.object_path(bucket, key),
-            attributes=Attributes(
-                mtime=time.time(),
-                mime=meta.get("content_type", "application/octet-stream"),
-                md5=etag,
-                file_size=offset,
-            ),
-            chunks=chunks,
-            extended={k: v for k, v in meta.items() if k.startswith("x-amz-meta-")},
-        )
-        self.s3.filer.create(entry)
+
+        def write(filer_path, vid_headers):
+            self.s3.filer.create(
+                _E(
+                    path=filer_path,
+                    attributes=Attributes(
+                        mtime=time.time(),
+                        mime=meta.get("content_type", "application/octet-stream"),
+                        md5=etag,
+                        file_size=offset,
+                    ),
+                    chunks=chunks,
+                    extended={
+                        **{
+                            k: v
+                            for k, v in meta.items()
+                            if k.startswith("x-amz-meta-")
+                        },
+                        **vid_headers,
+                    },
+                )
+            )
+
+        vid_headers = self._versioned_commit(bucket, key, write)
         # drop the staging entries but keep the needles (now owned by the
         # final object)
         self.s3.filer.delete(d, recursive=True, delete_data=False)
@@ -1121,7 +1711,7 @@ class _Handler(httpd.QuietHandler):
         _sub(root, "Bucket", bucket)
         _sub(root, "Key", key)
         _sub(root, "ETag", f'"{etag}"')
-        self._reply(200, _render(root))
+        self._reply(200, _render(root), headers=vid_headers)
 
     def _abort_multipart(self, bucket, key, upload_id):
         if not self._valid_upload(upload_id):

@@ -780,7 +780,9 @@ def test_upload_part_copy_and_acl(stack):
     from seaweedfs_tpu.s3api import server as s3server
 
     out = s3server._Handler._resolve_copy_source.__get__(rec, _Rec)
-    rec.s3 = s3  # not reached: auth fails first
+    rec.s3 = s3  # consulted for the source bucket's policy (none here)
+    rec._policy_verdict = s3server._Handler._policy_verdict.__get__(rec, _Rec)
+    rec._is_anonymous = s3server._Handler._is_anonymous
     assert out("/upcbkt/src.bin", limited) is None
     assert rec.replies == [403]
     # ACL endpoints: canned responses, never 501
@@ -792,3 +794,406 @@ def test_upload_part_copy_and_acl(stack):
     assert code == 200
     code, _, _ = _req(s3, "GET", "/upcbkt/ghost.bin", query="acl")
     assert code == 404
+
+
+def test_bucket_policy_engine(stack):
+    """Resource policies with IAM evaluation order: explicit Deny beats an
+    identity allow, Allow grants anonymous principals (public-read), no
+    match falls back to identity grants; Get/Put/DeleteBucketPolicy
+    endpoints round-trip the document."""
+    import json as _json
+
+    s3 = stack
+    assert _req(s3, "PUT", "/polbkt")[0] == 200
+    assert _req(s3, "PUT", "/polbkt/pub/hello.txt", b"public bytes")[0] == 200
+    assert _req(s3, "PUT", "/polbkt/secret/s.txt", b"secret bytes")[0] == 200
+
+    # before any policy: anonymous reads are refused, policy GET is a 404
+    code, _, body = _req(s3, "GET", "/polbkt/pub/hello.txt", sign=False)
+    assert code == 403
+    code, _, body = _req(s3, "GET", "/polbkt", query="policy")
+    assert code == 404 and b"NoSuchBucketPolicy" in body
+
+    # malformed documents are rejected with MalformedPolicy
+    code, _, body = _req(s3, "PUT", "/polbkt", b"{not json", query="policy")
+    assert code == 400 and b"MalformedPolicy" in body
+    bad = _json.dumps({"Statement": [{"Effect": "Allow", "Principal": "*",
+                                      "Action": "s3:GetObject",
+                                      "Resource": "arn:aws:s3:::otherbucket/*"}]})
+    code, _, body = _req(s3, "PUT", "/polbkt", bad.encode(), query="policy")
+    assert code == 400 and b"MalformedPolicy" in body
+
+    # public-read on /pub/* + explicit deny on /secret/* for everyone
+    doc = {
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::polbkt/pub/*"},
+            {"Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::polbkt/secret/*"},
+        ],
+    }
+    code, _, _ = _req(s3, "PUT", "/polbkt", _json.dumps(doc).encode(), query="policy")
+    assert code == 204
+
+    # anonymous: granted exactly where the Allow says, nowhere else
+    code, _, body = _req(s3, "GET", "/polbkt/pub/hello.txt", sign=False)
+    assert code == 200 and body == b"public bytes"
+    assert _req(s3, "GET", "/polbkt/other.txt", sign=False)[0] == 403
+    assert _req(s3, "PUT", "/polbkt/pub/nope.txt", b"x", sign=False)[0] == 403
+    assert _req(s3, "GET", "/polbkt", sign=False)[0] == 403  # list not granted
+
+    # explicit deny overrides the signed admin identity's grant
+    code, _, body = _req(s3, "GET", "/polbkt/secret/s.txt")
+    assert code == 403 and b"bucket policy" in body
+    # ...but only for the denied action: the same identity still writes
+    assert _req(s3, "PUT", "/polbkt/secret/new.txt", b"w")[0] == 200
+    # and undenied objects still read fine
+    assert _req(s3, "GET", "/polbkt/pub/hello.txt")[0] == 200
+
+    # round-trip the stored document
+    code, _, body = _req(s3, "GET", "/polbkt", query="policy")
+    assert code == 200 and _json.loads(body) == doc
+
+    # lockout safety: even a blanket deny cannot take the policy
+    # endpoints away from an admin identity
+    nuke = {"Statement": [{"Effect": "Deny", "Principal": "*", "Action": "s3:*",
+                           "Resource": ["arn:aws:s3:::polbkt",
+                                        "arn:aws:s3:::polbkt/*"]}]}
+    assert _req(s3, "PUT", "/polbkt", _json.dumps(nuke).encode(), query="policy")[0] == 204
+    assert _req(s3, "GET", "/polbkt/pub/hello.txt")[0] == 403  # deny bites
+    assert _req(s3, "DELETE", "/polbkt", query="policy")[0] == 204  # escape hatch
+    assert _req(s3, "GET", "/polbkt/pub/hello.txt")[0] == 200
+    assert _req(s3, "GET", "/polbkt", query="policy")[0] == 404
+    # anonymous grant gone with the policy
+    assert _req(s3, "GET", "/polbkt/pub/hello.txt", sign=False)[0] == 403
+
+
+def test_bucket_policy_principal_scoping(stack):
+    """Principal lists scope statements to named identities; others keep
+    their identity-grant behavior; anonymous never matches a named
+    principal."""
+    import json as _json
+
+    s3 = stack
+    assert _req(s3, "PUT", "/pribkt")[0] == 200
+    assert _req(s3, "PUT", "/pribkt/a.txt", b"data")[0] == 200
+    doc = {
+        "Statement": [
+            {"Effect": "Deny", "Principal": {"AWS": ["arn:aws:iam:::user/tester"]},
+             "Action": "s3:GetObject", "Resource": "arn:aws:s3:::pribkt/*"},
+        ]
+    }
+    assert _req(s3, "PUT", "/pribkt", _json.dumps(doc).encode(), query="policy")[0] == 204
+    # the named identity ("tester" is the stack's admin) is denied
+    assert _req(s3, "GET", "/pribkt/a.txt")[0] == 403
+    # anonymous does NOT match the named principal; falls through to
+    # identity grants and fails there (no credentials)
+    assert _req(s3, "GET", "/pribkt/a.txt", sign=False)[0] == 403
+    assert _req(s3, "DELETE", "/pribkt", query="policy")[0] == 204
+    assert _req(s3, "GET", "/pribkt/a.txt")[0] == 200
+
+
+def test_policy_evaluator_unit():
+    """Wildcard/principal/precedence semantics of the evaluator proper."""
+    import pytest as _pytest
+
+    from seaweedfs_tpu.s3api import policy as P
+
+    def ev(doc, **kw):
+        kw.setdefault("identity_name", "alice")
+        kw.setdefault("access_key", "AKALICE")
+        kw.setdefault("anonymous", False)
+        return P.evaluate(doc, **kw)
+
+    allow_all = {"Statement": [{"Effect": "Allow", "Principal": "*",
+                                "Action": "s3:*", "Resource": "arn:aws:s3:::b/*"}]}
+    assert ev(allow_all, action="s3:GetObject", resource="arn:aws:s3:::b/x") is True
+    # action matching is case-insensitive; resource matching is not a
+    # prefix match — 'b/*' does not cover the bucket ARN itself
+    assert ev(allow_all, action="S3:GETOBJECT", resource="arn:aws:s3:::b/x") is True
+    assert ev(allow_all, action="s3:ListBucket", resource="arn:aws:s3:::b") is None
+    # deny wins over a matching allow regardless of statement order
+    doc = {"Statement": [
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::b/priv/*"},
+        {"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::b/*"},
+    ]}
+    assert ev(doc, action="s3:GetObject", resource="arn:aws:s3:::b/priv/x") is False
+    assert ev(doc, action="s3:GetObject", resource="arn:aws:s3:::b/pub/x") is True
+    # principal forms: bare name, access key, ARN suffix; anonymous only *
+    named = {"Statement": [{"Effect": "Allow",
+                            "Principal": {"AWS": "arn:aws:iam:::user/alice"},
+                            "Action": "s3:GetObject",
+                            "Resource": "arn:aws:s3:::b/*"}]}
+    assert ev(named, action="s3:GetObject", resource="arn:aws:s3:::b/x") is True
+    assert ev(named, identity_name="bob", access_key="AKBOB",
+              action="s3:GetObject", resource="arn:aws:s3:::b/x") is None
+    assert ev(named, anonymous=True, identity_name="anonymous", access_key="",
+              action="s3:GetObject", resource="arn:aws:s3:::b/x") is None
+    # '?' wildcard and bracket-literal safety
+    q = {"Statement": [{"Effect": "Allow", "Principal": "*",
+                        "Action": "s3:GetObject",
+                        "Resource": "arn:aws:s3:::b/v?/[data]/*"}]}
+    assert ev(q, action="s3:GetObject", resource="arn:aws:s3:::b/v1/[data]/f") is True
+    assert ev(q, action="s3:GetObject", resource="arn:aws:s3:::b/v12/[data]/f") is None
+    # parse errors
+    for raw in (b"nope", b"{}", b'{"Statement": []}',
+                b'{"Statement": [{"Effect": "Maybe"}]}'):
+        with _pytest.raises(P.PolicyError):
+            P.parse_policy(raw, "b")
+    with _pytest.raises(P.PolicyError):
+        P.parse_policy(
+            b'{"Statement": [{"Effect": "Allow", "Principal": "*",'
+            b'"Action": "s3:GetObject", "Resource": "arn:aws:s3:::other/*"}]}',
+            "b",
+        )
+
+
+def test_object_versioning_lifecycle(stack):
+    """The VERDICT's SDK-shaped flow: enable versioning, put 2 versions,
+    list them, get the old one by id, delete (marker appears), read old
+    versions through the marker, remove the marker (restore)."""
+    s3 = stack
+    assert _req(s3, "PUT", "/verbkt")[0] == 200
+    # pre-versioning object: becomes the 'null' version later
+    assert _req(s3, "PUT", "/verbkt/doc.txt", b"v0 pre-versioning")[0] == 200
+
+    # enable
+    cfg = (b'<VersioningConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+           b"<Status>Enabled</Status></VersioningConfiguration>")
+    assert _req(s3, "PUT", "/verbkt", cfg, query="versioning")[0] == 200
+    code, _, body = _req(s3, "GET", "/verbkt", query="versioning")
+    assert code == 200 and b"<Status>Enabled</Status>" in body
+
+    # two puts -> two version ids
+    code, h1, _ = _req(s3, "PUT", "/verbkt/doc.txt", b"version one")
+    vid1 = h1.get("x-amz-version-id")
+    assert code == 200 and vid1
+    code, h2, _ = _req(s3, "PUT", "/verbkt/doc.txt", b"version two!")
+    vid2 = h2.get("x-amz-version-id")
+    assert code == 200 and vid2 and vid2 != vid1
+
+    # latest read; version-id reads; null version still reachable
+    code, h, body = _req(s3, "GET", "/verbkt/doc.txt")
+    assert code == 200 and body == b"version two!"
+    assert h.get("x-amz-version-id") == vid2
+    code, _, body = _req(s3, "GET", f"/verbkt/doc.txt", query=f"versionId={vid1}")
+    assert code == 200 and body == b"version one"
+    code, _, body = _req(s3, "GET", "/verbkt/doc.txt", query="versionId=null")
+    assert code == 200 and body == b"v0 pre-versioning"
+    code, _, body = _req(s3, "GET", "/verbkt/doc.txt", query="versionId=" + "0" * 24)
+    assert code == 404 and b"NoSuchVersion" in body
+    # versionId is path material: anything outside the minted-id/null
+    # grammar (e.g. a '..' traversal at another bucket's objects) is 400
+    for evil in ("nonexistent", "..%2F..%2Fother%2Fsecret.txt", "a/../b"):
+        code, _, body = _req(s3, "GET", "/verbkt/doc.txt", query=f"versionId={evil}")
+        assert code == 400, evil
+        code, _, _ = _req(s3, "DELETE", "/verbkt/doc.txt", query=f"versionId={evil}")
+        assert code == 400, evil
+
+    # list versions: newest first, IsLatest on the head
+    code, _, body = _req(s3, "GET", "/verbkt", query="versions")
+    assert code == 200
+    tree = _xml(body)
+    ns = tree.tag[: tree.tag.index("}") + 1]
+    vers = tree.findall(f"{ns}Version")
+    assert [v.find(f"{ns}VersionId").text for v in vers] == [vid2, vid1, "null"]
+    assert [v.find(f"{ns}IsLatest").text for v in vers] == ["true", "false", "false"]
+
+    # plain delete -> marker; key 404s but versions still read
+    code, h, _ = _req(s3, "DELETE", "/verbkt/doc.txt")
+    assert code == 204 and h.get("x-amz-delete-marker") == "true"
+    marker_vid = h.get("x-amz-version-id")
+    assert marker_vid
+    code, h, _ = _req(s3, "GET", "/verbkt/doc.txt")
+    assert code == 404 and h.get("x-amz-delete-marker") == "true"
+    code, _, body = _req(s3, "GET", f"/verbkt/doc.txt", query=f"versionId={vid2}")
+    assert code == 200 and body == b"version two!"
+    # marker shows in the listing as the latest
+    code, _, body = _req(s3, "GET", "/verbkt", query="versions")
+    tree = _xml(body)
+    dms = tree.findall(f"{ns}DeleteMarker")
+    assert len(dms) == 1 and dms[0].find(f"{ns}IsLatest").text == "true"
+    assert dms[0].find(f"{ns}VersionId").text == marker_vid
+    # GET of the marker version itself is 405
+    assert _req(s3, "GET", f"/verbkt/doc.txt", query=f"versionId={marker_vid}")[0] == 405
+
+    # deleting the marker restores the newest real version
+    code, h, _ = _req(s3, "DELETE", f"/verbkt/doc.txt", query=f"versionId={marker_vid}")
+    assert code == 204 and h.get("x-amz-delete-marker") == "true"
+    code, _, body = _req(s3, "GET", "/verbkt/doc.txt")
+    assert code == 200 and body == b"version two!"
+
+    # permanent delete of the latest promotes the next-newest
+    code, _, _ = _req(s3, "DELETE", f"/verbkt/doc.txt", query=f"versionId={vid2}")
+    assert code == 204
+    code, _, body = _req(s3, "GET", "/verbkt/doc.txt")
+    assert code == 200 and body == b"version one"
+    code, _, body = _req(s3, "GET", "/verbkt", query="versions")
+    tree = _xml(body)
+    vers = tree.findall(f"{ns}Version")
+    assert [v.find(f"{ns}VersionId").text for v in vers] == [vid1, "null"]
+
+    # versioned keys stay invisible to plain listings' archives
+    code, _, body = _req(s3, "GET", "/verbkt")
+    assert body.count(b"<Key>doc.txt</Key>") == 1 and b".s3versions" not in body
+
+    # reserved suffix refused everywhere
+    assert _req(s3, "PUT", "/verbkt/evil.s3versions", b"x")[0] == 400
+    assert _req(s3, "PUT", "/verbkt/a.s3versions/b", b"x")[0] == 400
+
+
+def test_versioning_suspended_and_bulk_markers(stack):
+    """Suspended buckets overwrite the 'null' version in place but keep
+    the archive readable; bulk DeleteObjects plants markers when enabled."""
+    s3 = stack
+    assert _req(s3, "PUT", "/susbkt")[0] == 200
+    cfg_on = (b"<VersioningConfiguration><Status>Enabled</Status>"
+              b"</VersioningConfiguration>")
+    cfg_off = (b"<VersioningConfiguration><Status>Suspended</Status>"
+               b"</VersioningConfiguration>")
+    assert _req(s3, "PUT", "/susbkt", cfg_on, query="versioning")[0] == 200
+    code, h, _ = _req(s3, "PUT", "/susbkt/f.txt", b"enabled-era")
+    vid_real = h.get("x-amz-version-id")
+    assert vid_real and vid_real != "null"
+    assert _req(s3, "PUT", "/susbkt", cfg_off, query="versioning")[0] == 200
+    # suspended puts carry the null id and replace each other
+    code, h, _ = _req(s3, "PUT", "/susbkt/f.txt", b"null one")
+    assert h.get("x-amz-version-id") == "null"
+    code, h, _ = _req(s3, "PUT", "/susbkt/f.txt", b"null two")
+    assert h.get("x-amz-version-id") == "null"
+    code, _, body = _req(s3, "GET", "/susbkt/f.txt")
+    assert body == b"null two"
+    # the enabled-era version survived the suspended overwrites
+    code, _, body = _req(s3, "GET", "/susbkt/f.txt", query=f"versionId={vid_real}")
+    assert code == 200 and body == b"enabled-era"
+    code, _, body = _req(s3, "GET", "/susbkt", query="versions")
+    tree = _xml(body)
+    ns = tree.tag[: tree.tag.index("}") + 1]
+    vids = [v.find(f"{ns}VersionId").text for v in tree.findall(f"{ns}Version")]
+    assert vids == ["null", vid_real]
+
+    # bulk delete on an Enabled bucket reports the marker per key
+    assert _req(s3, "PUT", "/susbkt", cfg_on, query="versioning")[0] == 200
+    payload = (b"<Delete><Object><Key>f.txt</Key></Object></Delete>")
+    code, _, body = _req(s3, "POST", "/susbkt", payload, query="delete")
+    assert code == 200 and b"<DeleteMarker>true</DeleteMarker>" in body
+    assert _req(s3, "GET", "/susbkt/f.txt")[0] == 404
+    # both old versions still listed beneath the marker
+    code, _, body = _req(s3, "GET", "/susbkt", query="versions")
+    tree = _xml(body)
+    assert len(tree.findall(f"{ns}Version")) == 2
+    assert len(tree.findall(f"{ns}DeleteMarker")) == 1
+
+
+def test_multipart_upload_versioned_bucket(stack):
+    """CompleteMultipartUpload on a versioned bucket mints a version id
+    and archives the previous latest instead of destroying it."""
+    s3 = stack
+    assert _req(s3, "PUT", "/mpver")[0] == 200
+    cfg = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    assert _req(s3, "PUT", "/mpver", cfg, query="versioning")[0] == 200
+    code, h, _ = _req(s3, "PUT", "/mpver/big.bin", b"old small version")
+    old_vid = h.get("x-amz-version-id")
+    code, _, body = _req(s3, "POST", "/mpver/big.bin", query="uploads=")
+    upload_id = _xml(body).find(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId").text
+    part = os.urandom(256 * 1024)
+    code, headers, _ = _req(
+        s3, "PUT", "/mpver/big.bin", part,
+        query=f"partNumber=1&uploadId={upload_id}",
+    )
+    etag = headers["ETag"]
+    done = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+            f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>").encode()
+    code, h, _ = _req(s3, "POST", "/mpver/big.bin", done, query=f"uploadId={upload_id}")
+    new_vid = h.get("x-amz-version-id")
+    assert code == 200 and new_vid and new_vid != old_vid
+    code, _, body = _req(s3, "GET", "/mpver/big.bin")
+    assert code == 200 and body == part
+    code, _, body = _req(s3, "GET", "/mpver/big.bin", query=f"versionId={old_vid}")
+    assert code == 200 and body == b"old small version"
+
+
+def test_policy_binds_copy_source_and_bulk_delete(stack):
+    """A policy-denied object must not leak through CopyObject, and
+    per-prefix s3:DeleteObject denies must bind inside bulk DeleteObjects
+    (both bypass the plain per-request resource check)."""
+    import json as _json
+
+    s3 = stack
+    assert _req(s3, "PUT", "/srcb")[0] == 200
+    assert _req(s3, "PUT", "/dstb")[0] == 200
+    assert _req(s3, "PUT", "/srcb/secret/x.txt", b"classified")[0] == 200
+    assert _req(s3, "PUT", "/srcb/keep/y.txt", b"precious")[0] == 200
+    doc = {"Statement": [
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::srcb/secret/*"},
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:DeleteObject",
+         "Resource": "arn:aws:s3:::srcb/keep/*"},
+    ]}
+    assert _req(s3, "PUT", "/srcb", _json.dumps(doc).encode(), query="policy")[0] == 204
+    # CopyObject with a denied source: 403, nothing written
+    code, _, body = _req(s3, "PUT", "/dstb/stolen.txt",
+                         headers={"x-amz-copy-source": "/srcb/secret/x.txt"})
+    assert code == 403 and b"source bucket policy" in body
+    assert _req(s3, "GET", "/dstb/stolen.txt")[0] == 404
+    # UploadPartCopy rides the same resolver
+    code, _, body = _req(s3, "POST", "/dstb/big.bin", query="uploads=")
+    upload_id = _xml(body).find(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId").text
+    code, _, _ = _req(s3, "PUT", "/dstb/big.bin",
+                      query=f"partNumber=1&uploadId={upload_id}",
+                      headers={"x-amz-copy-source": "/srcb/secret/x.txt"})
+    assert code == 403
+    # bulk delete: the protected prefix survives, the rest deletes
+    payload = (b"<Delete><Object><Key>keep/y.txt</Key></Object>"
+               b"<Object><Key>secret/x.txt</Key></Object></Delete>")
+    code, _, body = _req(s3, "POST", "/srcb", payload, query="delete")
+    assert code == 200
+    assert b"<Code>AccessDenied</Code>" in body and b"keep/y.txt" in body
+    assert _req(s3, "GET", "/srcb/keep/y.txt")[0] == 200  # survived
+    # the unprotected key really went (GetObject denied -> check via list)
+    code, _, listing = _req(s3, "GET", "/srcb")
+    assert b"secret/x.txt" not in listing
+    assert _req(s3, "DELETE", "/srcb", query="policy")[0] == 204
+
+
+def test_versioning_suspended_delete_removes_null(stack):
+    """DELETE (no versionId) on a Suspended bucket removes the 'null'
+    version and leaves a null marker — the key must read 404, not serve
+    the supposedly deleted bytes."""
+    s3 = stack
+    assert _req(s3, "PUT", "/susdel")[0] == 200
+    cfg = (b"<VersioningConfiguration><Status>Suspended</Status>"
+           b"</VersioningConfiguration>")
+    assert _req(s3, "PUT", "/susdel", cfg, query="versioning")[0] == 200
+    assert _req(s3, "PUT", "/susdel/f.txt", b"null bytes")[0] == 200
+    code, h, _ = _req(s3, "DELETE", "/susdel/f.txt")
+    assert code == 204 and h.get("x-amz-delete-marker") == "true"
+    assert h.get("x-amz-version-id") == "null"
+    assert _req(s3, "GET", "/susdel/f.txt")[0] == 404
+    code, _, body = _req(s3, "GET", "/susdel", query="versions")
+    tree = _xml(body)
+    ns = tree.tag[: tree.tag.index("}") + 1]
+    assert len(tree.findall(f"{ns}Version")) == 0
+    assert len(tree.findall(f"{ns}DeleteMarker")) == 1
+
+
+def test_policy_rejects_unsupported_statement_fields(stack):
+    """A Condition the evaluator does not implement must be rejected at
+    PUT time — silently ignoring it would turn a conditional Allow into
+    an unconditional public grant."""
+    import json as _json
+
+    s3 = stack
+    assert _req(s3, "PUT", "/uncond")[0] == 200
+    doc = {"Statement": [{"Effect": "Allow", "Principal": "*",
+                          "Action": "s3:GetObject",
+                          "Resource": "arn:aws:s3:::uncond/*",
+                          "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}}}]}
+    code, _, body = _req(s3, "PUT", "/uncond", _json.dumps(doc).encode(), query="policy")
+    assert code == 400 and b"Condition" in body
+    assert _req(s3, "GET", "/uncond", query="policy")[0] == 404  # nothing stored
