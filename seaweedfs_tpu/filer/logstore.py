@@ -174,10 +174,21 @@ class LogKv:
             self._f.close()
             self._r.close()
             os.replace(tmp, self.path)
+            # restore a fully usable store BEFORE the durability barrier: a
+            # failing dir-fsync must surface the error without leaving
+            # closed handles and a stale index behind
             self._f = open(self.path, "ab")
             self._r = open(self.path, "rb")
             self._index = new_index
             self._dead_bytes = 0
+            # the rename itself must survive power loss: fsync the parent
+            # directory or the swap may vanish and resurrect pre-compaction
+            # state (including data deleted since)
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
 
 
 class LogFilerStore(FilerStore):
