@@ -84,10 +84,12 @@ def steady_gbps(encode_fn, data):
 
 
 def _kernel_bf16(b_ref, data_ref, out_ref):
+    # r5 layout: plane-major on BOTH sides (matches rs_pallas._kernel and
+    # the doubly-permuted matrix from plane_major_matrix) + uint8-native
+    # unpack — only the MXU dtype differs from the int8 kernel
     data = data_ref[0]
-    wide = data.astype(jnp.int32)
     bits = jnp.concatenate(
-        [((wide >> j) & 1) for j in range(8)], axis=0
+        [((data >> j) & 1) for j in range(8)], axis=0
     ).astype(jnp.bfloat16)
     acc = jax.lax.dot_general(
         b_ref[...].astype(jnp.bfloat16),
@@ -97,10 +99,10 @@ def _kernel_bf16(b_ref, data_ref, out_ref):
     ).astype(jnp.int32)
     acc = acc & 1
     rows8, t = acc.shape
-    acc3 = acc.reshape(rows8 // 8, 8, t)
-    out = acc3[:, 0, :]
+    acc3 = acc.reshape(8, rows8 // 8, t)
+    out = acc3[0]
     for i in range(1, 8):
-        out = out | (acc3[:, i, :] << i)
+        out = out | (acc3[i] << i)
     out_ref[0] = out.astype(jnp.uint8)
 
 
@@ -108,6 +110,7 @@ def _kernel_bf16(b_ref, data_ref, out_ref):
 def _apply_bf16(b_pm, data, tile: int):
     batch, c, n = data.shape
     rows = b_pm.shape[0] // 8
+    interpret = jax.devices()[0].platform == "cpu"  # --tiny exactness runs
     return pl.pallas_call(
         _kernel_bf16,
         grid=(batch, n // tile),
@@ -117,11 +120,17 @@ def _apply_bf16(b_pm, data, tile: int):
         ],
         out_specs=pl.BlockSpec((1, rows, tile), lambda b, i: (b, 0, i)),
         out_shape=jax.ShapeDtypeStruct((batch, rows, n), jnp.uint8),
+        interpret=interpret,
     )(b_pm, data)
 
 
 def main():
     quick = "--quick" in sys.argv
+    # JAX_PLATFORMS=cpu must win over the axon sitecustomize (a cpu sanity
+    # run must never touch — or hang on — the one-client TPU tunnel)
+    from seaweedfs_tpu.utils.devices import honor_platform_env
+
+    honor_platform_env()
     print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
 
     pm = gf8.parity_matrix(10, 4)
@@ -147,8 +156,12 @@ def main():
                 lambda d, tt: rs_pallas.gf_apply_fused(b_bits, d, tile=tt), tt=t))
         )
         variants.append(
+            # clamp the tile to the input: the golden gate feeds n=8192,
+            # and grid=(batch, n // tile) with tile > n would be an empty
+            # grid — all-zero output, every large-tile variant failing the
+            # gate before it was ever measured
             (f"pallas-bf16-{t}", functools.partial(
-                lambda d, tt: _apply_bf16(b_pm, d, tt), tt=t))
+                lambda d, tt: _apply_bf16(b_pm, d, min(tt, d.shape[2])), tt=t))
         )
 
     results = {}

@@ -763,6 +763,13 @@ class VolumeServer:
 
     def _rpc_ec_shard_read(self, req: dict, ctx):
         """Stream bytes from one local shard (remote interval reads)."""
+        delay_ms = os.environ.get("WEEDTPU_BENCH_RPC_DELAY_MS", "")
+        if delay_ms:
+            # bench-only network simulation: on a 1-core loopback host the
+            # real cost of a remote fetch is CPU, so parallelism cannot
+            # show; a server-side sleep models the RTT that dominates real
+            # clusters (and releases the GIL, so overlap is measurable)
+            time.sleep(float(delay_ms) / 1e3)
         vid = int(req["volume_id"])
         shard_id = int(req["shard_id"])
         offset = int(req["offset"])

@@ -42,14 +42,17 @@ DEFAULT_TILE = 8192
 def _kernel(b_ref, data_ref, out_ref):
     data = data_ref[0]  # (C, T) uint8
     c, t = data.shape
-    # Plane-major bit layout: row j*C + ci = bit j of input byte-row ci.
+    # Plane-major bit layout ON BOTH SIDES (ROOFLINE_r05.md hyps 1+3):
+    #   input  row j*C + ci = bit j of input byte-row ci
+    #   output row i*R + r  = bit i of output byte-row r
     # Concatenating whole (C, T) blocks keeps every plane in its natural
-    # VMEM layout — the earlier byte-major stack(axis=1).reshape forced a
-    # per-byte sublane interleave that Mosaic had to shuffle for. The
-    # lifted matrix's COLUMNS are pre-permuted host-side to match (free).
-    wide = data.astype(jnp.int32)
+    # VMEM layout — a byte-major stack(axis=1).reshape forces a per-byte
+    # sublane interleave Mosaic must shuffle for. The lifted matrix's
+    # columns AND rows are pre-permuted host-side to match (free). The
+    # unpack shifts uint8 directly: an int32 widen quadruples the VMEM
+    # working set and costs a relayout before the shifts.
     bits = jnp.concatenate(
-        [((wide >> j) & 1) for j in range(8)], axis=0
+        [((data >> j) & 1) for j in range(8)], axis=0
     ).astype(jnp.int8)
     acc = jax.lax.dot_general(
         b_ref[...],
@@ -57,25 +60,29 @@ def _kernel(b_ref, data_ref, out_ref):
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
-    acc = acc & 1  # (R*8, T), rows r*8 + i
-    # pack on the VPU: out[r] = sum_i acc[r*8+i] << i. Leading-dim reshape
-    # regroups rows without touching the minor (lane) dimension; 7 shifted
-    # ORs beat the old tiny f32 pack-matmul (M=R wastes the 128x128 MXU).
+    acc = acc & 1  # (8*R, T), rows i*R + r — plane-major
+    # pack on the VPU: out[r] = sum_i acc[i*R + r] << i. With plane-major
+    # rows each acc3[i] is a CONTIGUOUS (R, T) block (sublane stride 1);
+    # the old byte-major pack read with sublane stride 8, which Mosaic
+    # lowered to per-sublane shuffles.
     rows8, _ = acc.shape
-    acc3 = acc.reshape(rows8 // 8, 8, t)
-    out = acc3[:, 0, :]
+    acc3 = acc.reshape(8, rows8 // 8, t)
+    out = acc3[0]
     for i in range(1, 8):
-        out = out | (acc3[:, i, :] << i)
+        out = out | (acc3[i] << i)
     out_ref[0] = out.astype(jnp.uint8)
 
 
 def _plane_major_columns(b_bits: np.ndarray) -> np.ndarray:
     """Permute the lifted matrix's columns from byte-major (ci*8 + j) to
-    plane-major (j*C + ci), matching the kernel's bit layout."""
+    plane-major (j*C + ci), AND its rows from byte-major (r*8 + i) to
+    plane-major (i*R + r) — both sides of the kernel's bit layout."""
     rows8, cols8 = b_bits.shape
     c = cols8 // 8
-    perm = [(k % c) * 8 + (k // c) for k in range(cols8)]
-    return np.asarray(b_bits)[:, perm]
+    r = rows8 // 8
+    col_perm = [(k % c) * 8 + (k // c) for k in range(cols8)]
+    row_perm = [(k % r) * 8 + (k // r) for k in range(rows8)]
+    return np.asarray(b_bits)[np.ix_(row_perm, col_perm)]
 
 
 def _on_tpu() -> bool:
@@ -89,6 +96,13 @@ def _apply_padded(b_pm, data, tile: int, interpret: bool):
     batch, c, n = data.shape
     rows = b_pm.shape[0] // 8
     grid = (batch, n // tile)
+    kwargs = {}
+    if not interpret:
+        # every grid step is independent (disjoint tiles): telling Mosaic
+        # so unlocks unconstrained pipelining of the HBM<->VMEM windows
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        )
     return pl.pallas_call(
         _kernel,
         grid=grid,
@@ -99,6 +113,7 @@ def _apply_padded(b_pm, data, tile: int, interpret: bool):
         out_specs=pl.BlockSpec((1, rows, tile), lambda b, i: (b, 0, i)),
         out_shape=jax.ShapeDtypeStruct((batch, rows, n), jnp.uint8),
         interpret=interpret,
+        **kwargs,
     )(b_pm, data)
 
 
