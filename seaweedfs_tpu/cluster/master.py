@@ -115,7 +115,7 @@ class MasterServer:
         now = time.monotonic()
         with self._admin_lock_mu:
             locks = {
-                name: [tok, max(0.0, exp - now), client]
+                name: {"token": tok, "ttl_s": max(0.0, exp - now), "client": client}
                 for name, (tok, exp, client) in self._admin_locks.items()
                 if exp > now
             }
@@ -145,8 +145,12 @@ class MasterServer:
             if version >= (self._lock_term, self._lock_seq):
                 self._lock_term, self._lock_seq = version
                 self._admin_locks = {
-                    name: (int(tok), now + float(ttl), client)
-                    for name, (tok, ttl, client) in payload.get("admin_locks", {}).items()
+                    name: (
+                        int(d["token"]),
+                        now + float(d["ttl_s"]),
+                        d.get("client", ""),
+                    )
+                    for name, d in payload.get("admin_locks", {}).items()
                 }
 
     def _on_become_leader(self) -> None:
@@ -172,10 +176,9 @@ class MasterServer:
         return self.raft.leader or ""
 
     def _not_leader_response(self) -> dict:
-        # "Leader" (capitalized) rides along for curl-level clients that
-        # follow the reference's HTTP error shape
-        addr = self._leader_address()
-        return {"error": "not the raft leader", "leader": addr, "Leader": addr}
+        # one canonical key on the RPC wire; the HTTP facade re-emits it
+        # as the reference's capitalized "Leader" for curl-level clients
+        return {"error": "not the raft leader", "leader": self._leader_address()}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -410,7 +413,7 @@ class MasterServer:
     def _rpc_filer_heartbeat(self, req: dict, ctx) -> dict:
         """Cluster-node announce for filers AND mq brokers (node_type
         distinguishes them; default 'filer' keeps old clients working)."""
-        node_type = req.get("node_type", "filer")
+        node_type = req.get("node_type") or "filer"
         with self._admin_lock_mu:  # small table; reuse the mutex
             if not hasattr(self, "_cluster_nodes"):
                 self._cluster_nodes = {}
@@ -454,7 +457,7 @@ class MasterServer:
     def _rpc_lease_admin_token(self, req: dict, ctx) -> dict:
         if not self.is_leader:
             raise rpc.NotLeaderFault(self._leader_address())
-        name = req.get("lock_name", "admin")
+        name = req.get("lock_name") or "admin"
         prev = int(req.get("previous_token", 0))
         now = time.monotonic()
         with self._admin_lock_mu:
@@ -498,7 +501,7 @@ class MasterServer:
             # must land on the leader: a follower-local delete is lost and
             # the replicated lock table keeps the cluster locked till TTL
             raise rpc.NotLeaderFault(self._leader_address())
-        name = req.get("lock_name", "admin")
+        name = req.get("lock_name") or "admin"
         prev = int(req.get("previous_token", 0))
         with self._admin_lock_mu:
             holder = self._admin_locks.get(name)

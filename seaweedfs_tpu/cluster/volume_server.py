@@ -13,6 +13,7 @@ master (the reference's bidi stream collapsed; deltas ride the next tick).
 
 from __future__ import annotations
 
+import base64
 import http.server
 import json
 import os
@@ -44,6 +45,34 @@ from seaweedfs_tpu.security import tls
 _COPY_CHUNK = 1024 * 1024
 _EC_EXTS = [".ecx", ".ecj", ".eci"]
 EC_SHARD_READ_TIMEOUT = 10.0  # s; per-holder cap on one interval read
+
+
+def _first_multipart_file(body: bytes, ctype: str):
+    """(bytes, filename, mime) of the first file part of a form upload,
+    or None. email.parser handles the RFC 2046 framing (boundaries,
+    part headers, trailing CRLF) so the needle stores exactly the file
+    bytes the client attached."""
+    import email.parser
+
+    msg = email.parser.BytesParser().parsebytes(
+        b"Content-Type: "
+        + ctype.encode("latin-1", "replace")  # header charset; never raises
+        + b"\r\n\r\n"
+        + body
+    )
+    if not msg.is_multipart():
+        return None
+    parts = msg.get_payload()
+    chosen = next(
+        (p for p in parts if p.get_filename()), parts[0] if parts else None
+    )
+    if chosen is None:
+        return None
+    payload = chosen.get_payload(decode=True)
+    if payload is None:
+        return None
+    fname = (chosen.get_filename() or "").encode("utf-8", "surrogateescape")
+    return payload, fname, chosen.get_content_type()
 
 
 class VolumeServer:
@@ -365,7 +394,7 @@ class VolumeServer:
         self.store.create_volume(
             int(req["volume_id"]),
             collection=req.get("collection", ""),
-            replication=req.get("replication", "000"),
+            replication=req.get("replication") or "000",
             ttl=req.get("ttl", ""),
         )
         return {}
@@ -492,7 +521,7 @@ class VolumeServer:
             info = tier_move(
                 v.base_path,
                 client,
-                key_prefix=req.get("key_prefix", "volumes/"),
+                key_prefix=req.get("key_prefix") or "volumes/",
                 keep_local=True,
             )
         except Exception:
@@ -635,14 +664,20 @@ class VolumeServer:
         v = self.store.get_volume(int(req["volume_id"]))
         if v is None:
             raise rpc.NotFoundFault(f"volume {req['volume_id']} not found")
-        limit = min(int(req.get("limit", 65536)), 65536)
+        limit = min(int(req.get("limit") or 65536), 65536)
         if req.get("tombstones"):  # tombstone-history page, same resume protocol
             rows, truncated = v.tombstone_history(
                 int(req.get("deleted_start_from", 0)), limit
             )
-            return {"deleted": rows, "deleted_truncated": truncated}
+            return {
+                "deleted": [{"id": k, "final_dead": d} for k, d in rows],
+                "deleted_truncated": truncated,
+            }
         entries, truncated = v.needle_entries_page(int(req.get("start_from", 0)), limit)
-        return {"entries": entries, "truncated": truncated}
+        return {
+            "entries": [{"id": k, "size": s} for k, s in entries],
+            "truncated": truncated,
+        }
 
     def _rpc_needle_ts(self, req: dict, ctx) -> dict:
         """Batch append_at_ns lookup (8-byte read per needle, no payload)
@@ -976,10 +1011,20 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def do_HEAD(self) -> None:
         self._serve_get(head=True)
 
-    def _replicate(self, fid: FileId, method: str, data: Optional[bytes], ctype: str) -> Optional[str]:
+    def _replicate(
+        self,
+        fid: FileId,
+        method: str,
+        data: Optional[bytes],
+        ctype: str,
+        name: bytes = b"",
+    ) -> Optional[str]:
         """Fan a write/delete out to the volume's sibling replicas
         (store_replicate.go analog). Returns an error string, or None.
-        The X-Weed-Replicate header stops forwarding loops."""
+        The X-Weed-Replicate header stops forwarding loops; the filename
+        of a form upload rides X-Weed-Filename (b64) so replica needles
+        stay byte-identical to the primary's (check.disk compares per-id
+        sizes, and the name is part of the needle body)."""
         try:
             resp = self.vs._master_query(
                 "Lookup", {"volume_or_file_ids": [str(fid.volume_id)]}
@@ -1009,6 +1054,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         "X-Weed-Replicate": "1",
                         **auth,
                         **({"Content-Type": ctype} if ctype else {}),
+                        **(
+                            {"X-Weed-Filename": base64.b64encode(name).decode()}
+                            if name
+                            else {}
+                        ),
                     },
                 )
                 with tls.urlopen(req, timeout=self.vs.replicate_timeout) as r:
@@ -1044,9 +1094,33 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         data = self.rfile.read(length)
         ctype = self.headers.get("Content-Type", "")
+        name = b""
+        if ctype.startswith("multipart/form-data"):
+            # the reference's canonical workflow is `curl -F file=@x URL`
+            # ([ref: weed/server/volume_server_handlers_write.go +
+            # needle parsing of form uploads — mount empty]); storing the
+            # raw form would hand the framing back as file bytes on read
+            try:
+                part = _first_multipart_file(data, ctype)
+            except Exception:  # noqa: BLE001 — malformed framing is a 400
+                part = None
+            if part is None:
+                self._reply_json(400, {"error": "no file part in form data"})
+                return
+            data, name, part_mime = part
+            ctype = part_mime
+        elif self.headers.get("X-Weed-Filename"):
+            # replica hop: the primary forwards the parsed form filename
+            # so sibling needles stay byte-identical
+            try:
+                name = base64.b64decode(self.headers["X-Weed-Filename"])
+            except Exception:  # noqa: BLE001 — bad header: store unnamed
+                name = b""
         n = Needle(cookie=fid.cookie, id=fid.key, data=data)
+        if name:
+            n.name = name
         if ctype and ctype != "application/octet-stream":
-            n.mime = ctype.encode()
+            n.mime = ctype.encode("utf-8", "surrogateescape")
         try:
             _, size = self.vs.store.write_needle(fid.volume_id, n)
         except KeyError:
@@ -1055,8 +1129,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except VolumeReadOnly as e:
             self._reply_json(422, {"error": str(e)})
             return
+        except ValueError as e:
+            # client-controlled inputs (255-byte name/mime caps, framing)
+            # must answer 400, not abort the connection
+            self._reply_json(400, {"error": str(e)})
+            return
         if "X-Weed-Replicate" not in self.headers:
-            err = self._replicate(fid, "POST", data, ctype)
+            err = self._replicate(fid, "POST", data, ctype, name=name)
             if err:
                 # strict replication (the reference fails the write when the
                 # fan-out fails): surface the partial state to the client

@@ -313,7 +313,7 @@ class FilerServer:
             req["directory"],
             start_from=req.get("start_from", ""),
             include_start=bool(req.get("inclusive_start_from", False)),
-            limit=int(req.get("limit", 1024)),
+            limit=int(req.get("limit") or 1024),
             prefix=req.get("prefix", ""),
         )
         return {"entries": [e.to_dict() for e in entries]}

@@ -254,14 +254,14 @@ class Broker:
     def _rpc_join_group(self, req: dict, ctx) -> dict:
         import time as _time
 
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         topic = req["topic"]
         conf = self._topic_conf(ns, topic)
         if conf is None:
             raise rpc.NotFoundFault(f"topic {ns}/{topic} not configured")
-        count = int(conf.get("partition_count", 4))
+        count = int(conf.get("partition_count") or 4)
         cid = req["consumer_id"]
-        key = (ns, topic, req.get("group", "default"))
+        key = (ns, topic, req.get("group") or "default")
         now = _time.monotonic()
         with self._lock:
             # lookup-or-create and mutate under ONE lock hold: a racing
@@ -284,8 +284,8 @@ class Broker:
     def _rpc_group_heartbeat(self, req: dict, ctx) -> dict:
         import time as _time
 
-        ns = req.get("namespace", "default")
-        key = (ns, req["topic"], req.get("group", "default"))
+        ns = req.get("namespace") or "default"
+        key = (ns, req["topic"], req.get("group") or "default")
         now = _time.monotonic()
         with self._lock:
             # look up WITHOUT creating: a typo'd topic/group must error,
@@ -305,8 +305,8 @@ class Broker:
             return {"generation": g.generation}
 
     def _rpc_leave_group(self, req: dict, ctx) -> dict:
-        ns = req.get("namespace", "default")
-        key = (ns, req["topic"], req.get("group", "default"))
+        ns = req.get("namespace") or "default"
+        key = (ns, req["topic"], req.get("group") or "default")
         with self._lock:
             g = self._groups.get(key)
             if g is None:
@@ -322,18 +322,18 @@ class Broker:
         return f"mq.offset/{ns}/{topic}/{group}/{partition:04d}"
 
     def _rpc_commit_offset(self, req: dict, ctx) -> dict:
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         key = self._offset_key(
-            ns, req["topic"], req.get("group", "default"), int(req["partition"])
+            ns, req["topic"], req.get("group") or "default", int(req["partition"])
         )
         self.filer.kv_put(key, str(int(req["ts_ns"])).encode())
         return {}
 
     def _rpc_fetch_offset(self, req: dict, ctx) -> dict:
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         raw = self.filer.kv_get(
             self._offset_key(
-                ns, req["topic"], req.get("group", "default"), int(req["partition"])
+                ns, req["topic"], req.get("group") or "default", int(req["partition"])
             )
         )
         return {"ts_ns": int(raw.decode()) if raw else 0}
@@ -341,9 +341,9 @@ class Broker:
     def _rpc_configure(self, req: dict, ctx) -> dict:
         from seaweedfs_tpu.filer.entry import Entry
 
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         topic = req["topic"]
-        count = int(req.get("partition_count", 4))
+        count = int(req.get("partition_count") or 4)
         path = f"{TOPICS_ROOT}/{ns}/{topic}"
         e = self.filer.lookup(path)
         if e is None:
@@ -353,7 +353,7 @@ class Broker:
         return {"partition_count": count}
 
     def _rpc_list(self, req: dict, ctx) -> dict:
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         out = []
         for e in self.filer.list(f"{TOPICS_ROOT}/{ns}", limit=10000):
             if e.is_directory:
@@ -368,12 +368,12 @@ class Broker:
     def _rpc_publish(self, req: dict, ctx) -> dict:
         import base64
 
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         topic = req["topic"]
         conf = self._topic_conf(ns, topic)
         if conf is None:
             raise rpc.NotFoundFault(f"topic {ns}/{topic} not configured")
-        count = int(conf.get("partition_count", 4))
+        count = int(conf.get("partition_count") or 4)
         key = base64.b64decode(req.get("key", ""))
         value = base64.b64decode(req.get("value", ""))
         if "partition" in req:
@@ -386,7 +386,7 @@ class Broker:
         return {"partition": index, "ts_ns": ts}
 
     def _rpc_subscribe(self, req: dict, ctx):
-        ns = req.get("namespace", "default")
+        ns = req.get("namespace") or "default"
         topic = req["topic"]
         index = int(req.get("partition", 0))
         since = int(req.get("since_ns", 0))

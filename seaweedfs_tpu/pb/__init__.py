@@ -1,10 +1,13 @@
 """Wire contracts — the dataclass mirror of weed/pb/master.proto +
 volume_server.proto [VERIFY: mount empty; SURVEY.md §2.1 "Protos" row].
 
-protoc-gen-python/grpcio-tools are absent from this image, so contracts are
-dataclasses serialized as JSON over the generic-handler transport in
-seaweedfs_tpu.rpc. Field names follow the reference protos so a future
-protobuf swap is mechanical.
+Two wire formats share the contracts.proto schema: the default JSON
+transport over seaweedfs_tpu.rpc's generic handlers, and a BINARY
+PROTOBUF wire (WEEDTPU_WIRE=proto) built by pb/wire.py from a protoc
+FileDescriptorSet at runtime — grpcio-tools codegen is absent from the
+image, so message classes come from google.protobuf.message_factory
+instead of generated _pb2 modules. Field names below match the
+reference protos.
 
 Services and methods (paths are /<service>/<method>):
 

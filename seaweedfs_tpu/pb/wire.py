@@ -1,0 +1,305 @@
+"""Binary protobuf wire for the pinned contracts — the real protobuf
+transport the reference speaks ([ref: weed/pb/*.proto — mount empty,
+SURVEY.md §2.6]).
+
+This image has protoc and the google.protobuf runtime but not
+protoc-gen-python/grpcio-tools, so instead of generated _pb2 modules the
+codec builds message classes AT RUNTIME from a FileDescriptorSet:
+protoc compiles `contracts.proto` to `contracts.desc` (regenerated on
+demand when protoc is present; the committed artifact serves
+protoc-less deploys), and `message_factory` turns each descriptor into
+a concrete class.
+
+Handlers keep their dict-shaped requests/responses — the codec converts
+strictly between dicts and messages:
+
+  - field names match 1:1 (the dict key IS the proto field name);
+    an unknown dict key raises instead of silently dropping data
+  - 64-bit ints stay Python ints (proto3 JSON would stringify them)
+  - `bytes` fields carry base64 strings in the dicts (the JSON wire's
+    convention) and raw bytes on the wire
+  - maps accept str keys for integer key types ({"7": ...}), matching
+    how JSON object keys arrive today
+
+Switch: WEEDTPU_WIRE=proto flips every unary JSON method whose
+(service, method) pair exists in the schema to binary protobuf on BOTH
+the server's generic handlers and the client stubs; streams keep their
+raw byte frames. All processes of a cluster must agree (same env),
+like a reference cluster agrees on its .proto version.
+"""
+
+from __future__ import annotations
+
+import base64
+import functools
+import os
+import shutil
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+PROTO_PATH = os.path.join(_HERE, "contracts.proto")
+DESC_PATH = os.path.join(_HERE, "contracts.desc")
+
+_lock = threading.Lock()
+
+
+# Wrapper messages: proto map values cannot themselves be maps or
+# repeated, so the schema wraps them (RackMap{racks}, UrlList{urls}, ...)
+# while the dicts keep their natural bare shape ({rack: [nodes]}). The
+# codec unwraps/rewraps EXACTLY these registered messages — inferring
+# wrapperness from shape would misfire on real single-field messages
+# like LookupRequest.
+WRAPPER_FIELD = {
+    "weedtpu.DataNodeList": "nodes",
+    "weedtpu.RackMap": "racks",
+    "weedtpu.UrlList": "urls",
+    "weedtpu.ShardHolderMap": "shards",
+}
+
+
+def _is_repeated(fd) -> bool:
+    rep = getattr(fd, "is_repeated", None)
+    if rep is not None:
+        return rep() if callable(rep) else bool(rep)
+    return fd.label == fd.LABEL_REPEATED  # older protobuf runtimes
+
+
+def wire_format() -> str:
+    """'proto' or 'json' — the process-wide wire selection."""
+    return "proto" if os.environ.get("WEEDTPU_WIRE", "") == "proto" else "json"
+
+
+def _descriptor_set_bytes() -> bytes:
+    """Fresh descriptor set from protoc when available (keeps the wire in
+    lockstep with contracts.proto), else the committed artifact."""
+    protoc = shutil.which("protoc")
+    if protoc is not None:
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".desc") as tmp:
+            proc = subprocess.run(
+                [
+                    protoc,
+                    f"--proto_path={_HERE}",
+                    "--include_imports",
+                    f"--descriptor_set_out={tmp.name}",
+                    PROTO_PATH,
+                ],
+                capture_output=True,
+                timeout=60,
+            )
+            if proc.returncode == 0:
+                tmp.seek(0)
+                raw = tmp.read()
+                if raw:
+                    return raw
+    with open(DESC_PATH, "rb") as f:
+        return f.read()
+
+
+class WireCodec:
+    """(service, method) -> request/response message classes + strict
+    dict<->message conversion."""
+
+    def __init__(self) -> None:
+        from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+        self._pool = descriptor_pool.DescriptorPool()
+        fds = descriptor_pb2.FileDescriptorSet.FromString(_descriptor_set_bytes())
+        for fdp in fds.file:
+            self._pool.Add(fdp)
+        self._factory = message_factory
+        # service methods: (service_full_name, method) -> (req_cls, resp_cls)
+        self._methods: dict[tuple[str, str], tuple] = {}
+        for fdp in fds.file:
+            for svc in fdp.service:
+                full = f"{fdp.package}.{svc.name}" if fdp.package else svc.name
+                sdesc = self._pool.FindServiceByName(full)
+                for m in sdesc.methods:
+                    self._methods[(full, m.name)] = (
+                        message_factory.GetMessageClass(m.input_type),
+                        message_factory.GetMessageClass(m.output_type),
+                    )
+
+    def has(self, service: str, method: str) -> bool:
+        return (service, method) in self._methods
+
+    def classes(self, service: str, method: str):
+        return self._methods[(service, method)]
+
+    # -- dict -> message ------------------------------------------------------
+
+    def to_message(self, d: dict, cls):
+        msg = cls()
+        self._fill(msg, d or {})
+        return msg
+
+    def _fill(self, msg, d) -> None:
+        desc = msg.DESCRIPTOR
+        wrap = WRAPPER_FIELD.get(desc.full_name)
+        if wrap is not None:
+            # wrapper values arrive in their natural bare shape, always
+            # (to_dict only ever emits bare; a rack literally named
+            # "racks" must not flip the interpretation)
+            d = {wrap: d}
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"{desc.full_name}: expected an object, got {type(d).__name__}"
+            )
+        fields = {f.name: f for f in desc.fields}
+        for key, value in d.items():
+            fd = fields.get(key)
+            if fd is None:
+                raise ValueError(
+                    f"{desc.full_name}: dict key {key!r} is not a schema field"
+                )
+            if value is None:
+                continue  # absent on the wire, like a missing JSON key
+            if fd.message_type is not None and fd.message_type.GetOptions().map_entry:
+                self._fill_map(msg, fd, value)
+            elif _is_repeated(fd):
+                tgt = getattr(msg, key)
+                for item in value:
+                    if fd.message_type is not None:
+                        self._fill(tgt.add(), item)
+                    else:
+                        tgt.append(self._scalar(fd, item))
+            elif fd.message_type is not None:
+                self._fill(getattr(msg, key), value)
+            else:
+                setattr(msg, key, self._scalar(fd, value))
+
+
+    def _fill_map(self, msg, fd, value: dict) -> None:
+        key_fd = fd.message_type.fields_by_name["key"]
+        val_fd = fd.message_type.fields_by_name["value"]
+        tgt = getattr(msg, fd.name)
+        for k, v in value.items():
+            kk = self._scalar(key_fd, k)
+            if val_fd.message_type is not None:
+                self._fill(tgt[kk], v)
+            else:
+                tgt[kk] = self._scalar(val_fd, v)
+
+    @staticmethod
+    def _scalar(fd, value):
+        t = fd.type
+        if t in (fd.TYPE_INT32, fd.TYPE_INT64, fd.TYPE_UINT32, fd.TYPE_UINT64,
+                 fd.TYPE_SINT32, fd.TYPE_SINT64, fd.TYPE_FIXED32, fd.TYPE_FIXED64,
+                 fd.TYPE_SFIXED32, fd.TYPE_SFIXED64):
+            return int(value)  # str keys like {"7": ...} arrive from JSON habits
+        if t in (fd.TYPE_FLOAT, fd.TYPE_DOUBLE):
+            return float(value)
+        if t == fd.TYPE_BOOL:
+            return bool(value)
+        if t == fd.TYPE_BYTES:
+            if isinstance(value, (bytes, bytearray)):
+                return bytes(value)
+            return base64.b64decode(value)  # dicts carry b64 strings
+        if t == fd.TYPE_STRING:
+            if not isinstance(value, str):
+                raise ValueError(f"field {fd.name}: expected str, got {type(value).__name__}")
+            return value
+        raise ValueError(f"field {fd.name}: unsupported proto type {t}")
+
+    # -- message -> dict ------------------------------------------------------
+
+    def to_dict(self, msg):
+        desc = msg.DESCRIPTOR
+        wrap = WRAPPER_FIELD.get(desc.full_name)
+        if wrap is not None:
+            inner = self._to_dict_fields(msg)
+            fd = desc.fields_by_name[wrap]
+            if fd.message_type is not None and fd.message_type.GetOptions().map_entry:
+                return inner.get(wrap, {})
+            return inner.get(wrap, [])
+        return self._to_dict_fields(msg)
+
+    def _to_dict_fields(self, msg) -> dict:
+        out = {}
+        desc = msg.DESCRIPTOR
+        for fd in desc.fields:
+            if fd.message_type is not None and fd.message_type.GetOptions().map_entry:
+                # maps always emit (possibly {}): readers index resp["x"]
+                val_fd = fd.message_type.fields_by_name["value"]
+                m = getattr(msg, fd.name)
+                if val_fd.message_type is not None:
+                    out[fd.name] = {
+                        self._key_out(k): self.to_dict(v) for k, v in m.items()
+                    }
+                else:
+                    out[fd.name] = {
+                        self._key_out(k): self._scalar_out(val_fd, v)
+                        for k, v in m.items()
+                    }
+            elif _is_repeated(fd):
+                # repeated always emits (possibly []), same reason
+                seq = getattr(msg, fd.name)
+                if fd.message_type is not None:
+                    out[fd.name] = [self.to_dict(v) for v in seq]
+                else:
+                    out[fd.name] = [self._scalar_out(fd, v) for v in seq]
+            elif fd.message_type is not None:
+                sub = getattr(msg, fd.name)
+                if msg.HasField(fd.name):
+                    out[fd.name] = self.to_dict(sub)
+            elif fd.has_presence:
+                # `optional` scalar: absent and explicit-default differ on
+                # the wire AND to handlers (.get(k, True) patterns —
+                # copy_ecx_file / is_delete_data)
+                if msg.HasField(fd.name):
+                    out[fd.name] = self._scalar_out(fd, getattr(msg, fd.name))
+            else:
+                # plain proto3 scalar: zero == unset on the wire, so the
+                # dict always carries the key (the codebase's dominant
+                # pattern is req["volume_id"]-style indexing; the few
+                # handlers with NON-zero defaults use `.get(k) or default`
+                # or-defaulting, which treats explicit zero as unset —
+                # exactly proto3's semantics)
+                out[fd.name] = self._scalar_out(fd, getattr(msg, fd.name))
+        return out
+
+    @staticmethod
+    def _key_out(k):
+        # JSON object keys are strings; handlers already int() them — keep
+        # native ints for int-keyed maps (both sides accept them)
+        return k
+
+    @staticmethod
+    def _scalar_out(fd, v):
+        if fd.type == fd.TYPE_BYTES:
+            return base64.b64encode(bytes(v)).decode()
+        return v
+
+    # -- gRPC (de)serializers --------------------------------------------------
+
+    def request_serdes(self, service: str, method: str):
+        """(serializer, deserializer) for the REQUEST message."""
+        req_cls, _ = self.classes(service, method)
+        return (
+            lambda d: self.to_message(d, req_cls).SerializeToString(),
+            lambda raw: self.to_dict(req_cls.FromString(raw)),
+        )
+
+    def response_serdes(self, service: str, method: str):
+        _, resp_cls = self.classes(service, method)
+        return (
+            lambda d: self.to_message(d, resp_cls).SerializeToString(),
+            lambda raw: self.to_dict(resp_cls.FromString(raw)),
+        )
+
+
+@functools.lru_cache(maxsize=1)
+def codec() -> WireCodec:
+    with _lock:
+        return WireCodec()
+
+
+def regenerate_descriptor_artifact() -> bytes:
+    """Write contracts.desc next to the proto (CI/commit-time helper; the
+    drift test asserts the artifact matches what protoc emits)."""
+    raw = _descriptor_set_bytes()
+    with open(DESC_PATH, "wb") as f:
+        f.write(raw)
+    return raw

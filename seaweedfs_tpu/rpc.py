@@ -52,6 +52,36 @@ _FORMATS = {
 }
 
 
+def _resolve_serdes(service: str, method: str, req_format: str, resp_format: str):
+    """(req_ser, req_de, resp_ser, resp_de) for one method, honoring the
+    process-wide wire selection: WEEDTPU_WIRE=proto swaps every "json"
+    side for binary protobuf built from pb/contracts.proto (pb/wire.py).
+    "bytes" streams are already the reference's raw-frame shape and stay.
+
+    Failures are LOUD by design: a process that silently fell back to
+    JSON while its peers speak protobuf would corrupt every call — the
+    operator asked for proto, so a missing schema entry or a codec load
+    error must stop the process, not downgrade it."""
+    req_ser, req_de = _FORMATS[req_format]
+    resp_ser, resp_de = _FORMATS[resp_format]
+    if "json" in (req_format, resp_format):
+        from seaweedfs_tpu.pb import wire
+
+        if wire.wire_format() == "proto":
+            codec = wire.codec()
+            # a (service, method) outside the schema (ad-hoc test services)
+            # falls back to JSON on BOTH ends — every process derives the
+            # decision from the same descriptor set, so the fallback is
+            # symmetric and interoperable. A codec load failure still
+            # raises: that CAN diverge between processes.
+            if codec.has(service, method):
+                if req_format == "json":
+                    req_ser, req_de = codec.request_serdes(service, method)
+                if resp_format == "json":
+                    resp_ser, resp_de = codec.response_serdes(service, method)
+    return req_ser, req_de, resp_ser, resp_de
+
+
 class RpcFault(Exception):
     """Handler-raised fault with an explicit status code."""
 
@@ -150,8 +180,9 @@ class _GenericHandler(grpc.GenericRpcHandler):
         m = svc.methods.get(m_name)
         if m is None:
             return None
-        req_ser, req_de = _FORMATS[m.req_format]
-        resp_ser, resp_de = _FORMATS[m.resp_format]
+        req_ser, req_de, resp_ser, resp_de = _resolve_serdes(
+            svc_name, m_name, m.req_format, m.resp_format
+        )
         if m.kind == "unary_unary":
             return grpc.unary_unary_rpc_method_handler(
                 _wrap_unary(m.fn), request_deserializer=req_de, response_serializer=resp_ser
@@ -237,8 +268,9 @@ class RpcClient:
         with self._lock:
             stub = self._stubs.get(key)
             if stub is None:
-                req_ser, _ = _FORMATS[req_format]
-                _, resp_de = _FORMATS[resp_format]
+                req_ser, _, _, resp_de = _resolve_serdes(
+                    service, method, req_format, resp_format
+                )
                 path = f"/{service}/{method}"
                 factory = getattr(self._channel, kind)
                 stub = factory(path, request_serializer=req_ser, response_deserializer=resp_de)

@@ -786,8 +786,8 @@ def _needle_ids_of(env: CommandEnv, node: dict, vid: int) -> tuple[dict[int, int
             "VolumeNeedleIds",
             {"volume_id": vid, "start_from": start, "limit": 65536},
         )
-        for key, size in resp.get("entries", []):
-            out[int(key)] = int(size)
+        for row in resp.get("entries", []):
+            out[int(row["id"])] = int(row["size"])
         if not resp.get("truncated"):
             break
         start = max(out) + 1
@@ -800,7 +800,9 @@ def _needle_ids_of(env: CommandEnv, node: dict, vid: int) -> tuple[dict[int, int
             {"volume_id": vid, "tombstones": True, "deleted_start_from": start,
              "limit": 65536},
         )
-        page = [(int(k), int(d)) for k, d in resp.get("deleted", [])]
+        page = [
+            (int(r["id"]), int(r["final_dead"])) for r in resp.get("deleted", [])
+        ]
         tombs.update(page)
         if not resp.get("deleted_truncated") or not page:
             return out, tombs

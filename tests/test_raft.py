@@ -219,7 +219,7 @@ def test_admin_lock_apply_is_seq_gated():
     m = MasterServer(port=0, reap_interval=3600)
     try:
         fresh = {"max_volume_id": 0, "sequence": 0, "lock_seq": 5,
-                 "admin_locks": {"admin": [42, 30.0, "holder"]}}
+                 "admin_locks": {"admin": {"token": 42, "ttl_s": 30.0, "client": "holder"}}}
         stale = {"max_volume_id": 0, "sequence": 0, "lock_seq": 3, "admin_locks": {}}
         m._raft_apply(fresh)
         assert m._admin_locks["admin"][0] == 42
