@@ -700,34 +700,9 @@ def mode_device() -> None:
     #                   (a storage node streams encodes) and BASELINE.md's
     #                   device-side protocol.
     def steady_gbps(encode_fn):
-        from jax import lax
+        from seaweedfs_tpu.ops.measure import scan_chain_gbps
 
-        def make_chain(k):
-            @jax.jit
-            def chain(d):
-                def body(acc, i):
-                    return acc ^ encode_fn(d ^ i)[:, :4, :], ()
-                acc, _ = lax.scan(
-                    body,
-                    jnp.zeros((b, 4, n), jnp.uint8),
-                    jnp.arange(k, dtype=jnp.uint8),
-                )
-                return acc
-
-            return chain
-
-        k1, k2 = 1, 8
-        c1, c2 = make_chain(k1), make_chain(k2)
-        t1 = _median_time(lambda: jax.block_until_ready(c1(data)), iters=3, warmup=1)
-        t2 = _median_time(lambda: jax.block_until_ready(c2(data)), iters=3, warmup=1)
-        per = (t2 - t1) / (k2 - k1)
-        if per <= 0:
-            # tunnel RTT jitter swamped the slope — an invalid measurement
-            # must be flagged, not recorded as a (negative) throughput
-            raise ValueError(
-                f"slope not measurable: t({k1})={t1:.4f}s t({k2})={t2:.4f}s"
-            )
-        return data_bytes / per / 1e9
+        return scan_chain_gbps(encode_fn, data, data_bytes)
 
     best_gbps, best_name, best_fn = 0.0, "none", None
     for name, fn in (("xla", encode_xla), ("pallas", encode_pallas)):

@@ -1,19 +1,20 @@
 #!/bin/bash
 # Detached tunnel watcher: probe the axon TPU every 10 min; on the first
-# healthy probe run the kernel sweep (scripts/kernel_sweep.py) and a fresh
-# device bench stage, logging everything to artifacts/. Exits after one
-# successful sweep or when the deadline passes. Never SIGTERMs a device
-# run mid-flight (that wedges the tunnel): the sweep runs unbounded.
+# healthy probe run the full window worker (scripts/device_window.py:
+# fresh measurement + kernel sweep + e2e encode). Exits after one
+# successful window or when the deadline passes. Never SIGTERMs a device
+# run mid-flight (that wedges the tunnel): the worker self-budgets.
 cd /root/repo
 DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-6} * 3600 ))
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if bash scripts/probe_device.sh | grep -q "probe ok"; then
-    echo "$(date -u +%FT%TZ) tunnel alive — running kernel sweep" >> artifacts/device_watch.log
-    python scripts/kernel_sweep.py > artifacts/SWEEP_r04.jsonl 2>artifacts/SWEEP_r04.err
-    echo "$(date -u +%FT%TZ) sweep rc=$? — running device bench" >> artifacts/device_watch.log
-    BENCH_MODE=device BENCH_TRACE_DIR="" python bench.py > artifacts/DEVICE_BENCH_late_r04.json 2>/dev/null
-    echo "$(date -u +%FT%TZ) device bench rc=$?" >> artifacts/device_watch.log
-    exit 0
+    echo "$(date -u +%FT%TZ) tunnel alive — running device window" >> artifacts/device_watch.log
+    PYTHONPATH=/root/repo:/root/.axon_site python scripts/device_window.py >> artifacts/device_watch.log 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) window rc=$rc" >> artifacts/device_watch.log
+    # only a COMPLETED window ends the watch: a failed/aborted attempt
+    # must not burn the remaining deadline (the next probe retries)
+    [ "$rc" -eq 0 ] && exit 0
   fi
   sleep 600
 done

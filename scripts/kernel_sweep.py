@@ -56,28 +56,9 @@ def _median_time(fn, iters=3, warmup=1):
 
 
 def steady_gbps(encode_fn, data):
-    def make_chain(k):
-        @jax.jit
-        def chain(d):
-            def body(acc, i):
-                return acc ^ encode_fn(d ^ i)[:, :4, :], ()
+    from seaweedfs_tpu.ops.measure import scan_chain_gbps
 
-            acc, _ = lax.scan(
-                body,
-                jnp.zeros((B, 4, N), jnp.uint8),
-                jnp.arange(k, dtype=jnp.uint8),
-            )
-            return acc
-
-        return chain
-
-    c1, c2 = make_chain(1), make_chain(8)
-    t1 = _median_time(lambda: jax.block_until_ready(c1(data)))
-    t2 = _median_time(lambda: jax.block_until_ready(c2(data)))
-    per = (t2 - t1) / 7
-    if per <= 0:
-        raise ValueError(f"slope not measurable: t1={t1:.4f} t2={t2:.4f}")
-    return DATA_BYTES / per / 1e9
+    return scan_chain_gbps(encode_fn, data, DATA_BYTES)
 
 
 # --- bf16 variant of the fused kernel -------------------------------------

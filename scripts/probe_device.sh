@@ -1,7 +1,7 @@
 #!/bin/sh
 # Probe the axon TPU tunnel in a throwaway child (90s cap) and append the
-# result to PROBES_r04.jsonl. Never SIGTERMs a dispatch mid-flight: the probe
-# child only calls jax.devices(), which is safe to kill.
+# result to PROBES_r05.jsonl. Kill-safe: the child only calls
+# jax.devices() (init phase), never a dispatch.
 cd /root/repo
 python - <<'PY'
 import json, subprocess, time, datetime
@@ -11,13 +11,15 @@ try:
         ["python", "-c", "import jax; print(jax.devices()[0].platform)"],
         capture_output=True, text=True, timeout=90,
     )
-    ok = r.returncode == 0 and "tpu" in r.stdout
+    plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    ok = r.returncode == 0 and plat in ("tpu", "axon")
     err = "" if ok else (r.stderr[-200:] or r.stdout[-200:])
 except subprocess.TimeoutExpired:
     ok, err = False, "timeout after 90s"
-rec = {"when": "round-4-loop", "ts": datetime.datetime.now(datetime.UTC).strftime("%Y-%m-%dT%H:%MZ"),
+rec = {"when": "round-5-loop", "ts": datetime.datetime.now(datetime.UTC).strftime("%Y-%m-%dT%H:%MZ"),
        "method": "subprocess jax.devices(), 90s cap", "ok": ok, "dt_s": round(time.time()-t0, 1)}
 if err: rec["error"] = err
-with open("PROBES_r04.jsonl", "a") as f: f.write(json.dumps(rec) + "\n")
-print("probe ok" if ok else f"probe failed: {err}")
+with open("PROBES_r05.jsonl", "a") as f:
+    f.write(json.dumps(rec) + "\n")
+print("probe ok" if ok else "probe fail")
 PY

@@ -1,0 +1,58 @@
+"""Scan-chain throughput measurement — the ONE implementation of the
+slope method shared by bench.py, scripts/kernel_sweep.py, and
+scripts/device_window.py.
+
+Method: jit a `lax.scan` of K chained encodes into a single dispatch and
+time K=1 vs K=8; the slope (t8-t1)/7 is the per-encode device time, with
+the per-dispatch overhead (the ~65 ms axon tunnel RTT) cancelled out.
+The xor-chain keeps every iteration data-dependent so XLA cannot hoist
+or dedupe encodes, while staying byte-reversible (cheap on the VPU).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def scan_chain_gbps(encode_fn, data, data_bytes: int, iters: int = 3) -> float:
+    """Steady-state effective GB/s of `encode_fn` ((B, C, N) uint8 ->
+    (B, C+R, N)) on device-resident `data`. Raises ValueError when timing
+    noise swamps the slope — a non-positive slope is an invalid
+    measurement, never a throughput."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, _c, n = data.shape
+
+    def make_chain(k: int):
+        @jax.jit
+        def chain(d):
+            def body(acc, i):
+                return acc ^ encode_fn(d ^ i)[:, :4, :], ()
+
+            acc, _ = lax.scan(
+                body,
+                jnp.zeros((b, 4, n), jnp.uint8),
+                jnp.arange(k, dtype=jnp.uint8),
+            )
+            return acc
+
+        return chain
+
+    def best_time(fn) -> float:
+        jax.block_until_ready(fn(data))  # compile + warm
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(data))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    k1, k2 = 1, 8
+    t1 = best_time(make_chain(k1))
+    t2 = best_time(make_chain(k2))
+    per = (t2 - t1) / (k2 - k1)
+    if per <= 0:
+        raise ValueError(f"slope not measurable: t({k1})={t1:.4f}s t({k2})={t2:.4f}s")
+    return data_bytes / per / 1e9
