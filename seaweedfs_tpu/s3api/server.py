@@ -998,16 +998,8 @@ class _Handler(httpd.QuietHandler):
                 400, "InvalidArgument", "invalid versionId"
             )
             return
-        filer_path = self.s3.object_path(bucket, key)
-        entry = self.s3.filer.lookup(filer_path)
-        if version_id and not (
-            entry is not None
-            and not entry.is_directory
-            and self._entry_vid(entry) == version_id
-        ):
-            # not the latest: serve out of the version archive
-            filer_path = f"{self.s3.versions_dir(bucket, key)}/{version_id}"
-            entry = self.s3.filer.lookup(filer_path)
+        if version_id:
+            filer_path, entry = self._locate_version(bucket, key, version_id)
             if entry is None or entry.is_directory:
                 self._reply(404) if head else self._error(
                     404, "NoSuchVersion", version_id
@@ -1019,6 +1011,9 @@ class _Handler(httpd.QuietHandler):
                     405, headers={"x-amz-delete-marker": "true", "Allow": "DELETE"}
                 ) if head else self._error(405, "MethodNotAllowed", "delete marker")
                 return
+        else:
+            filer_path = self.s3.object_path(bucket, key)
+            entry = self.s3.filer.lookup(filer_path)
         if entry is None or entry.is_directory:
             marker_headers = {}
             if self.s3.get_bucket_versioning(bucket):
@@ -1119,13 +1114,24 @@ class _Handler(httpd.QuietHandler):
         destination), and confirm the source exists and is an object —
         a directory source would otherwise serve the filer's JSON listing
         as object bytes. Replies the error itself; returns
-        (s_bucket, s_key) or None."""
-        src = urllib.parse.unquote(src)
-        if src.startswith("/"):
-            src = src[1:]
-        s_bucket, _, s_key = src.partition("/")
+        (s_bucket, s_key, s_filer_path, version_id) or None."""
+        # AWS appends ?versionId AFTER the percent-encoded key, so split
+        # BEFORE unquoting — decoding first would truncate a key that
+        # legitimately contains an encoded '?' (%3F)
+        src_enc, _, src_q = src.partition("?")
+        src_path = urllib.parse.unquote(src_enc)
+        if src_path.startswith("/"):
+            src_path = src_path[1:]
+        version_id = ""
+        if src_q:
+            qd = dict(urllib.parse.parse_qsl(src_q, keep_blank_values=True))
+            version_id = qd.get("versionId", "")
+        s_bucket, _, s_key = src_path.partition("/")
         if not s_key or not _valid_path(s_bucket, s_key):
             self._error(400, "InvalidArgument", "invalid copy source")
+            return None
+        if version_id and not _VERSION_ID_RE.fullmatch(version_id):
+            self._error(400, "InvalidArgument", "invalid copy source versionId")
             return None
         # the SOURCE bucket's policy binds here too: a denied direct GET
         # must not be readable by copying it into a bucket the caller can
@@ -1137,23 +1143,31 @@ class _Handler(httpd.QuietHandler):
         if verdict is not True and not identity.can_do(ACTION_READ, s_bucket):
             self._error(403, "AccessDenied", f"no Read on {s_bucket}")
             return None
-        s_entry = self.s3.filer.lookup(self.s3.object_path(s_bucket, s_key))
+        if version_id:
+            s_path, s_entry = self._locate_version(s_bucket, s_key, version_id)
+            if s_entry is not None and self._is_marker(s_entry):
+                # AWS: a copy source may not name a delete marker by id
+                self._error(400, "InvalidRequest", "source version is a delete marker")
+                return None
+        else:
+            s_path = self.s3.object_path(s_bucket, s_key)
+            s_entry = self.s3.filer.lookup(s_path)
         if s_entry is None or s_entry.is_directory:
-            self._error(404, "NoSuchKey", src)
+            self._error(
+                404, "NoSuchVersion" if version_id else "NoSuchKey", src
+            )
             return None
-        return s_bucket, s_key
+        return s_bucket, s_key, s_path, version_id
 
     def _copy_object(self, bucket, key, src, identity):
         resolved = self._resolve_copy_source(src, identity)
         if resolved is None:
             return
-        s_bucket, s_key = resolved
+        _s_bucket, _s_key, s_path, src_vid = resolved
         # stream through the filer: read source, write dest (fresh needles,
         # so source delete can never orphan the copy)
         try:
-            with tls.urlopen(
-                self.s3.filer_url(self.s3.object_path(s_bucket, s_key)), timeout=60
-            ) as r:
+            with tls.urlopen(self.s3.filer_url(s_path), timeout=60) as r:
                 data = r.read()
                 ctype = r.headers.get("Content-Type", "application/octet-stream")
         except urllib.error.URLError as e:
@@ -1172,6 +1186,8 @@ class _Handler(httpd.QuietHandler):
                 meta.update(json.loads(r.read()))
 
         vid_headers = self._versioned_commit(bucket, key, write)
+        if src_vid:
+            vid_headers = {**vid_headers, "x-amz-copy-source-version-id": src_vid}
         root = _xml("CopyObjectResult")
         _sub(root, "ETag", f'"{meta.get("etag", "")}"')
         _sub(root, "LastModified", _iso(time.time()))
@@ -1189,6 +1205,23 @@ class _Handler(httpd.QuietHandler):
 
     def _is_marker(self, entry) -> bool:
         return self.s3.MARKER_KEY in entry.extended
+
+    def _locate_version(self, bucket, key, version_id):
+        """-> (filer_path, entry|None) for one version id: the plain path
+        when the current latest carries that id, else the archive slot —
+        the ONE resolution shared by GET, DELETE, and copy-source (a
+        caller-local copy of this branch would drift on marker/latest
+        semantics)."""
+        plain = self.s3.object_path(bucket, key)
+        cur = self.s3.filer.lookup(plain)
+        if (
+            cur is not None
+            and not cur.is_directory
+            and self._entry_vid(cur) == version_id
+        ):
+            return plain, cur
+        vpath = f"{self.s3.versions_dir(bucket, key)}/{version_id}"
+        return vpath, self.s3.filer.lookup(vpath)
 
     def _archive_current(self, bucket, key, status, drop_null: bool = False) -> None:
         """Move the plain-path entry (the latest version) into the version
@@ -1278,13 +1311,11 @@ class _Handler(httpd.QuietHandler):
             raise ValueError("invalid versionId")
         if version_id:
             # permanent delete of one version
-            cur = self.s3.filer.lookup(plain)
-            if cur is not None and not cur.is_directory and self._entry_vid(cur) == version_id:
+            vpath, ventry = self._locate_version(bucket, key, version_id)
+            if vpath == plain:
                 self.s3.filer.delete(plain)
                 self._promote_newest(bucket, key)
                 return {self.s3.VID_KEY: version_id}
-            vpath = f"{self.s3.versions_dir(bucket, key)}/{version_id}"
-            ventry = self.s3.filer.lookup(vpath)
             headers = {self.s3.VID_KEY: version_id}
             if ventry is not None:
                 if self._is_marker(ventry):
@@ -1532,7 +1563,7 @@ class _Handler(httpd.QuietHandler):
             # can be up to 5 GiB and buffering one in gateway memory is an
             # OOM (r4 advisor finding) — urllib takes a file-like body when
             # the length is pinned by an explicit Content-Length
-            src_resp, length = opened
+            src_resp, length, src_vid = opened
             body = src_resp
             put_headers["Content-Length"] = str(length)
         path = f"{self._upload_dir(bucket, upload_id)}/part{part:05d}"
@@ -1550,19 +1581,23 @@ class _Handler(httpd.QuietHandler):
             root = _xml("CopyPartResult")
             _sub(root, "ETag", f'"{etag}"')
             _sub(root, "LastModified", _iso(time.time()))
-            self._reply(200, _render(root), headers={"ETag": f'"{etag}"'})
+            out_h = {"ETag": f'"{etag}"'}
+            if src_vid:
+                out_h["x-amz-copy-source-version-id"] = src_vid
+            self._reply(200, _render(root), headers=out_h)
         else:
             self._reply(200, headers={"ETag": f'"{etag}"'})
 
     def _open_copy_source(self, src: str, identity):
         """Resolve x-amz-copy-source [+ x-amz-copy-source-range] to an OPEN
         streaming response for UploadPartCopy (shared parse/auth/existence
-        via _resolve_copy_source) -> (file-like, length). The caller owns
-        closing it. Replies the error itself; None on failure."""
+        via _resolve_copy_source) -> (file-like, length, source version
+        id). The caller owns closing it. Replies the error itself; None
+        on failure."""
         resolved = self._resolve_copy_source(src, identity)
         if resolved is None:
             return None
-        s_bucket, s_key = resolved
+        _s_bucket, _s_key, s_path, _src_vid = resolved
         headers = {}
         rng = self.headers.get("x-amz-copy-source-range", "")
         if rng:
@@ -1570,7 +1605,7 @@ class _Handler(httpd.QuietHandler):
         try:
             r = tls.urlopen(
                 urllib.request.Request(
-                    self.s3.filer_url(self.s3.object_path(s_bucket, s_key)),
+                    self.s3.filer_url(s_path),
                     headers=headers,
                 ),
                 timeout=600,
@@ -1581,8 +1616,8 @@ class _Handler(httpd.QuietHandler):
                 # fallback — urllib needs Content-Length for file-like data
                 buf = r.read()
                 r.close()
-                return io.BytesIO(buf), len(buf)
-            return r, int(length)
+                return io.BytesIO(buf), len(buf), _src_vid
+            return r, int(length), _src_vid
         except urllib.error.HTTPError as e:
             if e.code == 416:
                 self._error(416, "InvalidRange")

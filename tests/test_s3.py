@@ -1197,3 +1197,51 @@ def test_policy_rejects_unsupported_statement_fields(stack):
     code, _, body = _req(s3, "PUT", "/uncond", _json.dumps(doc).encode(), query="policy")
     assert code == 400 and b"Condition" in body
     assert _req(s3, "GET", "/uncond", query="policy")[0] == 404  # nothing stored
+
+
+def test_copy_object_from_specific_version(stack):
+    """x-amz-copy-source with ?versionId addresses an archived version;
+    markers and bogus ids answer 404/400; the reply names the source
+    version (x-amz-copy-source-version-id)."""
+    s3 = stack
+    assert _req(s3, "PUT", "/cpver")[0] == 200
+    assert _req(s3, "PUT", "/cpdst")[0] == 200
+    cfg = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    assert _req(s3, "PUT", "/cpver", cfg, query="versioning")[0] == 200
+    _, h1, _ = _req(s3, "PUT", "/cpver/f.txt", b"old version bytes")
+    vid1 = h1.get("x-amz-version-id")
+    _, h2, _ = _req(s3, "PUT", "/cpver/f.txt", b"new version bytes")
+    assert h2.get("x-amz-version-id") != vid1
+    # copy the OLD version into another bucket
+    code, ch, body = _req(
+        s3, "PUT", "/cpdst/restored.txt",
+        headers={"x-amz-copy-source": f"/cpver/f.txt?versionId={vid1}"},
+    )
+    assert code == 200 and ch.get("x-amz-copy-source-version-id") == vid1
+    assert _req(s3, "GET", "/cpdst/restored.txt")[2] == b"old version bytes"
+    # a delete marker version has no bytes to copy
+    _, dh, _ = _req(s3, "DELETE", "/cpver/f.txt")
+    marker = dh.get("x-amz-version-id")
+    code, _, body = _req(
+        s3, "PUT", "/cpdst/nope.txt",
+        headers={"x-amz-copy-source": f"/cpver/f.txt?versionId={marker}"},
+    )
+    assert code == 400 and b"delete marker" in body  # AWS: InvalidRequest
+    # malformed version ids are rejected as path material
+    code, _, _ = _req(
+        s3, "PUT", "/cpdst/nope2.txt",
+        headers={"x-amz-copy-source": "/cpver/f.txt?versionId=../../evil"},
+    )
+    assert code == 400
+    # UploadPartCopy names the source version in its reply too
+    code, _, body = _req(s3, "POST", "/cpdst/mp.bin", query="uploads=")
+    upload_id = _xml(body).find(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId").text
+    code, ph, body = _req(
+        s3, "PUT", "/cpdst/mp.bin",
+        query=f"partNumber=1&uploadId={upload_id}",
+        headers={"x-amz-copy-source": f"/cpver/f.txt?versionId={vid1}"},
+    )
+    assert code == 200 and ph.get("x-amz-copy-source-version-id") == vid1
+    assert b"CopyPartResult" in body
+    _req(s3, "DELETE", "/cpdst/mp.bin", query=f"uploadId={upload_id}")
