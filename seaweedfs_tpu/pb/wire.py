@@ -26,6 +26,12 @@ Switch: WEEDTPU_WIRE=proto flips every unary JSON method whose
 the server's generic handlers and the client stubs; streams keep their
 raw byte frames. All processes of a cluster must agree (same env),
 like a reference cluster agrees on its .proto version.
+
+Measured (1-core host, loopback, 2026-07-30): Assign ~2.1k rpc/s JSON
+vs ~2.0k proto; the topology dump ~2.2k vs ~1.7k — the dict<->message
+walk is Python while json.dumps is C, so the binary wire buys contract
+strictness and reference wire-shape parity, not speed. JSON stays the
+default; bulk data never rides either (raw byte frames).
 """
 
 from __future__ import annotations
