@@ -242,7 +242,7 @@ def _filer_conf(p: argparse.ArgumentParser) -> None:
     p.add_argument("-grpcPort", type=int, default=0)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="127.0.0.1:9333")
-    p.add_argument("-store", default="memory", help="memory|sqlite|log")
+    p.add_argument("-store", default="memory", help="memory|sqlite|log|log3 (log3 = per-bucket store separation)")
     p.add_argument("-dir", default="", help="store/meta-log directory (sqlite/log stores)")
     p.add_argument("-collection", default="")
     p.add_argument("-defaultReplicaPlacement", default="")

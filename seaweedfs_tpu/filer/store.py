@@ -336,10 +336,16 @@ def make_store(kind: str = "memory", path: str = "") -> FilerStore:
         if not path:
             raise ValueError("sqlite store needs a db path")
         return SqliteStore(path)
-    if kind in ("log", "weedkv", "leveldb"):  # leveldb-analog embedded engine
+    if kind in ("log", "weedkv", "leveldb", "leveldb2"):  # embedded engine
         if not path:
             raise ValueError("log store needs a directory")
         from seaweedfs_tpu.filer.logstore import LogFilerStore
 
         return LogFilerStore(path)
-    raise ValueError(f"unknown filer store {kind!r} (memory|sqlite|log)")
+    if kind in ("log3", "leveldb3"):  # per-bucket store separation
+        if not path:
+            raise ValueError("log3 store needs a directory")
+        from seaweedfs_tpu.filer.bucketstore import BucketedLogStore
+
+        return BucketedLogStore(path)
+    raise ValueError(f"unknown filer store {kind!r} (memory|sqlite|log|log3)")

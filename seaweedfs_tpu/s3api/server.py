@@ -1315,6 +1315,7 @@ class _Handler(httpd.QuietHandler):
             if vpath == plain:
                 self.s3.filer.delete(plain)
                 self._promote_newest(bucket, key)
+                self._prune_versioned_remains(bucket, key)
                 return {self.s3.VID_KEY: version_id}
             headers = {self.s3.VID_KEY: version_id}
             if ventry is not None:
@@ -1324,6 +1325,7 @@ class _Handler(httpd.QuietHandler):
                 if self._is_marker(ventry) and self.s3.filer.lookup(plain) is None:
                     # removing the masking marker can re-expose a version
                     self._promote_newest(bucket, key)
+            self._prune_versioned_remains(bucket, key)
             return headers
         if status in ("Enabled", "Suspended"):
             # logical delete: archive the latest, leave a marker. Under
@@ -1343,7 +1345,44 @@ class _Handler(httpd.QuietHandler):
             self.s3.filer.delete(plain)
         except Exception:  # noqa: BLE001 — S3 delete is idempotent
             pass
+        self._prune_empty_parents(bucket, key)
         return {}
+
+    def _prune_versioned_remains(self, bucket, key) -> None:
+        """After a permanent version delete: when the last version of a
+        key is gone (plain path absent, archive empty), drop the empty
+        archive dir and the folder husks — otherwise DeleteBucket on a
+        fully-emptied versioned bucket reports BucketNotEmpty forever."""
+        if self.s3.filer.lookup(self.s3.object_path(bucket, key)) is not None:
+            return
+        vdir = self.s3.versions_dir(bucket, key)
+        try:
+            if self.s3.filer.lookup(vdir) is not None:
+                if self.s3.filer.list(vdir, limit=1):
+                    return  # versions remain: the key still exists
+                self.s3.filer.delete(vdir)
+        except Exception:  # noqa: BLE001 — raced; husks are best-effort
+            return
+        self._prune_empty_parents(bucket, key)
+
+    def _prune_empty_parents(self, bucket, key) -> None:
+        """Remove now-empty ancestor DIRECTORIES of a deleted key, up to
+        (never including) the bucket root — S3 has no real folders, and
+        leaving husks behind blocks DeleteBucket's emptiness check
+        ([ref: weed/s3api doDeleteEmptyDirectories — mount empty])."""
+        # a folder-marker key ("a/b/") normalizes to the directory itself:
+        # its first ancestor is a/  — probing the just-deleted path would
+        # abort the walk on NOT_FOUND
+        parts = key.rstrip("/").split("/")[:-1]
+        while parts:
+            d = self.s3.object_path(bucket, "/".join(parts))
+            try:
+                if self.s3.filer.list(d, limit=1):
+                    return  # first non-empty ancestor ends the walk
+                self.s3.filer.delete(d)
+            except Exception:  # noqa: BLE001 — raced or already gone
+                return
+            parts.pop()
 
     def _delete_object(self, bucket, key, version_id: str = ""):
         try:

@@ -34,12 +34,14 @@ from seaweedfs_tpu.filer.store import EntryNotFound
 
 
 def _stores(tmp_path):
+    from seaweedfs_tpu.filer.bucketstore import BucketedLogStore
     from seaweedfs_tpu.filer.logstore import LogFilerStore
 
     return [
         MemoryStore(),
         SqliteStore(str(tmp_path / "f.db")),
         LogFilerStore(str(tmp_path / "lg")),
+        BucketedLogStore(str(tmp_path / "lg3")),
     ]
 
 
@@ -684,3 +686,81 @@ def test_log_filer_store_reopen_invariants_after_kill(tmp_path):
             for name in names:
                 assert re.find(_pp.join(sub, name)).name == name
         re.close()
+
+
+def test_bucketed_store_routes_and_isolates(tmp_path):
+    """leveldb3-analog semantics: each /buckets/<name> subtree lives in
+    its own shard directory, non-bucket paths and the KV facet in the
+    default store, and listings stitch both views together."""
+    import os as _os
+
+    from seaweedfs_tpu.filer.bucketstore import BucketedLogStore
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.filer import Filer
+
+    st = BucketedLogStore(str(tmp_path))
+    f = Filer(st)
+    f.create_entry(Entry(path="/buckets", is_directory=True))
+    for b in ("alpha", "beta"):
+        f.create_entry(Entry(path=f"/buckets/{b}", is_directory=True))
+        f.create_entry(Entry(path=f"/buckets/{b}/obj.txt"))
+        f.create_entry(Entry(path=f"/buckets/{b}/dir", is_directory=True))
+        f.create_entry(Entry(path=f"/buckets/{b}/dir/deep.txt"))
+    f.create_entry(Entry(path="/plain", is_directory=True))
+    f.create_entry(Entry(path="/plain/file.txt"))
+    st.kv_put("identities", b"kvdata")
+
+    # physical separation on disk
+    assert _os.path.exists(tmp_path / "buckets" / "alpha" / "filer.log")
+    assert _os.path.exists(tmp_path / "buckets" / "beta" / "filer.log")
+    assert _os.path.exists(tmp_path / "default" / "filer.log")
+    # routing round-trips
+    assert f.find_entry("/buckets/alpha/dir/deep.txt").name == "deep.txt"
+    assert f.find_entry("/plain/file.txt").name == "file.txt"
+    assert sorted(e.name for e in st.list("/buckets")) == ["alpha", "beta"]
+    assert st.kv_get("identities") == b"kvdata"
+    st.close()
+
+    # reopen: shards rediscovered from the directory layout
+    re = BucketedLogStore(str(tmp_path))
+    f2 = Filer(re)
+    assert f2.find_entry("/buckets/beta/obj.txt").name == "obj.txt"
+    assert sorted(e.name for e in re.list("/buckets")) == ["alpha", "beta"]
+
+    # deleting a bucket subtree unlinks its shard wholesale
+    f2.delete_entry("/buckets/alpha", recursive=True, delete_chunks=False)
+    assert not _os.path.exists(tmp_path / "buckets" / "alpha")
+    assert [e.name for e in re.list("/buckets")] == ["beta"]
+    import pytest as _pytest
+
+    from seaweedfs_tpu.filer.store import EntryNotFound
+
+    with _pytest.raises(EntryNotFound):
+        re.find("/buckets/alpha/obj.txt")
+    # the other bucket and the flat namespace are untouched
+    assert f2.find_entry("/buckets/beta/dir/deep.txt").name == "deep.txt"
+    assert f2.find_entry("/plain/file.txt").name == "file.txt"
+    re.close()
+
+
+def test_bucketed_store_rename_across_buckets(tmp_path):
+    """A bucket-root rename migrates every entry into the target shard
+    and drops the emptied source shard."""
+    import os as _os
+
+    from seaweedfs_tpu.filer.bucketstore import BucketedLogStore
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.filer import Filer
+
+    st = BucketedLogStore(str(tmp_path))
+    f = Filer(st)
+    f.create_entry(Entry(path="/buckets/src", is_directory=True))
+    f.create_entry(Entry(path="/buckets/src/a.txt"))
+    f.create_entry(Entry(path="/buckets/src/sub", is_directory=True))
+    f.create_entry(Entry(path="/buckets/src/sub/b.txt"))
+    f.rename("/buckets/src", "/buckets/dst")
+    assert f.find_entry("/buckets/dst/sub/b.txt").name == "b.txt"
+    assert _os.path.exists(tmp_path / "buckets" / "dst" / "filer.log")
+    assert not _os.path.exists(tmp_path / "buckets" / "src")
+    assert [e.name for e in st.list("/buckets")] == ["dst"]
+    st.close()

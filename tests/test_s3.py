@@ -1245,3 +1245,42 @@ def test_copy_object_from_specific_version(stack):
     assert code == 200 and ph.get("x-amz-copy-source-version-id") == vid1
     assert b"CopyPartResult" in body
     _req(s3, "DELETE", "/cpdst/mp.bin", query=f"uploadId={upload_id}")
+
+
+def test_delete_object_prunes_empty_folders(stack):
+    """Deleting the last object under a nested prefix removes the empty
+    folder husks, so an emptied bucket can actually be deleted (AWS has
+    no real folders)."""
+    s3 = stack
+    assert _req(s3, "PUT", "/prune")[0] == 200
+    assert _req(s3, "PUT", "/prune/a/b/c/deep.txt", b"x")[0] == 200
+    assert _req(s3, "PUT", "/prune/a/side.txt", b"y")[0] == 200
+    assert _req(s3, "DELETE", "/prune/a/b/c/deep.txt")[0] == 204
+    # /a survives (side.txt), /a/b and /a/b/c are pruned
+    code, _, body = _req(s3, "GET", "/prune", query="list-type=2")
+    assert b"side.txt" in body and b"a/b" not in body
+    assert _req(s3, "DELETE", "/prune/a/side.txt")[0] == 204
+    code, _, _ = _req(s3, "DELETE", "/prune")
+    assert code == 204  # fully prunable: bucket delete succeeds
+
+
+def test_versioned_bucket_fully_emptied_is_deletable(stack):
+    """Permanently deleting every version and marker of every key must
+    leave a versioned bucket deletable (archive dirs and folder husks
+    pruned)."""
+    s3 = stack
+    assert _req(s3, "PUT", "/vprune")[0] == 200
+    cfg = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    assert _req(s3, "PUT", "/vprune", cfg, query="versioning")[0] == 200
+    _, h1, _ = _req(s3, "PUT", "/vprune/a/b/f.txt", b"v1")
+    vid1 = h1.get("x-amz-version-id")
+    _, dh, _ = _req(s3, "DELETE", "/vprune/a/b/f.txt")  # marker
+    marker = dh.get("x-amz-version-id")
+    assert _req(s3, "DELETE", "/vprune/a/b/f.txt", query=f"versionId={marker}")[0] == 204
+    # marker gone re-exposed v1 at the plain path; now delete it for good
+    assert _req(s3, "DELETE", "/vprune/a/b/f.txt", query=f"versionId={vid1}")[0] == 204
+    code, _, body = _req(s3, "GET", "/vprune", query="versions")
+    tree = _xml(body)
+    ns = tree.tag[: tree.tag.index("}") + 1]
+    assert not tree.findall(f"{ns}Version") and not tree.findall(f"{ns}DeleteMarker")
+    assert _req(s3, "DELETE", "/vprune")[0] == 204  # no husks left
