@@ -41,6 +41,13 @@ class BucketedLogStore(FilerStore):
         self._mk = LogFilerStore
         os.makedirs(os.path.join(directory, "buckets"), exist_ok=True)
         self._default = self._mk(os.path.join(directory, "default"))
+        # /buckets is a REAL entry in the default store (not synthesized):
+        # a synthetic find() would make mkdirs skip the insert and the
+        # root listing would never show /buckets to namespace walkers
+        try:
+            self._default.find(BUCKETS_PREFIX)
+        except EntryNotFound:
+            self._default.insert(Entry(path=BUCKETS_PREFIX, is_directory=True))
         self._lock = threading.Lock()
         self._buckets: dict[str, FilerStore] = {}
         for name in sorted(os.listdir(os.path.join(directory, "buckets"))):
@@ -84,12 +91,6 @@ class BucketedLogStore(FilerStore):
 
     def find(self, path: str) -> Entry:
         path = normalize_path(path)
-        if path == BUCKETS_PREFIX:
-            # /buckets exists as soon as the store does (it IS the layout)
-            try:
-                return self._default.find(path)
-            except EntryNotFound:
-                return Entry(path=BUCKETS_PREFIX, is_directory=True)
         return self._route(path).find(path)
 
     def delete(self, path: str) -> None:
@@ -128,9 +129,11 @@ class BucketedLogStore(FilerStore):
 
     def _drop_bucket(self, name: str) -> None:
         with self._lock:
-            st = self._buckets.pop(name, None)
-        if st is not None:
-            st.close()
+            self._buckets.pop(name, None)
+        # deliberately NOT closing the popped store: lock-free readers may
+        # still hold it mid-read, and POSIX keeps unlinked-but-open files
+        # readable — a close here would turn their 404s into 500s. The
+        # file handles fall with the last reference (refcount/GC).
         shutil.rmtree(os.path.join(self._dir, "buckets", name), ignore_errors=True)
         # the bucket DIRECTORY entry may live in the shard (dropped with
         # it) — make sure the default store holds no stale record either

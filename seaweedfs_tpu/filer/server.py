@@ -471,6 +471,13 @@ class _Handler(httpd.QuietHandler):
                 limit=httpd.safe_int(q.get("limit"), 1024),
                 prefix=q.get("prefix", ""),
             )
+            accept = self.headers.get("Accept", "")
+            if "text/html" in accept and "application/json" not in accept:
+                # browser navigation (filer_ui analog): content-negotiated
+                # HTML listing; curl/SDKs keep getting JSON
+                limit = httpd.safe_int(q.get("limit"), 1024)
+                self._reply_dir_html(path, entries, truncated=len(entries) >= limit, head=head)
+                return
             self._reply_json(
                 200,
                 {
@@ -522,6 +529,53 @@ class _Handler(httpd.QuietHandler):
                 pass
         body = self.fs.read_file(entry)
         self._reply(200, body, mime, headers=base_headers)
+
+    def _reply_dir_html(self, path, entries, truncated: bool, head: bool) -> None:
+        """HTML directory listing for browsers (filer_ui analog). Every
+        name is escaped AND percent-encoded in hrefs: entry names arrive
+        from arbitrary writers and render/navigate in a browser."""
+        import urllib.parse as _up
+        from html import escape as _esc
+
+        crumbs, acc = ['<a href="/">/</a>'], ""
+        for seg in [s for s in path.split("/") if s]:
+            acc += "/" + seg
+            crumbs.append(
+                f'<a href="{_esc(_up.quote(acc))}/">{_esc(seg)}</a>'
+            )
+        rows = []
+        for e in entries:
+            href = _esc(_up.quote(e.path)) + ("/" if e.is_directory else "")
+            name = _esc(e.name) + ("/" if e.is_directory else "")
+            size = "" if e.is_directory else str(e.size)
+            mtime = time.strftime(
+                "%Y-%m-%d %H:%M", time.gmtime(e.attributes.mtime)
+            )
+            rows.append(
+                f'<tr><td><a href="{href}">{name}</a></td>'
+                f"<td>{size}</td><td>{mtime}</td></tr>"
+            )
+        more = ""
+        if truncated and entries:
+            nxt = _esc(
+                _up.quote(path) + "?lastFileName=" + _up.quote(entries[-1].name)
+            )
+            more = f' &middot; <a href="{nxt}">next page &raquo;</a>'
+        count = (
+            f"first {len(entries)} entries" if truncated else f"{len(entries)} entries"
+        )
+        html = (
+            "<!DOCTYPE html><html><head><title>weedtpu filer</title>"
+            "<style>body{font-family:monospace}table{border-collapse:collapse}"
+            "td,th{border:1px solid #999;padding:2px 8px}</style></head><body>"
+            f"<h1>{' '.join(crumbs)}</h1>"
+            "<table><tr><th>name</th><th>size</th><th>modified</th></tr>"
+            f"{''.join(rows)}</table>"
+            f"<p>{count} &middot; "
+            f"store {_esc(self.fs.filer.store.name)} &middot; "
+            f'<a href="/metrics">/metrics</a>{more}</p></body></html>'
+        )
+        self.send_reply(200, html.encode(), "text/html; charset=utf-8", head=head)
 
     def do_GET(self):
         self._serve_get(head=False)

@@ -764,3 +764,58 @@ def test_bucketed_store_rename_across_buckets(tmp_path):
     assert not _os.path.exists(tmp_path / "buckets" / "src")
     assert [e.name for e in st.list("/buckets")] == ["dst"]
     st.close()
+
+
+def test_filer_html_directory_browsing(stack):
+    """Browsers (Accept: text/html) get a navigable HTML listing with
+    escaped names; API clients keep their JSON."""
+    _, _, fs = stack
+    base = f"http://{fs.url}"
+    _http("PUT", base + "/web/sub/", None)
+    _http("PUT", base + "/web/a.txt", b"x")
+    evil = "/web/%3Cb%3Ename.txt"  # stored name contains <b>
+    _http("PUT", base + evil, b"y")
+    code, headers, body = _http(
+        "GET", base + "/web", headers={"Accept": "text/html,application/xhtml+xml"}
+    )
+    assert code == 200 and headers["Content-Type"].startswith("text/html")
+    assert b"<table>" in body and b'href="/web/a.txt"' in body
+    assert b"sub/" in body
+    assert b"<b>name" not in body and b"&lt;b&gt;name.txt" in body  # escaped
+    # JSON unchanged for API clients
+    code, headers, body = _http("GET", base + "/web")
+    assert headers["Content-Type"].startswith("application/json")
+    assert b'"Entries"' in body
+
+
+def test_bucketed_store_root_discovery_and_html_pagination(tmp_path, stack):
+    """(1) /buckets must be a REAL entry discoverable from a root walk on
+    the log3 store; (2) the HTML listing paginates instead of presenting
+    a truncated view as complete."""
+    from seaweedfs_tpu.filer.bucketstore import BucketedLogStore
+    from seaweedfs_tpu.filer.filer import Filer as _Filer
+
+    st = BucketedLogStore(str(tmp_path / "disc"))
+    f = _Filer(st)
+    f.create_entry(Entry(path="/buckets/bb", is_directory=True))
+    assert "/buckets" in {e.path for e in st.list("/")}, "root walk must see /buckets"
+    st.close()
+
+    _, _, fs = stack
+    base = f"http://{fs.url}"
+    for i in range(5):
+        _http("PUT", base + f"/pagedir/f{i:02d}.txt", b"x")
+    code, _, body = _http(
+        "GET", base + "/pagedir", headers={"Accept": "text/html"},
+    )
+    assert b"5 entries" in body and b"next page" not in body
+    code, _, body = _http(
+        "GET", base + "/pagedir?limit=2", headers={"Accept": "text/html"},
+    )
+    assert b"first 2 entries" in body and b"next page" in body
+    assert b"lastFileName=f01.txt" in body
+    code, _, body = _http(
+        "GET", base + "/pagedir?limit=2&lastFileName=f01.txt",
+        headers={"Accept": "text/html"},
+    )
+    assert b"f02.txt" in body and b"f00.txt" not in body
