@@ -1,0 +1,236 @@
+"""Chaos soak: a live multi-process cluster under continuous load while
+volume servers are killed and restarted at random — the failure-
+detection/recovery subsystems (SURVEY §5) exercised end to end, not per
+unit. Verifies ZERO data loss: every acknowledged write must read back
+byte-identical for the whole run, through whatever mix of replica
+failover and EC degraded reads the kills force.
+
+Topology: 1 master + 3 volume servers (subprocesses) + 1 in-process
+filer client path via the master HTTP API. Files are written with
+replication 001 (2 copies) so any single kill leaves a live replica;
+mid-run one volume is EC-encoded so degraded reads join the mix.
+
+Usage:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
+      python scripts/chaos_soak.py [--seconds 300]
+Writes artifacts/SOAK_r05.json and exits nonzero on any lost byte.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ART = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class Node:
+    def __init__(self, i: int, dirpath: str, master: str):
+        self.i = i
+        self.dir = dirpath
+        self.master = master
+        self.http = _free_port()
+        self.grpc = _free_port()
+        self.proc: subprocess.Popen | None = None
+
+    def start(self) -> None:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("XLA_FLAGS", None)  # servers need no virtual mesh
+        # per-node log FILE (not a pipe: an unread pipe would deadlock the
+        # child) — in a chaos test the server logs are the evidence
+        self.log = open(os.path.join(self.dir, "server.log"), "ab")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "seaweedfs_tpu", "volume",
+                "-port", str(self.http), "-grpcPort", str(self.grpc),
+                "-dir", self.dir, "-mserver", self.master, "-max", "30",
+            ],
+            cwd=os.path.dirname(ART),
+            env=env,
+            stdout=self.log,
+            stderr=self.log,
+        )
+
+    def kill(self, hard: bool) -> None:
+        if self.proc is not None:
+            self.proc.send_signal(signal.SIGKILL if hard else signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+            self.proc = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+def main() -> int:
+    seconds = 300
+    if "--seconds" in sys.argv:
+        seconds = int(sys.argv[sys.argv.index("--seconds") + 1])
+    rng = random.Random(7)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu import rpc as _rpc
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    report: dict = {
+        "when": time.strftime("%FT%TZ", time.gmtime()),
+        "seconds": seconds,
+        "kills": 0,
+        "writes": 0,
+        "write_failures": 0,
+        "reads": 0,
+        "read_failures_transient": 0,
+        "lost": [],
+    }
+    with tempfile.TemporaryDirectory() as td:
+        master = MasterServer(port=0, reap_interval=5)
+        master.start()
+        nodes = []
+        for i in range(3):
+            d = os.path.join(td, f"n{i}")
+            os.makedirs(d)
+            n = Node(i, d, master.address)
+            n.start()
+            nodes.append(n)
+        client = None
+        try:
+            client = MasterClient(master.address)
+            deadline0 = time.monotonic() + 60
+            while time.monotonic() < deadline0:
+            if len(master.topology.nodes) == 3:
+                break
+            time.sleep(0.5)
+            assert len(master.topology.nodes) == 3, "cluster did not form"
+
+            blobs: dict[str, bytes] = {}  # fid -> expected bytes
+
+            def write_one() -> None:
+            size = rng.randrange(200, 50_000)
+            payload = rng.getrandbits(8 * size).to_bytes(size, "little")
+            for attempt in range(10):
+                try:
+                    a = client.assign(replication="001")
+                    client.upload(a.fid, payload)
+                    blobs[a.fid] = payload
+                    report["writes"] += 1
+                    return
+                except Exception:
+                    time.sleep(0.5)
+            # silent drops would make ok:true vacuous under a collapsed
+            # cluster — every exhausted retry is on the record
+            report["write_failures"] += 1
+
+            def read_all(final: bool) -> None:
+            for fid, want in list(blobs.items()):
+                got = None
+                for attempt in range(12 if final else 3):
+                    try:
+                        got = client.read(fid)
+                        break
+                    except Exception:
+                        report["read_failures_transient"] += 1
+                        time.sleep(1.0 if final else 0.3)
+                report["reads"] += 1
+                if got is not None and got != want:
+                    report["lost"].append({"fid": fid, "why": "BYTES DIFFER"})
+                    blobs.pop(fid, None)  # record a corruption ONCE
+                elif final and got is None:
+                    report["lost"].append({"fid": fid, "why": "unreadable at end"})
+
+            for _ in range(30):
+            write_one()
+
+            # EC-encode the first volume mid-soak so degraded reads join in
+            def try_ec_encode() -> None:
+            vids = sorted({int(f.split(",")[0]) for f in blobs})
+            if not vids:
+                return
+            vid = vids[0]
+            for n in nodes:
+                if not n.alive:
+                    continue
+                try:
+                    with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                        c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+                        c.call(
+                            VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                            {"volume_id": vid}, timeout=120,
+                        )
+                        # mount FIRST, delete LAST (the shell's ec.encode
+                        # order): the data must be served from somewhere at
+                        # every instant
+                        c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+                        c.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
+                    report["ec_encoded_vid"] = vid
+                    return
+                except Exception:  # noqa: BLE001 — not the owner: next node
+                    continue
+
+            try_ec_encode()
+
+            t_end = time.monotonic() + seconds
+            while time.monotonic() < t_end:
+            victim = rng.choice(nodes)
+            if victim.alive and sum(n.alive for n in nodes) > 1:
+                victim.kill(hard=rng.random() < 0.5)
+                report["kills"] += 1
+            for _ in range(rng.randrange(2, 6)):
+                write_one()
+            read_all(final=False)
+            time.sleep(rng.uniform(1.0, 3.0))
+            if not victim.alive:
+                victim.start()
+                time.sleep(2.0)
+
+            # every node back up; the final pass demands every byte
+            for n in nodes:
+            if not n.alive:
+                n.start()
+            time.sleep(8.0)
+            read_all(final=True)
+
+        finally:
+            # teardown must run on ANY exit path (a failed form-up assert
+            # must not leak three subprocesses writing into the tempdir)
+            if client is not None:
+                client.close()
+            for n in nodes:
+                try:
+                    n.kill(hard=False)
+                except Exception:
+                    pass
+            master.stop()
+
+    report["files"] = len(blobs)
+    report["ok"] = not report["lost"]
+    os.makedirs(ART, exist_ok=True)
+    with open(os.path.join(ART, "SOAK_r05.json"), "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
