@@ -123,18 +123,33 @@ def main():
         jax.random.randint(key, (B, 10, N), 0, 256, dtype=jnp.uint8)
     )
 
+    # rebuild shape (the second north-star target): ONE fused decode
+    # matrix for the worst allowed loss — 2 data + 2 parity shards gone —
+    # applied to the (B, 10, N) survivor stack exactly as the pipelined
+    # rebuild_ec_files dispatches it. Same kernels, different matrix.
+    from seaweedfs_tpu.ops.rs_codec import _reconstruction_matrix  # noqa: E402
+
+    lost = (0, 5, 11, 13)
+    surv = tuple(s for s in range(14) if s not in lost)[:10]
+    dm = _reconstruction_matrix("vandermonde", 10, 4, surv, lost)
+    dm_bits = rs_jax.lifted_matrix(dm)
+
     # golden check inputs (small) — verify each variant is byte-exact
+    # against its OWN gf8 matrix product (encode variants vs the parity
+    # matrix, rebuild variants vs the decode matrix)
     small = np.asarray(
         jax.random.randint(jax.random.PRNGKey(1), (1, 10, 8192), 0, 256, dtype=jnp.uint8)
     )
-    golden = gf8.gf_mat_mul(pm, small[0])
 
-    variants = [("xla", lambda d: rs_jax.gf_apply(b_bits, d))]
+    variants = [
+        ("xla", lambda d: rs_jax.gf_apply(b_bits, d), pm),
+        ("rebuild-xla", lambda d: rs_jax.gf_apply(dm_bits, d), dm),
+    ]
     tiles = [8192, 16384] if quick else [8192, 16384, 32768, 65536]
     for t in tiles:
         variants.append(
             (f"pallas-{t}", functools.partial(
-                lambda d, tt: rs_pallas.gf_apply_fused(b_bits, d, tile=tt), tt=t))
+                lambda d, tt: rs_pallas.gf_apply_fused(b_bits, d, tile=tt), tt=t), pm)
         )
         variants.append(
             # clamp the tile to the input: the golden gate feeds n=8192,
@@ -142,14 +157,19 @@ def main():
             # grid — all-zero output, every large-tile variant failing the
             # gate before it was ever measured
             (f"pallas-bf16-{t}", functools.partial(
-                lambda d, tt: _apply_bf16(b_pm, d, min(tt, d.shape[2])), tt=t))
+                lambda d, tt: _apply_bf16(b_pm, d, min(tt, d.shape[2])), tt=t), pm)
+        )
+        variants.append(
+            (f"rebuild-pallas-{t}", functools.partial(
+                lambda d, tt: rs_pallas.gf_apply_fused(dm_bits, d, tile=tt), tt=t), dm)
         )
 
     results = {}
-    for name, fn in variants:
+    for name, fn, gm in variants:
         rec = {"variant": name}
         try:
-            got = np.asarray(fn(jnp.asarray(small))[0, :4])
+            golden = gf8.gf_mat_mul(gm, small[0])
+            got = np.asarray(fn(jnp.asarray(small))[0, : golden.shape[0]])
             exact = bool((got == golden).all())
             rec["exact"] = exact
             if not exact:

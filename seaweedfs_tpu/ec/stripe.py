@@ -243,19 +243,14 @@ def find_local_shards(base_file_name: str) -> list[int]:
     ]
 
 
-def rebuild_ec_files(
-    base_file_name: str,
-    encoder: Optional[Encoder] = None,
-    buffer_size: int = 4 * 1024 * 1024,
-) -> list[int]:
-    """Reconstruct missing .ecNN files from >=10 survivors (RebuildEcFiles).
-
-    Returns the rebuilt shard ids."""
-    enc = encoder or new_encoder()
+def _check_rebuild_geometry(base_file_name: str) -> tuple[list[int], list[int], int]:
+    """Shared preflight for both rebuild paths: -> (present, missing,
+    shard_size). Raises when fewer than DATA_SHARDS survive or survivors
+    disagree on length (truncated shard)."""
     present = find_local_shards(base_file_name)
     missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
     if not missing:
-        return []
+        return present, missing, 0
     if len(present) < DATA_SHARDS_COUNT:
         raise ValueError(
             f"cannot rebuild: only {len(present)} shards present, need {DATA_SHARDS_COUNT}"
@@ -263,7 +258,88 @@ def rebuild_ec_files(
     sizes = {s: os.path.getsize(shard_file_name(base_file_name, s)) for s in present}
     if len(set(sizes.values())) != 1:
         raise IOError(f"surviving shards disagree on length: {sizes} — truncated shard?")
-    shard_size = sizes[present[0]]
+    return present, missing, sizes[present[0]]
+
+
+def rebuild_ec_files(
+    base_file_name: str,
+    encoder: Optional[Encoder] = None,
+    buffer_size: int = 4 * 1024 * 1024,
+    max_batch_bytes: int = 64 * 1024 * 1024,
+) -> list[int]:
+    """Reconstruct missing .ecNN files from >=10 survivors (RebuildEcFiles).
+
+    The device-first repair path: chunks are stacked into a
+    (batch, survivors, buffer) tensor and decoded by ONE fused
+    survivors->missing matrix in ONE device dispatch per batch (not per
+    chunk), with the same one-deep inflight pipeline as `_encode_rows` —
+    batch N decodes on-device (async dispatch) while batch N+1's slab
+    reads run; the np.asarray in drain() is the synchronization point.
+    Reads are one contiguous slab per survivor per batch, so disk
+    readahead stays alive. Output is byte-identical to
+    `rebuild_ec_files_serial` (zero-padding the tail chunk is exact: GF
+    matmul maps zero columns to zero columns, and the pad is trimmed
+    before writing).
+
+    Returns the rebuilt shard ids."""
+    enc = encoder or new_encoder()
+    present, missing, shard_size = _check_rebuild_geometry(base_file_name)
+    if not missing:
+        return []
+    # first DATA_SHARDS present ids, exactly like Encoder._pick_survivors —
+    # the serial path and this one must derive the SAME decode matrix
+    survivors = present[:DATA_SHARDS_COUNT]
+    chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
+    span = chunks_per_batch * buffer_size
+    with ExitStack() as stack:
+        ins = {
+            s: stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
+            for s in survivors
+        }
+        outs = {
+            s: stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
+            for s in missing
+        }
+        inflight: list[tuple[object, int]] = []  # [(decoded_handle, valid_bytes)]
+
+        def drain() -> None:
+            if not inflight:
+                return
+            lazy, valid = inflight.pop()
+            out = np.asarray(lazy)  # (B, len(missing), buffer) — sync point
+            for k, s in enumerate(missing):
+                # contiguous view writes via the buffer protocol; the tail
+                # batch trims its zero-pad back off
+                outs[s].write(np.ascontiguousarray(out[:, k, :]).reshape(-1)[:valid])
+
+        for off in range(0, shard_size, span):
+            valid = min(span, shard_size - off)
+            nchunks = -(-valid // buffer_size)
+            data = np.empty((DATA_SHARDS_COUNT, nchunks * buffer_size), dtype=np.uint8)
+            for i, s in enumerate(survivors):
+                data[i] = read_padded(ins[s], off, nchunks * buffer_size)
+            chunked = np.ascontiguousarray(
+                data.reshape(DATA_SHARDS_COUNT, nchunks, buffer_size).transpose(1, 0, 2)
+            )
+            decoded = enc.reconstruct_lazy(chunked, survivors, missing)  # async
+            drain()  # materialize + write the PREVIOUS batch while this one runs
+            inflight.append((decoded, valid))
+        drain()
+    return missing
+
+
+def rebuild_ec_files_serial(
+    base_file_name: str,
+    encoder: Optional[Encoder] = None,
+    buffer_size: int = 4 * 1024 * 1024,
+) -> list[int]:
+    """The pre-pipeline serial rebuild: one blocking reconstruct per chunk.
+    Kept as the correctness oracle (bench golden path + byte-identity
+    tests) and the shape the AVX2-baseline comparison is defined against."""
+    enc = encoder or new_encoder()
+    present, missing, shard_size = _check_rebuild_geometry(base_file_name)
+    if not missing:
+        return []
     with ExitStack() as stack:
         ins = {
             s: stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))

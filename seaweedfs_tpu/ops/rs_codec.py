@@ -222,6 +222,69 @@ class Encoder:
             shards[w] = out[k]
         return shards
 
+    # -- batched reconstruct (the repair-path mirror of encode_parity_lazy) --
+
+    def reconstruction_matrix(
+        self, survivors: Sequence[int], wanted: Sequence[int]
+    ) -> np.ndarray:
+        """The fused decode matrix (len(wanted) x data_shards) mapping a
+        survivor stack to the wanted shards — ONE matrix for any mix of
+        data and parity losses, built once per loss pattern via the cached
+        GF Gaussian elimination. `survivors` must be exactly `data_shards`
+        distinct present shard ids; stack rows must follow its order."""
+        survivors = tuple(int(s) for s in survivors)
+        wanted = tuple(int(w) for w in wanted)
+        if len(survivors) != self.data_shards or len(set(survivors)) != len(survivors):
+            raise ValueError(
+                f"survivors must be {self.data_shards} distinct shard ids, got {survivors}"
+            )
+        if not wanted:
+            raise ValueError("wanted must name at least one shard id")
+        for i in survivors + wanted:
+            if not 0 <= i < self.total_shards:
+                raise ValueError(f"shard id {i} out of range 0..{self.total_shards - 1}")
+        return _reconstruction_matrix(
+            self.matrix_kind, self.data_shards, self.parity_shards, survivors, wanted
+        )
+
+    def reconstruct_lazy(
+        self,
+        stack: np.ndarray,
+        survivors: Sequence[int],
+        wanted: Sequence[int],
+    ):
+        """Batched repair WITHOUT forcing the result to the host: a
+        (B, data_shards, N) survivor stack (rows in `survivors` order)
+        -> (B, len(wanted), N) device array (jax/pallas) or ndarray
+        (numpy/native) — ONE device dispatch for the whole batch, the
+        `encode_parity_lazy` contract mirrored for the repair path. JAX's
+        async dispatch returns immediately, so callers overlap the NEXT
+        batch's disk reads with this batch's decode; np.asarray() on the
+        result is the synchronization point."""
+        stack = np.asarray(stack, dtype=np.uint8)
+        if stack.ndim != 3 or stack.shape[1] != self.data_shards:
+            raise ValueError(f"want (B, {self.data_shards}, N), got {stack.shape}")
+        return self._apply_lazy(self.reconstruction_matrix(survivors, wanted), stack)
+
+    def reconstruct_batch(
+        self,
+        stack: np.ndarray,
+        survivors: Sequence[int],
+        wanted: Sequence[int],
+        bucketed: bool = False,
+    ) -> np.ndarray:
+        """Materialized batched repair: (B, data_shards, N) survivor stack
+        -> (B, len(wanted), N) host ndarray. `bucketed` pads N to the
+        serving-path shard-length buckets (jax/pallas only) so degraded
+        reads of odd interval sizes never pay a fresh XLA compile."""
+        stack = np.asarray(stack, dtype=np.uint8)
+        if stack.ndim != 3 or stack.shape[1] != self.data_shards:
+            raise ValueError(f"want (B, {self.data_shards}, N), got {stack.shape}")
+        m = self.reconstruction_matrix(survivors, wanted)
+        if bucketed:
+            return self._apply_bucketed(m, stack)
+        return np.asarray(self._apply_lazy(m, stack))
+
     def _bucket_for(self, n: int) -> Optional[int]:
         if self.backend in ("numpy", "native") or n == 0:
             return None  # host backends have no compile cache to miss —

@@ -21,6 +21,15 @@ identity name or access key, or an IAM-user ARN whose trailing
 ``user/<name>`` names the identity. Anonymous callers match ONLY "*".
 Action and Resource match with case-preserving ``*``/``?`` wildcards
 (actions compare case-insensitively, per AWS).
+
+Version-granular requests are evaluated under the separate AWS action
+names — ``s3:GetObjectVersion`` for ?versionId reads,
+``s3:DeleteObjectVersion`` for permanent versionId deletes, and
+``s3:ListBucketVersions`` for ?versions listings — never under the base
+``s3:GetObject``/``s3:DeleteObject``/``s3:ListBucket`` names, so a
+public-read grant cannot expose historical versions and a Deny written
+against the *Version names actually matches (the server derives the
+action name in ``_s3_action_name``).
 """
 
 from __future__ import annotations

@@ -157,24 +157,52 @@ class S3ApiServer:
 
     POLICY_KEY = "s3_policy"
     _POLICY_TTL = 5.0  # s; policies are read per request, entries are not
+    _CACHE_MAX = 4096  # hard cap on cached bucket names (real buckets only)
+    #: guards every structural mutation of _policy_cache/_versioning_cache:
+    #: the HTTP server is threaded, and an unlocked eviction scan racing a
+    #: concurrent insert/pop would raise 'dict changed size during iteration'
+    _cache_lock = threading.Lock()
+
+    @classmethod
+    def _cache_put(cls, cache: dict, bucket: str, value, now: float) -> None:
+        """Bounded insert shared by the policy and versioning caches: evict
+        every expired entry first (the TTL previously only gated reuse, so
+        dead entries lived forever), then cap the size — a flood past the
+        cap resets the cache rather than growing it (entries rebuild on
+        demand at one filer lookup each)."""
+        with cls._cache_lock:
+            for k in [k for k, v in cache.items() if v[0] <= now]:
+                cache.pop(k, None)
+            if len(cache) >= cls._CACHE_MAX:
+                cache.clear()
+            cache[bucket] = (now + cls._POLICY_TTL, value)
+
+    @classmethod
+    def _cache_drop(cls, cache: dict, bucket: str) -> None:
+        with cls._cache_lock:
+            cache.pop(bucket, None)
 
     def get_bucket_policy(self, bucket: str) -> Optional[dict]:
         """The bucket's policy document, or None — cached briefly so the
-        per-request evaluation doesn't pay a filer lookup per call."""
+        per-request evaluation doesn't pay a filer lookup per call.
+        Nonexistent buckets are NOT cached: unauthenticated probes naming
+        random buckets must not grow server state."""
         now = time.monotonic()
         cached = self._policy_cache.get(bucket)
         if cached is not None and cached[0] > now:
             return cached[1]
         entry = self.filer.lookup(self.bucket_path(bucket))
+        if entry is None:
+            self._cache_drop(self._policy_cache, bucket)
+            return None
         doc = None
-        if entry is not None:
-            raw = entry.extended.get(self.POLICY_KEY)
-            if raw:
-                try:
-                    doc = json.loads(raw)
-                except ValueError:
-                    doc = None  # unreadable stored policy must not 500 reads
-        self._policy_cache[bucket] = (now + self._POLICY_TTL, doc)
+        raw = entry.extended.get(self.POLICY_KEY)
+        if raw:
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                doc = None  # unreadable stored policy must not 500 reads
+        self._cache_put(self._policy_cache, bucket, doc, now)
         return doc
 
     def put_bucket_policy(self, bucket: str, doc: dict) -> bool:
@@ -183,7 +211,7 @@ class S3ApiServer:
             return False
         entry.extended[self.POLICY_KEY] = json.dumps(doc)
         self.filer.update(entry)
-        self._policy_cache.pop(bucket, None)
+        self._cache_drop(self._policy_cache, bucket)
         return True
 
     def delete_bucket_policy(self, bucket: str) -> bool:
@@ -193,7 +221,7 @@ class S3ApiServer:
         if self.POLICY_KEY in entry.extended:
             del entry.extended[self.POLICY_KEY]
             self.filer.update(entry)
-        self._policy_cache.pop(bucket, None)
+        self._cache_drop(self._policy_cache, bucket)
         return True
 
     # -- object versioning ----------------------------------------------------
@@ -211,16 +239,18 @@ class S3ApiServer:
     VID_KEY = "x-amz-version-id"
 
     def get_bucket_versioning(self, bucket: str) -> str:
-        """'' | 'Enabled' | 'Suspended' (briefly cached like policies)."""
+        """'' | 'Enabled' | 'Suspended' (briefly cached like policies;
+        nonexistent buckets are not cached, matching get_bucket_policy)."""
         now = time.monotonic()
         cached = self._versioning_cache.get(bucket)
         if cached is not None and cached[0] > now:
             return cached[1]
         entry = self.filer.lookup(self.bucket_path(bucket))
-        status = ""
-        if entry is not None:
-            status = entry.extended.get(self.VERSIONING_KEY, "")
-        self._versioning_cache[bucket] = (now + self._POLICY_TTL, status)
+        if entry is None:
+            self._cache_drop(self._versioning_cache, bucket)
+            return ""
+        status = entry.extended.get(self.VERSIONING_KEY, "")
+        self._cache_put(self._versioning_cache, bucket, status, now)
         return status
 
     def set_bucket_versioning(self, bucket: str, status: str) -> bool:
@@ -229,7 +259,7 @@ class S3ApiServer:
             return False
         entry.extended[self.VERSIONING_KEY] = status
         self.filer.update(entry)
-        self._versioning_cache.pop(bucket, None)
+        self._cache_drop(self._versioning_cache, bucket)
         return True
 
     def versions_dir(self, bucket: str, key: str) -> str:
@@ -363,17 +393,38 @@ class _Handler(httpd.QuietHandler):
         policies speak. Admin (bucket-management) operations return "" —
         they stay identity-only, which keeps Get/Put/DeleteBucketPolicy
         out of the policy's own reach (no AWS-style deny-yourself
-        lockout). Bucket-level reads approximate to s3:ListBucket."""
+        lockout). Bucket-level reads approximate to s3:ListBucket.
+
+        Version-granular requests authorize under the separate
+        s3:*Version action names, like AWS: a public-read policy granting
+        s3:GetObject must NOT expose historical versions via ?versionId,
+        and s3:DeleteObject must not permit permanent versionId deletes
+        (nor may a Deny written against the *Version names silently never
+        match)."""
+        # FIRST-value-wins, exactly like _parse builds the q the handlers
+        # serve from — authorization and serving must agree on which
+        # versionId a request names, or a duplicated query key smuggles a
+        # versioned read/delete past the base-action policy check
+        q = {
+            k: v[0]
+            for k, v in urllib.parse.parse_qs(query, keep_blank_values=True).items()
+        }
+        # ?versions is a presence-flagged subresource, but versionId only
+        # selects a version when its VALUE is non-empty — the handlers
+        # treat a blank ?versionId= as "current object", so the action
+        # name must agree or a base-action Deny would be bypassed
+        versioned = bool(q.get("versionId", "").strip())
         if action == ACTION_LIST:
-            return "s3:ListBucket"
+            return "s3:ListBucketVersions" if "versions" in q else "s3:ListBucket"
         if action == ACTION_READ:
-            return "s3:GetObject" if key else "s3:ListBucket"
+            if not key:
+                return "s3:ListBucketVersions" if "versions" in q else "s3:ListBucket"
+            return "s3:GetObjectVersion" if versioned else "s3:GetObject"
         if action == ACTION_WRITE:
-            qkeys = {
-                k for k, _ in urllib.parse.parse_qsl(query, keep_blank_values=True)
-            }
-            if self.command == "DELETE" or (self.command == "POST" and "delete" in qkeys):
-                return "s3:DeleteObject"
+            if self.command == "DELETE" or (self.command == "POST" and "delete" in q):
+                return (
+                    "s3:DeleteObjectVersion" if versioned else "s3:DeleteObject"
+                )
             return "s3:PutObject"
         return ""
 
@@ -754,8 +805,8 @@ class _Handler(httpd.QuietHandler):
         self.s3.filer.delete(path, recursive=True)
         # a same-named bucket created within the cache TTL must not
         # inherit the dead bucket's policy or versioning state
-        self.s3._policy_cache.pop(bucket, None)
-        self.s3._versioning_cache.pop(bucket, None)
+        self.s3._cache_drop(self.s3._policy_cache, bucket)
+        self.s3._cache_drop(self.s3._versioning_cache, bucket)
         try:
             # in-flight multipart staging references needles in this
             # bucket's collection; dropping the collection without it
@@ -888,17 +939,8 @@ class _Handler(httpd.QuietHandler):
                 if plain is not None:
                     recs.append((self._entry_vid(plain), False, plain))
                 if "vdir" in per_key[name]:
-                    archived = [
-                        e
-                        for e in self.s3.filer.list(
-                            per_key[name]["vdir"].path, limit=10000
-                        )
-                        if not e.is_directory
-                    ]
-                    archived.sort(
-                        key=lambda e: (e.attributes.mtime, e.name), reverse=True
-                    )
-                    recs.extend((e.name, self._is_marker(e), e) for e in archived)
+                    # the shared newest-first (and paginated) archive walk
+                    recs.extend(self._archived_records(per_key[name]["vdir"].path))
                 if recs:
                     yield name, recs
 
@@ -1135,8 +1177,12 @@ class _Handler(httpd.QuietHandler):
             return None
         # the SOURCE bucket's policy binds here too: a denied direct GET
         # must not be readable by copying it into a bucket the caller can
-        # write ([ref: weed/s3api — mount empty]; IAM evaluation order)
-        verdict = self._policy_verdict(s_bucket, s_key, identity, "s3:GetObject")
+        # write ([ref: weed/s3api — mount empty]; IAM evaluation order).
+        # A versioned source reads under s3:GetObjectVersion, like AWS.
+        verdict = self._policy_verdict(
+            s_bucket, s_key, identity,
+            "s3:GetObjectVersion" if version_id else "s3:GetObject",
+        )
         if verdict is False:
             self._error(403, "AccessDenied", "denied by source bucket policy")
             return None
@@ -1265,13 +1311,30 @@ class _Handler(httpd.QuietHandler):
         self.s3.filer.rename(staging, plain)
         return vid_headers
 
+    #: filer page size for version-archive listings (class attr so tests
+    #: can shrink it to exercise pagination without 1000+ versions)
+    _VERSION_PAGE = 1000
+
     def _archived_records(self, vdir_path) -> list[tuple[str, bool, object]]:
         """[(vid, is_marker, entry)] of the version archive, newest first —
         the ONE ordering shared by listings, promotion, and marker
-        detection (ties break on the time-ordered hex id)."""
-        archived = [
-            e for e in self.s3.filer.list(vdir_path, limit=10000) if not e.is_directory
-        ]
+        detection (ties break on the time-ordered hex id). Paginated: a
+        one-shot limited list would silently drop the NEWEST versions of a
+        key with more versions than the limit (ids are time-ordered and
+        the filer lists ascending), letting _promote_newest resurrect a
+        stale version after a delete."""
+        archived = []
+        start = ""
+        while True:
+            batch = self.s3.filer.list(
+                vdir_path, start_from=start, limit=self._VERSION_PAGE
+            )
+            if not batch:
+                break
+            archived.extend(e for e in batch if not e.is_directory)
+            start = batch[-1].name
+            if len(batch) < self._VERSION_PAGE:
+                break
         archived.sort(key=lambda e: (e.attributes.mtime, e.name), reverse=True)
         return [(e.name, self._is_marker(e), e) for e in archived]
 
@@ -1510,10 +1573,15 @@ class _Handler(httpd.QuietHandler):
                 _sub(err, "Key", key_el.text)
                 _sub(err, "Code", "InvalidArgument")
                 continue
+            vid_el = obj.find(f"{ns}VersionId")
+            vid = (vid_el.text or "").strip() if vid_el is not None else ""
             # the bucket-level _auth saw resource arn:...:bucket; per-key
-            # denies (s3:DeleteObject on a prefix) must still bind here
+            # denies (s3:DeleteObject on a prefix) must still bind here —
+            # and an entry naming a VersionId is a permanent versioned
+            # delete, which authorizes under s3:DeleteObjectVersion
             verdict = self._policy_verdict(
-                bucket, key_el.text, identity, "s3:DeleteObject"
+                bucket, key_el.text, identity,
+                "s3:DeleteObjectVersion" if vid else "s3:DeleteObject",
             )
             if verdict is False or (
                 self._is_anonymous(identity) and verdict is not True
@@ -1522,8 +1590,6 @@ class _Handler(httpd.QuietHandler):
                 _sub(err, "Key", key_el.text)
                 _sub(err, "Code", "AccessDenied")
                 continue
-            vid_el = obj.find(f"{ns}VersionId")
-            vid = (vid_el.text or "").strip() if vid_el is not None else ""
             try:
                 headers = self._delete_object_versioned(bucket, key_el.text, vid)
             except ValueError:

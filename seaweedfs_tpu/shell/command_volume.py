@@ -1105,31 +1105,46 @@ def _referenced_needles(env: CommandEnv, w: TextIO) -> dict[int, set[int]]:
     return refs
 
 
+#: orphan ids per VolumeNeedleTs call (matches VolumeNeedleIds paging) — a
+#: very large orphan set in one JSON request can exceed gRPC's default 4 MB
+#: message cap, making every holder "fail" and sparing all orphans with a
+#: misleading in-flight-upload report
+_NEEDLE_TS_CHUNK = 65536
+
+
 def _orphans_after_cutoff(
     env: CommandEnv, holders: list[dict], vid: int, nids: list[int], cutoff_ns: int
-) -> set[int]:
-    """The subset of `nids` appended after the cutoff on ANY replica — a
-    post-cutoff copy on one divergent holder is enough to spare the needle
-    everywhere (the delete loop hits every holder). Needles no reachable
-    holder can date are spared too. One batched VolumeNeedleTs per holder;
-    pre-ts (v2) needles report 0 and stay deletable: the cutoff protects
-    in-flight uploads, which land on current-version volumes."""
+) -> tuple[set[int], set[int]]:
+    """-> (dated after the cutoff, undatable). A post-cutoff copy on ANY
+    replica is enough to spare the needle everywhere (the delete loop hits
+    every holder). Needles NO reachable holder could date — every RPC
+    covering them failed — are returned separately so the report says
+    'holder unreachable' instead of claiming an upload in flight.
+    Chunked VolumeNeedleTs calls per holder; pre-ts (v2) needles report 0
+    and stay deletable: the cutoff protects in-flight uploads, which land
+    on current-version volumes."""
     newest: dict[int, int] = {}
-    answered = False
+    covered: set[int] = set()
     for h in holders:
-        try:
-            resp = env.vs_call(
-                grpc_addr(h), "VolumeNeedleTs", {"volume_id": vid, "needle_ids": nids}
-            )
-        except Exception:  # noqa: BLE001 — holder down: others may answer
-            continue
-        answered = True
-        for k, ts in resp.get("ts", {}).items():
-            nid = int(k)
-            newest[nid] = max(newest.get(nid, 0), int(ts or 0))
-    if not answered:
-        return set(nids)
-    return {nid for nid in nids if newest.get(nid, 0) > cutoff_ns}
+        for i in range(0, len(nids), _NEEDLE_TS_CHUNK):
+            chunk = nids[i : i + _NEEDLE_TS_CHUNK]
+            try:
+                resp = env.vs_call(
+                    grpc_addr(h),
+                    "VolumeNeedleTs",
+                    {"volume_id": vid, "needle_ids": chunk},
+                )
+            except Exception:  # noqa: BLE001 — holder down: others may answer.
+                # Fast-fail the holder's REMAINING chunks: a dead holder
+                # would otherwise cost one full RPC timeout per chunk
+                # (hours on a multi-million orphan set)
+                break
+            covered.update(chunk)
+            for k, ts in resp.get("ts", {}).items():
+                nid = int(k)
+                newest[nid] = max(newest.get(nid, 0), int(ts or 0))
+    fresh = {nid for nid in covered if newest.get(nid, 0) > cutoff_ns}
+    return fresh, set(nids) - covered
 
 
 def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
@@ -1188,7 +1203,7 @@ def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
         if orphans:
             # date candidates in BOTH modes so the report an operator sizes
             # a cleanup from agrees with what a purge would actually delete
-            fresh = _orphans_after_cutoff(
+            fresh, undatable = _orphans_after_cutoff(
                 env, holders_of[vid], vid, sorted(orphans), cutoff_ns
             )
             for nid in sorted(fresh):
@@ -1196,7 +1211,12 @@ def do_volume_fsck(args: list[str], env: CommandEnv, w: TextIO) -> None:
                     f"volume {vid}: needle {nid:x} appended after the "
                     f"cutoff — spared (likely an upload in flight)\n"
                 )
-            orphans -= fresh
+            for nid in sorted(undatable):
+                w.write(
+                    f"volume {vid}: needle {nid:x} could not be dated "
+                    f"(holder unreachable) — spared\n"
+                )
+            orphans -= fresh | undatable
         if orphans:
             size = sum(have[i] for i in orphans)
             orphan_count += len(orphans)

@@ -1284,3 +1284,123 @@ def test_versioned_bucket_fully_emptied_is_deletable(stack):
     ns = tree.tag[: tree.tag.index("}") + 1]
     assert not tree.findall(f"{ns}Version") and not tree.findall(f"{ns}DeleteMarker")
     assert _req(s3, "DELETE", "/vprune")[0] == 204  # no husks left
+
+
+def test_version_granular_policy_actions(stack):
+    """Versioned requests authorize under the s3:*Version action names:
+    a public-read grant of s3:GetObject must NOT expose ?versionId reads,
+    s3:ListBucket must not expose ?versions listings, and a Deny written
+    against s3:DeleteObjectVersion actually matches."""
+    import json as _json
+
+    s3 = stack
+    assert _req(s3, "PUT", "/vact")[0] == 200
+    cfg = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    assert _req(s3, "PUT", "/vact", cfg, query="versioning")[0] == 200
+    _, h1, _ = _req(s3, "PUT", "/vact/doc.txt", b"old version")
+    vid1 = h1.get("x-amz-version-id")
+    _, h2, _ = _req(s3, "PUT", "/vact/doc.txt", b"new version")
+    assert vid1 and h2.get("x-amz-version-id")
+
+    # public-read: base actions only
+    doc = {"Statement": [
+        {"Effect": "Allow", "Principal": "*",
+         "Action": ["s3:GetObject", "s3:ListBucket"],
+         "Resource": ["arn:aws:s3:::vact", "arn:aws:s3:::vact/*"]},
+    ]}
+    assert _req(s3, "PUT", "/vact", _json.dumps(doc).encode(), query="policy")[0] == 204
+    code, _, body = _req(s3, "GET", "/vact/doc.txt", sign=False)
+    assert code == 200 and body == b"new version"
+    assert _req(s3, "GET", "/vact", sign=False)[0] == 200
+    # ...but historical versions and version listings stay closed
+    assert _req(s3, "GET", "/vact/doc.txt", sign=False, query=f"versionId={vid1}")[0] == 403
+    assert _req(s3, "GET", "/vact", sign=False, query="versions")[0] == 403
+    # a BLANK ?versionId= is served as the current object, so it must
+    # authorize under the base name (and not smuggle past a base Deny)
+    code, _, body = _req(s3, "GET", "/vact/doc.txt", sign=False, query="versionId=")
+    assert code == 200 and body == b"new version"
+    # duplicate versionId keys: authorization and serving must agree on
+    # the SAME (first) value — a trailing blank copy must not downgrade
+    # the action name to s3:GetObject while the handler serves <vid1>
+    code, _, _ = _req(
+        s3, "GET", "/vact/doc.txt", sign=False, query=f"versionId={vid1}&versionId="
+    )
+    assert code == 403
+
+    # granting the *Version names opens exactly those
+    doc["Statement"].append(
+        {"Effect": "Allow", "Principal": "*",
+         "Action": ["s3:GetObjectVersion", "s3:ListBucketVersions"],
+         "Resource": ["arn:aws:s3:::vact", "arn:aws:s3:::vact/*"]})
+    assert _req(s3, "PUT", "/vact", _json.dumps(doc).encode(), query="policy")[0] == 204
+    code, _, body = _req(s3, "GET", "/vact/doc.txt", sign=False, query=f"versionId={vid1}")
+    assert code == 200 and body == b"old version"
+    assert _req(s3, "GET", "/vact", sign=False, query="versions")[0] == 200
+
+    # a Deny on s3:DeleteObjectVersion binds the signed identity's
+    # permanent versioned deletes but not its logical (marker) delete
+    deny = {"Statement": [
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:DeleteObjectVersion",
+         "Resource": "arn:aws:s3:::vact/*"},
+    ]}
+    assert _req(s3, "PUT", "/vact", _json.dumps(deny).encode(), query="policy")[0] == 204
+    assert _req(s3, "DELETE", "/vact/doc.txt", query=f"versionId={vid1}")[0] == 403
+    code, dh, _ = _req(s3, "DELETE", "/vact/doc.txt")  # marker: s3:DeleteObject
+    assert code == 204 and dh.get("x-amz-delete-marker") == "true"
+    assert _req(s3, "DELETE", "/vact", query="policy")[0] == 204
+
+
+def test_version_archive_pagination(stack, monkeypatch):
+    """A key with more versions than one filer page must keep its NEWEST
+    versions visible: promotion after a permanent delete of the latest must
+    pick the true next-newest (the one-shot limited listing used to drop
+    it), and ListObjectVersions must show every record."""
+    from seaweedfs_tpu.s3api import server as s3server
+
+    monkeypatch.setattr(s3server._Handler, "_VERSION_PAGE", 3)
+    s3 = stack
+    assert _req(s3, "PUT", "/vpage")[0] == 200
+    cfg = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    assert _req(s3, "PUT", "/vpage", cfg, query="versioning")[0] == 200
+    vids = []
+    for i in range(8):
+        _, h, _ = _req(s3, "PUT", "/vpage/k.txt", f"content {i}".encode())
+        vids.append(h.get("x-amz-version-id"))
+    assert all(vids) and len(set(vids)) == 8
+    # permanent delete of the live latest: promotion must resurrect
+    # version 6 (newest archived), which lives beyond the first page
+    assert _req(s3, "DELETE", "/vpage/k.txt", query=f"versionId={vids[7]}")[0] == 204
+    code, _, body = _req(s3, "GET", "/vpage/k.txt")
+    assert code == 200 and body == b"content 6"
+    # the listing walks every page: all 7 remaining versions show
+    code, _, body = _req(s3, "GET", "/vpage", query="versions")
+    tree = _xml(body)
+    ns = tree.tag[: tree.tag.index("}") + 1]
+    listed = {v.find(f"{ns}VersionId").text for v in tree.findall(f"{ns}Version")}
+    assert listed == set(vids[:7])
+
+
+def test_policy_and_versioning_caches_stay_bounded(stack):
+    """Unauthenticated probes of nonexistent buckets must not grow the
+    policy/versioning caches, expired entries are evicted on insert, and
+    the size cap holds."""
+    s3 = stack
+    assert _req(s3, "PUT", "/cachebkt")[0] == 200
+    for i in range(50):
+        assert s3.get_bucket_policy(f"no-such-bucket-{i}") is None
+        assert s3.get_bucket_versioning(f"no-such-bucket-{i}") == ""
+    assert not any(k.startswith("no-such-") for k in s3._policy_cache)
+    assert not any(k.startswith("no-such-") for k in s3._versioning_cache)
+    # expired entries go on the next insert (the TTL used to only gate reuse)
+    s3._policy_cache["stale-entry"] = (0.0, None)
+    s3.get_bucket_policy("cachebkt")
+    assert "stale-entry" not in s3._policy_cache
+    assert "cachebkt" in s3._policy_cache
+    # cap: a flood past _CACHE_MAX resets rather than grows
+    import time as _time
+
+    cache = {}
+    now = _time.monotonic()
+    for i in range(type(s3)._CACHE_MAX + 10):
+        type(s3)._cache_put(cache, f"b{i}", None, now)
+    assert len(cache) <= type(s3)._CACHE_MAX + 1

@@ -807,3 +807,64 @@ def test_pick_balance_move_prefers_rack_spread():
     assert picked is not None and picked[0] == 9
     # nothing movable -> None
     assert pick_balance_move({"a:1": {}, "c:1": {}}, by_url, "a:1", "c:1", {}, "") is None
+
+
+def test_orphans_after_cutoff_chunks_and_classifies(monkeypatch):
+    """fsck's orphan dating: the VolumeNeedleTs RPC is chunked (an
+    unchunked JSON request can blow gRPC's 4 MB cap), a post-cutoff copy
+    on ANY replica spares the needle, and ids NO reachable holder could
+    date come back as 'undatable' (holder unreachable) — distinct from
+    'dated after the cutoff'."""
+    from seaweedfs_tpu.shell import command_volume as cv
+
+    monkeypatch.setattr(cv, "_NEEDLE_TS_CHUNK", 3)
+    cutoff = 1000
+    nids = list(range(1, 11))  # 10 ids -> 4 chunks per holder
+
+    calls = []
+
+    class Env:
+        def vs_call(self, addr, method, req, timeout=300):
+            assert method == "VolumeNeedleTs"
+            chunk = req["needle_ids"]
+            assert len(chunk) <= 3
+            calls.append((addr, tuple(chunk)))
+            if addr.startswith("down"):
+                raise ConnectionError("holder down")
+            if 7 in chunk and addr.startswith("flaky"):
+                raise ConnectionError("mid-volume failure")
+            # holder 'a' dates needles 2 and 7 after the cutoff
+            return {"ts": {str(n): 2000 if n in (2, 7) else 10 for n in chunk}}
+
+    holders = [
+        {"url": "a:80", "grpc_port": 1},
+        {"url": "down:80", "grpc_port": 1},
+    ]
+    fresh, undatable = cv._orphans_after_cutoff(Env(), holders, 5, nids, cutoff)
+    assert fresh == {2, 7}
+    assert undatable == set()
+    # chunking: 4 chunks on the live holder; the down holder fast-fails
+    # after its first chunk (no RPC-timeout-per-chunk against a dead box)
+    assert calls == [
+        ("a:1", (1, 2, 3)),
+        ("a:1", (4, 5, 6)),
+        ("a:1", (7, 8, 9)),
+        ("a:1", (10,)),
+        ("down:1", (1, 2, 3)),
+    ]
+
+    # every holder down: nothing datable, nothing falsely 'in flight'
+    fresh, undatable = cv._orphans_after_cutoff(
+        Env(), [{"url": "down:80", "grpc_port": 1}], 5, nids, cutoff
+    )
+    assert fresh == set() and undatable == set(nids)
+
+    # a mid-volume failure on the only holder: that chunk AND the holder's
+    # remaining chunks are undatable (fast-fail), earlier chunks keep their
+    # dates
+    calls.clear()
+    fresh, undatable = cv._orphans_after_cutoff(
+        Env(), [{"url": "flaky:80", "grpc_port": 1}], 5, nids, cutoff
+    )
+    assert fresh == {2} and 7 not in fresh
+    assert undatable == {7, 8, 9, 10}  # failed chunk + fast-failed remainder
