@@ -299,11 +299,35 @@ def _measure_file_encode_e2e(td: str) -> dict:
         encoder=enc,
     )
     dt = time.perf_counter() - t0
-    return {
+    out = {
         "file_encode_e2e_gbps": round(size / dt / 1e9, 3),
         "file_encode_backend": enc.backend,
         "file_encode_dat_mib": size >> 20,
     }
+    # pipeline-depth sweep: what the depth-N inflight pipeline buys over
+    # the one-deep scheme on this host. The run above already measured the
+    # configured default depth; the remaining depths are measured here
+    # (skipping whichever of them the default already covered, so an env
+    # override like WEEDTPU_PIPELINE_DEPTH=1 never overwrites or drops a
+    # sweep point).
+    sweep = {str(stripe.DEFAULT_PIPELINE_DEPTH): out["file_encode_e2e_gbps"]}
+    for depth in (1, 2, 4):
+        if str(depth) in sweep:
+            continue
+        try:
+            t0 = time.perf_counter()
+            stripe.write_ec_files(
+                base,
+                large_block_size=4 << 20,
+                small_block_size=1 << 20,
+                encoder=enc,
+                pipeline_depth=depth,
+            )
+            sweep[str(depth)] = round(size / (time.perf_counter() - t0) / 1e9, 3)
+        except Exception as e:  # noqa: BLE001 — one depth must not zero the sweep
+            sweep[str(depth)] = f"error: {str(e)[:120]}"
+    out["file_encode_depth_sweep_gbps"] = sweep
+    return out
 
 
 def _measure_rebuild(td: str) -> dict:
@@ -386,6 +410,23 @@ def _measure_rebuild(td: str) -> dict:
         best = max(candidates, key=candidates.get)
         out["best_backend"] = best
         out["pipelined_vs_serial"] = round(candidates[best] / serial, 2)
+        # pipeline-depth sweep on the best backend: the depth-N inflight
+        # rebuild pipeline vs the one-deep r5 scheme, same volume
+        import functools
+
+        enc_by_name = {name: e for name, e, _ in suite}
+        sweep: dict = {}
+        for depth in (1, 2, 4):
+            try:
+                gbps, match = run(
+                    functools.partial(stripe.rebuild_ec_files, pipeline_depth=depth),
+                    enc_by_name[best],
+                    1,
+                )
+                sweep[str(depth)] = round(gbps, 3) if match else "mismatch"
+            except Exception as e:  # noqa: BLE001 — one depth must not zero the sweep
+                sweep[str(depth)] = f"error: {str(e)[:120]}"
+        out["depth_sweep_gbps"] = sweep
     return out
 
 
@@ -787,10 +828,10 @@ def mode_device() -> None:
     #                   per-encode device time. This matches production use
     #                   (a storage node streams encodes) and BASELINE.md's
     #                   device-side protocol.
-    def steady_gbps(encode_fn):
+    def steady_gbps(encode_fn, out_rows: int = 4):
         from seaweedfs_tpu.ops.measure import scan_chain_gbps
 
-        return scan_chain_gbps(encode_fn, data, data_bytes)
+        return scan_chain_gbps(encode_fn, data, data_bytes, out_rows=out_rows)
 
     best_gbps, best_name, best_fn = 0.0, "none", None
     for name, fn in (("xla", encode_xla), ("pallas", encode_pallas)):
@@ -836,7 +877,9 @@ def mode_device() -> None:
             lambda: jax.block_until_ready(decode_xla(data)), iters=10, warmup=3
         )
         out["rebuild_xla_gbps"] = round(data_bytes / t / 1e9, 3)
-        out["rebuild_xla_steady_gbps"] = round(steady_gbps(decode_xla), 3)
+        out["rebuild_xla_steady_gbps"] = round(
+            steady_gbps(decode_xla, out_rows=len(lost)), 3
+        )
     except Exception as e:  # noqa: BLE001 — rebuild numbers must not zero encode's
         out["rebuild_error"] = str(e)[:300]
     out["best_gbps"] = round(best_gbps, 3)

@@ -3,8 +3,10 @@ everything VERDICT r4 asks for in priority order, self-budgeted, in ONE
 process (never externally killed — SIGTERM mid-dispatch wedges the
 tunnel, the r4 lesson):
 
-  1. fresh scan-chain measurement of the XLA path and the r5 fused
-     kernel -> artifacts/DEVICE_MEASUREMENT_r05.json
+  1. fresh scan-chain measurement of the XLA path, the retuned fused
+     kernel (auto-tile + bf16 MXU variants), AND the rebuild decode path
+     (`rebuild_xla_steady_gbps` — the ROADMAP's missing number)
+     -> artifacts/DEVICE_MEASUREMENT_r06.json
   2. kernel sweep (tiles x dtypes, byte-exact gated)
      -> artifacts/SWEEP_r05.jsonl
   3. config-2-shaped END-TO-END encode through ec/stripe's real file
@@ -72,33 +74,64 @@ def main() -> int:
 
     from seaweedfs_tpu.ops.measure import scan_chain_gbps
 
-    def steady(encode_fn) -> float:
+    def steady(encode_fn, out_rows: int = 4) -> float:
         # raises ValueError on a non-measurable slope — the stage wrappers
         # record *_error instead of a bogus 0.0 measurement
-        return scan_chain_gbps(encode_fn, data, data_bytes)
+        return scan_chain_gbps(encode_fn, data, data_bytes, out_rows=out_rows)
 
     # -- 1: fresh measurement ------------------------------------------------
     meas = {
         "when": time.strftime("%FT%TZ", time.gmtime()),
-        "round": 5,
+        "round": 6,
         "platform": f"{d.platform} ({getattr(d, 'device_kind', '?')})",
-        "method": "scan-chain slope, 320 MiB/encode, device-resident, block_until_ready",
+        "method": "scan-chain slope, 320 MiB/apply, device-resident, block_until_ready",
     }
-    try:
-        meas["xla_steady_gbps"] = round(steady(lambda x: rs_jax.gf_apply(b_bits, x)), 3)
-        log(f"xla steady: {meas['xla_steady_gbps']} GB/s")
-    except Exception as e:  # noqa: BLE001
-        meas["xla_error"] = str(e)[:300]
-        log(f"xla stage failed: {e}")
-    try:
-        meas["pallas_r5_steady_gbps"] = round(
-            steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x)), 3
-        )
-        log(f"pallas r5 steady: {meas['pallas_r5_steady_gbps']} GB/s")
-    except Exception as e:  # noqa: BLE001
-        meas["pallas_error"] = str(e)[:300]
-        log(f"pallas stage failed: {e}")
-    with open(os.path.join(ART, "DEVICE_MEASUREMENT_r05.json"), "w", encoding="utf-8") as f:
+
+    def stage(key: str, fn) -> None:
+        try:
+            meas[key] = round(fn(), 3)
+            log(f"{key}: {meas[key]} GB/s")
+        except Exception as e:  # noqa: BLE001
+            meas[key + "_error"] = str(e)[:300]
+            log(f"{key} stage failed: {e}")
+
+    stage("xla_steady_gbps", lambda: steady(lambda x: rs_jax.gf_apply(b_bits, x)))
+    # the r6 retuned defaults: auto_tile (VMEM-budget tiles) and the bf16
+    # MXU variant — the two hypotheses for the 19-vs-31 GB/s Pallas gap
+    stage(
+        "pallas_auto_steady_gbps",
+        lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x)),
+    )
+    stage(
+        "pallas_bf16_steady_gbps",
+        lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, mxu="bf16")),
+    )
+    stage(
+        "pallas_tile8192_steady_gbps",
+        lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, tile=8192)),
+    )
+    # rebuild decode path — the ROADMAP's missing rebuild_xla_steady_gbps:
+    # ONE fused survivors->missing matrix (worst allowed loss, 2 data +
+    # 2 parity) applied to the survivor stack exactly as the pipelined
+    # rebuild_ec_files dispatches it
+    from seaweedfs_tpu.ops.rs_codec import _reconstruction_matrix
+
+    lost = (0, 5, 11, 13)
+    surv = tuple(s for s in range(14) if s not in lost)[:10]
+    dm_bits = rs_jax.lifted_matrix(
+        _reconstruction_matrix("vandermonde", 10, 4, surv, lost)
+    )
+    stage(
+        "rebuild_xla_steady_gbps",
+        lambda: steady(lambda x: rs_jax.gf_apply(dm_bits, x), out_rows=len(lost)),
+    )
+    stage(
+        "rebuild_pallas_auto_steady_gbps",
+        lambda: steady(
+            lambda x: rs_pallas.gf_apply_fused(dm_bits, x), out_rows=len(lost)
+        ),
+    )
+    with open(os.path.join(ART, "DEVICE_MEASUREMENT_r06.json"), "w", encoding="utf-8") as f:
         json.dump(meas, f, indent=1)
 
     # -- 2: sweep ------------------------------------------------------------
@@ -109,8 +142,8 @@ def main() -> int:
         log("running kernel sweep")
         import subprocess
 
-        with open(os.path.join(ART, "SWEEP_r05.jsonl"), "w") as out, open(
-            os.path.join(ART, "SWEEP_r05.err"), "w"
+        with open(os.path.join(ART, "SWEEP_r06.jsonl"), "w") as out, open(
+            os.path.join(ART, "SWEEP_r06.err"), "w"
         ) as err:
             subprocess.run(
                 [sys.executable, "scripts/kernel_sweep.py"],
@@ -151,7 +184,27 @@ def main() -> int:
                 "on real hardware this hop is PCIe/ICI — device_steady_gbps is "
                 "the chip-side number, e2e_gbps is tunnel-bound here",
             }
-        with open(os.path.join(ART, "E2E_DEVICE_r05.json"), "w", encoding="utf-8") as f:
+            # e2e REBUILD through the depth-N pipelined path: lose the worst
+            # allowed pattern, rebuild on-device, depth sweep 1 vs default
+            if left() > 120:
+                try:
+                    for s in (0, 5, 11, 13):
+                        os.unlink(stripe.shard_file_name(base, s))
+                    t0 = time.perf_counter()
+                    stripe.rebuild_ec_files(base, encoder=enc)
+                    dt = time.perf_counter() - t0
+                    rec["rebuild_e2e_seconds"] = round(dt, 3)
+                    rec["rebuild_e2e_gbps"] = round(size / dt / 1e9, 4)
+                    for s in (0, 5, 11, 13):
+                        os.unlink(stripe.shard_file_name(base, s))
+                    t0 = time.perf_counter()
+                    stripe.rebuild_ec_files(base, encoder=enc, pipeline_depth=1)
+                    rec["rebuild_e2e_depth1_seconds"] = round(
+                        time.perf_counter() - t0, 3
+                    )
+                except Exception as e:  # noqa: BLE001 — rebuild must not zero encode e2e
+                    rec["rebuild_e2e_error"] = str(e)[:300]
+        with open(os.path.join(ART, "E2E_DEVICE_r06.json"), "w", encoding="utf-8") as f:
             json.dump(rec, f, indent=1)
         log(f"e2e: {rec['e2e_gbps']} GB/s ({rec['e2e_seconds']}s for 128 MiB)")
     else:

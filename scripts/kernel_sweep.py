@@ -1,42 +1,59 @@
-"""Device-side kernel sweep: hunt for encode throughput past the current
-31 GB/s steady-state (target: BASELINE.json 40 GB/s/chip, 10+4).
+"""Device-side kernel sweep: hunt for encode AND rebuild throughput past
+the current 31 GB/s steady-state (target: BASELINE.json 40 GB/s/chip, 10+4).
 
 Variants swept (all byte-exact vs gf8 golden):
-  xla            rs_jax.gf_apply (current per-call winner)
-  pallas-T       rs_pallas fused kernel at tile T in {8k, 16k, 32k, 64k}
-  pallas-bf16-T  same kernel but the MXU matmul runs in bf16 (products are
-                 0/1 and K=80 so every partial sum <= 80 < 256 is exactly
-                 representable in bf16's 8-bit mantissa; f32 accumulate is
-                 exact a fortiori) — int8 matmul on some TPU generations is
-                 emulated at half/quarter bf16 rate, so this can win.
+  xla              rs_jax.gf_apply (current per-call winner)
+  pallas-T         rs_pallas fused kernel at tile T in {8k, 16k, 32k, 64k}
+  pallas-auto      the retuned default: auto_tile picks the largest tile
+                   whose VMEM working set fits the budget
+  pallas-bf16-T    same kernel but the MXU matmul runs in bf16 (products are
+                   0/1 and K=80 so every partial sum <= 80 < 256 is exactly
+                   representable in bf16's 8-bit mantissa; f32 accumulate is
+                   exact a fortiori) — int8 matmul on some TPU generations is
+                   emulated at half/quarter bf16 rate, so this can win.
+  rebuild-*        the same kernels driven by a fused survivors->missing
+                   decode matrix (worst allowed loss: 2 data + 2 parity) —
+                   the shape the pipelined rebuild_ec_files dispatches.
 
 Method: scan-chain slope (same as bench.py stage 3) — time K=1 vs K=8
-encode chains in one dispatch; the slope is per-encode device time, immune
-to the ~65 ms axon-tunnel dispatch floor.
+chains in one dispatch; the slope is per-apply device time, immune to the
+~65 ms axon-tunnel dispatch floor.
 
-Usage: python scripts/kernel_sweep.py [--quick]
-Emits one JSON line per variant + a summary line; exits nonzero only on
-harness failure (a variant that fails to compile is recorded, not fatal).
+Usage: python scripts/kernel_sweep.py [--quick|--tiny|--smoke]
+  --quick  fewer tiles
+  --tiny   CPU sanity run: toy sizes, correctness + timing
+  --smoke  CI gate: JAX_PLATFORMS=cpu forced, toy sizes, correctness ONLY
+           (no scan-chain timing), exits nonzero if ANY variant fails its
+           byte-exactness gate — wired into tests so kernel refactors
+           cannot silently break the sweep.
+Emits one JSON line per variant + a summary line; outside --smoke it exits
+nonzero only on harness failure (a variant that fails to compile is
+recorded, not fatal).
 """
 
 from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.experimental import pallas as pl
-
 sys.path.insert(0, ".")
+
+SMOKE = "--smoke" in sys.argv
+if SMOKE:
+    # the gate must never touch (or hang on) the one-client TPU tunnel —
+    # pin cpu BEFORE jax resolves a backend
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 from seaweedfs_tpu.ops import gf8, rs_jax, rs_pallas  # noqa: E402
 
-if "--tiny" in sys.argv:  # CPU sanity run: correctness only, toy sizes
+if SMOKE or "--tiny" in sys.argv:  # CPU sanity runs: toy sizes
     B, N = 2, 32768
 else:
     B, N = 8, 4 << 20  # same workload as bench.py stage 3
@@ -55,54 +72,10 @@ def _median_time(fn, iters=3, warmup=1):
     return ts[len(ts) // 2]
 
 
-def steady_gbps(encode_fn, data):
+def steady_gbps(encode_fn, data, out_rows):
     from seaweedfs_tpu.ops.measure import scan_chain_gbps
 
-    return scan_chain_gbps(encode_fn, data, DATA_BYTES)
-
-
-# --- bf16 variant of the fused kernel -------------------------------------
-
-
-def _kernel_bf16(b_ref, data_ref, out_ref):
-    # r5 layout: plane-major on BOTH sides (matches rs_pallas._kernel and
-    # the doubly-permuted matrix from plane_major_matrix) + uint8-native
-    # unpack — only the MXU dtype differs from the int8 kernel
-    data = data_ref[0]
-    bits = jnp.concatenate(
-        [((data >> j) & 1) for j in range(8)], axis=0
-    ).astype(jnp.bfloat16)
-    acc = jax.lax.dot_general(
-        b_ref[...].astype(jnp.bfloat16),
-        bits,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32)
-    acc = acc & 1
-    rows8, t = acc.shape
-    acc3 = acc.reshape(8, rows8 // 8, t)
-    out = acc3[0]
-    for i in range(1, 8):
-        out = out | (acc3[i] << i)
-    out_ref[0] = out.astype(jnp.uint8)
-
-
-@functools.partial(jax.jit, static_argnames=("tile",))
-def _apply_bf16(b_pm, data, tile: int):
-    batch, c, n = data.shape
-    rows = b_pm.shape[0] // 8
-    interpret = jax.devices()[0].platform == "cpu"  # --tiny exactness runs
-    return pl.pallas_call(
-        _kernel_bf16,
-        grid=(batch, n // tile),
-        in_specs=[
-            pl.BlockSpec((b_pm.shape[0], b_pm.shape[1]), lambda b, i: (0, 0)),
-            pl.BlockSpec((1, c, tile), lambda b, i: (b, 0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, rows, tile), lambda b, i: (b, 0, i)),
-        out_shape=jax.ShapeDtypeStruct((batch, rows, n), jnp.uint8),
-        interpret=interpret,
-    )(b_pm, data)
+    return scan_chain_gbps(encode_fn, data, DATA_BYTES, out_rows=out_rows)
 
 
 def main():
@@ -112,11 +85,10 @@ def main():
     from seaweedfs_tpu.utils.devices import honor_platform_env
 
     honor_platform_env()
-    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+    print(json.dumps({"platform": jax.devices()[0].platform, "smoke": SMOKE}), flush=True)
 
     pm = gf8.parity_matrix(10, 4)
     b_bits = rs_jax.lifted_matrix(pm)
-    b_pm = rs_pallas.plane_major_matrix(pm)
 
     key = jax.random.PRNGKey(0)
     data = jax.block_until_ready(
@@ -141,30 +113,32 @@ def main():
         jax.random.randint(jax.random.PRNGKey(1), (1, 10, 8192), 0, 256, dtype=jnp.uint8)
     )
 
+    def fused(bits, tile, mxu="int8"):
+        # _apply_pm clamps explicit tiles to the (padded) input width, so
+        # tiles larger than the 8192-wide golden input are safe to pass
+        # through; tile=None lets auto_tile pick.
+        return lambda d: rs_pallas.gf_apply_fused(bits, d, tile=tile, mxu=mxu)
+
     variants = [
         ("xla", lambda d: rs_jax.gf_apply(b_bits, d), pm),
         ("rebuild-xla", lambda d: rs_jax.gf_apply(dm_bits, d), dm),
+        ("pallas-auto", fused(b_bits, None), pm),
+        ("pallas-bf16-auto", fused(b_bits, None, "bf16"), pm),
+        ("rebuild-pallas-auto", fused(dm_bits, None), dm),
     ]
-    tiles = [8192, 16384] if quick else [8192, 16384, 32768, 65536]
+    if SMOKE:
+        tiles = [8192]  # one explicit tile proves the tiled path; cheap
+    elif quick:
+        tiles = [8192, 16384]
+    else:
+        tiles = [8192, 16384, 32768, 65536]
     for t in tiles:
-        variants.append(
-            (f"pallas-{t}", functools.partial(
-                lambda d, tt: rs_pallas.gf_apply_fused(b_bits, d, tile=tt), tt=t), pm)
-        )
-        variants.append(
-            # clamp the tile to the input: the golden gate feeds n=8192,
-            # and grid=(batch, n // tile) with tile > n would be an empty
-            # grid — all-zero output, every large-tile variant failing the
-            # gate before it was ever measured
-            (f"pallas-bf16-{t}", functools.partial(
-                lambda d, tt: _apply_bf16(b_pm, d, min(tt, d.shape[2])), tt=t), pm)
-        )
-        variants.append(
-            (f"rebuild-pallas-{t}", functools.partial(
-                lambda d, tt: rs_pallas.gf_apply_fused(dm_bits, d, tile=tt), tt=t), dm)
-        )
+        variants.append((f"pallas-{t}", fused(b_bits, t), pm))
+        variants.append((f"pallas-bf16-{t}", fused(b_bits, t, "bf16"), pm))
+        variants.append((f"rebuild-pallas-{t}", fused(dm_bits, t), dm))
 
     results = {}
+    failed = []
     for name, fn, gm in variants:
         rec = {"variant": name}
         try:
@@ -174,18 +148,33 @@ def main():
             rec["exact"] = exact
             if not exact:
                 raise ValueError("output mismatch vs gf8 golden")
-            t = _median_time(lambda: jax.block_until_ready(fn(data)), iters=5, warmup=2)
-            rec["per_call_gbps"] = round(DATA_BYTES / t / 1e9, 3)
-            rec["steady_gbps"] = round(steady_gbps(fn, data), 3)
-            results[name] = rec["steady_gbps"]
+            if not SMOKE:
+                t = _median_time(
+                    lambda: jax.block_until_ready(fn(data)), iters=5, warmup=2
+                )
+                rec["per_call_gbps"] = round(DATA_BYTES / t / 1e9, 3)
+                rec["steady_gbps"] = round(
+                    steady_gbps(fn, data, out_rows=gm.shape[0]), 3
+                )
+                results[name] = rec["steady_gbps"]
         except Exception as e:  # noqa: BLE001
             rec["error"] = str(e)[:300]
+            failed.append(name)
         print(json.dumps(rec), flush=True)
 
+    if SMOKE:
+        print(
+            json.dumps(
+                {"smoke_ok": not failed, "variants": len(variants), "failed": failed}
+            ),
+            flush=True,
+        )
+        return 1 if failed else 0
     if results:
         best = max(results, key=results.get)
         print(json.dumps({"best": best, "steady_gbps": results[best]}), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import threading
+import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Optional
 
@@ -153,6 +154,31 @@ class EcVolume:
     @property
     def shard_ids(self) -> list[int]:
         return sorted(self._shard_files)
+
+    def verify_local_shards(self) -> Optional[dict]:
+        """Check every locally-held shard file against the CRC32s the
+        streaming encode recorded in the .eci sidecar (and rebuilds verify
+        on write) — the fsck-style integrity pass for a mounted EC volume.
+        Returns {shard_id: ok} or None when the volume predates CRC
+        recording (no shard_crc32 in the sidecar)."""
+        info = stripe.read_ec_info(self.base)
+        recorded = (info or {}).get("shard_crc32")
+        if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+            return None
+        out = {}
+        for s in sorted(self._shard_files):
+            # private handle per shard: the serving handles in
+            # self._shard_files are seek/read'd by concurrent interval
+            # reads, and an fsck pass sharing them would race both sides
+            with open(stripe.shard_file_name(self.base, s), "rb") as f:
+                crc = 0
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    crc = zlib.crc32(chunk, crc)
+            out[s] = crc == recorded[s]
+        return out
 
     def drop_local_shard(self, shard_id: int) -> bool:
         """Stop serving a shard from local disk (single-shard unmount /

@@ -9,16 +9,25 @@ file is the concatenation of its column across rows. All 14 shard files end up
 the same length.
 
 TPU-first deviation from the reference's inner loop: the reference encodes
-256 KiB buffer segments one at a time per goroutine; here segments are stacked
-into a (batch, shards, seg) tensor and dispatched as ONE device call per
-batch so the MXU sees large matmuls (SURVEY.md §2.5 pipeline analog). The
-on-disk output is byte-identical either way.
+256 KiB buffer segments one at a time per goroutine; here segments are laid
+out flat in a reused (shards, width) host staging buffer and dispatched as
+ONE wide device matmul per batch (SURVEY.md §2.5 pipeline analog) — GF
+matmul is column-independent, so the flat form is byte-identical to any
+per-segment batching. The streaming paths run a configurable depth-N
+inflight pipeline (double/triple buffering) over a ring of staging buffers:
+batch K's parity/decode computes on-device while batches K+1..K+depth read
+from disk, with no per-batch host allocation (readinto straight into the
+staging ring, buffer donation releasing batch HBM early on device
+backends) and the
+per-shard CRC32 folded into the same pass so shard bytes are touched once.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import zlib
+from collections import deque
 from contextlib import ExitStack
 from typing import Optional, Sequence
 
@@ -35,6 +44,14 @@ from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types
 from seaweedfs_tpu.storage.needle_map import MemDb
+
+
+#: inflight depth of the streaming encode/rebuild pipelines: how many
+#: batches may be in the read->device->write pipe at once. 1 restores the
+#: pre-r6 behavior (one batch overlapped), 2 = double buffering, 3 = triple.
+#: Deeper pipelines hide longer device/tunnel latencies at the cost of
+#: (depth+1) staging buffers of `max_batch_bytes` each.
+DEFAULT_PIPELINE_DEPTH = max(1, int(os.environ.get("WEEDTPU_PIPELINE_DEPTH", "2")))
 
 
 def to_ext(shard_id: int) -> str:
@@ -55,6 +72,48 @@ def read_padded(f, offset: int, length: int) -> np.ndarray:
     return buf
 
 
+def read_padded_into(f, offset: int, out: np.ndarray) -> None:
+    """Read `out.size` bytes at `offset` straight into a contiguous uint8
+    staging view, zero-filling past EOF — the zero-copy replacement for
+    `read_padded` on the streaming paths (no bytes object, no frombuffer,
+    no intermediate host copy per batch)."""
+    f.seek(offset)
+    got = f.readinto(memoryview(out)) or 0
+    if got < out.size:
+        out[got:] = 0
+
+
+class _StagingRing:
+    """`slots` reused host staging buffers for a depth-N pipeline.
+
+    A slot is pinned from fill until its batch drains; with slots =
+    pipeline_depth + 1 the round-robin take() never hands back a buffer
+    whose batch is still inflight (the pipeline drains to < depth before
+    every take)."""
+
+    def __init__(self, slots: int, shape: tuple):
+        self._bufs = [np.empty(shape, dtype=np.uint8) for _ in range(slots)]
+        self._next = 0
+
+    def take(self) -> np.ndarray:
+        buf = self._bufs[self._next]
+        self._next = (self._next + 1) % len(self._bufs)
+        return buf
+
+
+def _discard_inflight(inflight: deque) -> None:
+    """Failure path: force every pending async dispatch to completion and
+    drop the results, so teardown never races device work still reading
+    from staging buffers. Errors here are suppressed — the original
+    failure propagates from the caller."""
+    while inflight:
+        handle = inflight.popleft()[0]
+        try:
+            np.asarray(handle)
+        except Exception:  # noqa: BLE001 — discarding, not reporting
+            pass
+
+
 def _encode_rows(
     f,
     enc: Encoder,
@@ -64,47 +123,57 @@ def _encode_rows(
     n_rows: int,
     buffer_size: int,
     max_batch_bytes: int,
+    pipeline_depth: Optional[int] = None,
+    crcs: Optional[list] = None,
 ) -> None:
-    """Encode `n_rows` rows of `block_size` blocks, batching segments into
-    single device calls. Output files receive bytes in row-major order."""
+    """Encode `n_rows` rows of `block_size` blocks as a stream of flat
+    (DATA_SHARDS, width) device dispatches over reused staging buffers.
+    Output files receive bytes in row-major order.
+
+    Depth-N pipeline: up to `pipeline_depth` batches' parity computes
+    on-device (async dispatch) while the next batch's disk reads run;
+    the np.asarray in drain_one() is the per-batch synchronization point,
+    and drains happen FIFO so parity files receive bytes in order. Data
+    shards stream to disk at fill time (their bytes never cross the
+    device); when `crcs` is given, each shard's running CRC32 is folded
+    in the same pass — bytes are touched once, no second host pass."""
+    if n_rows <= 0:
+        return
     if buffer_size > block_size:
         buffer_size = block_size
     if block_size % buffer_size:
         raise ValueError(f"block size {block_size} not a multiple of buffer {buffer_size}")
+    depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
     segs_per_row = block_size // buffer_size
     # how many (10 x buffer) segments fit the device-batch budget
     batch_cap = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
-    # iterate segments in global order (row-major, then segment within block)
-    pending: list[tuple[int, int]] = []  # (row, seg)
-    # one-deep pipeline (SURVEY §7.1 double buffering): batch N's parity
-    # computes on-device (async dispatch) while batch N+1's disk reads run;
-    # the np.asarray in drain() is the synchronization point
-    inflight: list[tuple[np.ndarray, object]] = []  # [(data, parity_handle)]
+    span = batch_cap * buffer_size
+    ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
+    inflight: deque = deque()  # FIFO of (parity_handle, width)
 
-    def drain() -> None:
-        if not inflight:
-            return
-        data, parity = inflight.pop()
-        parity_np = np.asarray(parity)
-        if DATA_SHARDS_COUNT + parity_np.shape[1] != len(outputs):
+    def drain_one() -> None:
+        parity, width = inflight.popleft()
+        parity_np = np.asarray(parity)  # sync point
+        if DATA_SHARDS_COUNT + parity_np.shape[0] != len(outputs):
             # a geometry-mismatched encoder must fail loudly, not leave
             # trailing .ecNN files silently empty
             raise ValueError(
-                f"encoder produced {parity_np.shape[1]} parity shards; "
+                f"encoder produced {parity_np.shape[0]} parity shards; "
                 f"layout wants {len(outputs) - DATA_SHARDS_COUNT}"
             )
-        for bi in range(data.shape[0]):
-            for s in range(DATA_SHARDS_COUNT):
-                # contiguous row views write via the buffer protocol —
-                # no tobytes() copy per (batch, shard)
-                outputs[s].write(data[bi, s])
-            for p in range(parity_np.shape[1]):
-                outputs[DATA_SHARDS_COUNT + p].write(parity_np[bi, p])
+        for p in range(parity_np.shape[0]):
+            row = np.ascontiguousarray(parity_np[p, :width])
+            outputs[DATA_SHARDS_COUNT + p].write(row)
+            if crcs is not None:
+                crcs[DATA_SHARDS_COUNT + p] = zlib.crc32(row, crcs[DATA_SHARDS_COUNT + p])
 
-    def flush(batch: list[tuple[int, int]]):
+    def flush(batch: list) -> None:
         if not batch:
             return
-        data = np.empty((len(batch), DATA_SHARDS_COUNT, buffer_size), dtype=np.uint8)
+        width = len(batch) * buffer_size
+        while len(inflight) >= depth:
+            drain_one()
+        staging = ring.take()
         # read runs of consecutive segments as one contiguous slab per shard
         # (10 large sequential reads per row-run instead of one seek per
         # segment x shard — keeps readahead alive at 1 GiB block strides)
@@ -114,26 +183,36 @@ def _encode_rows(
             j = i
             while j + 1 < len(batch) and batch[j + 1] == (row, batch[j][1] + 1):
                 j += 1
-            nseg = j - i + 1
             row_start = start_offset + row * block_size * DATA_SHARDS_COUNT
             for d in range(DATA_SHARDS_COUNT):
-                slab = read_padded(
-                    f, row_start + d * block_size + seg0 * buffer_size, nseg * buffer_size
+                read_padded_into(
+                    f,
+                    row_start + d * block_size + seg0 * buffer_size,
+                    staging[d, i * buffer_size : (j + 1) * buffer_size],
                 )
-                data[i : j + 1, d] = slab.reshape(nseg, buffer_size)
             i = j + 1
-        parity = enc.encode_parity_lazy(data)  # async: returns pre-compute
-        drain()  # materialize + write the PREVIOUS batch while this one runs
-        inflight.append((data, parity))
+        view = staging[:, :width]
+        for d in range(DATA_SHARDS_COUNT):
+            outputs[d].write(view[d])
+            if crcs is not None:
+                crcs[d] = zlib.crc32(view[d], crcs[d])
+        inflight.append((enc.encode_parity_lazy(view, donate=True), width))
 
-    for row in range(n_rows):
-        for seg in range(segs_per_row):
-            pending.append((row, seg))
-            if len(pending) >= batch_cap:
-                flush(pending)
-                pending = []
-    flush(pending)
-    drain()
+    try:
+        # iterate segments in global order (row-major, then segment in block)
+        pending: list = []  # (row, seg)
+        for row in range(n_rows):
+            for seg in range(segs_per_row):
+                pending.append((row, seg))
+                if len(pending) >= batch_cap:
+                    flush(pending)
+                    pending = []
+        flush(pending)
+        while inflight:
+            drain_one()
+    except BaseException:
+        _discard_inflight(inflight)
+        raise
 
 
 def write_ec_files(
@@ -143,8 +222,16 @@ def write_ec_files(
     buffer_size: int = EC_BUFFER_SIZE,
     encoder: Optional[Encoder] = None,
     max_batch_bytes: int = 64 * 1024 * 1024,
+    pipeline_depth: Optional[int] = None,
 ) -> None:
-    """<base>.dat -> <base>.ec00 .. .ec13 (WriteEcFiles semantics)."""
+    """<base>.dat -> <base>.ec00 .. .ec13 (WriteEcFiles semantics).
+
+    Each shard's CRC32 is computed inline as its bytes stream through the
+    encode pipeline (one touch per byte — no second host read-back pass)
+    and recorded in the .eci sidecar for later shard verification. A
+    mid-stream failure drains the inflight device work and unlinks every
+    partial .ecNN file — a crashed encode never leaves a truncated shard
+    set that a later rebuild would mistake for truth."""
     enc = encoder or new_encoder()
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
@@ -161,28 +248,48 @@ def write_ec_files(
         n_small += 1
         remaining -= small_row
 
-    with ExitStack() as stack:
-        f = stack.enter_context(open(dat_path, "rb"))
-        outputs = [
-            stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
-            for s in range(TOTAL_SHARDS_COUNT)
-        ]
-        _encode_rows(f, enc, outputs, 0, large_block_size, n_large, buffer_size, max_batch_bytes)
-        _encode_rows(
-            f,
-            enc,
-            outputs,
-            n_large * large_row,
-            small_block_size,
-            n_small,
-            min(buffer_size, small_block_size),
-            max_batch_bytes,
-        )
-    write_ec_info(base_file_name, large_block_size, small_block_size, dat_size)
+    crcs = [0] * TOTAL_SHARDS_COUNT
+    try:
+        with ExitStack() as stack:
+            f = stack.enter_context(open(dat_path, "rb"))
+            outputs = [
+                stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
+                for s in range(TOTAL_SHARDS_COUNT)
+            ]
+            _encode_rows(
+                f, enc, outputs, 0, large_block_size, n_large, buffer_size,
+                max_batch_bytes, pipeline_depth, crcs,
+            )
+            _encode_rows(
+                f,
+                enc,
+                outputs,
+                n_large * large_row,
+                small_block_size,
+                n_small,
+                min(buffer_size, small_block_size),
+                max_batch_bytes,
+                pipeline_depth,
+                crcs,
+            )
+    except BaseException:
+        for s in range(TOTAL_SHARDS_COUNT):
+            try:
+                os.unlink(shard_file_name(base_file_name, s))
+            except OSError:
+                pass
+        raise
+    write_ec_info(
+        base_file_name, large_block_size, small_block_size, dat_size, shard_crcs=crcs
+    )
 
 
 def write_ec_info(
-    base_file_name: str, large_block_size: int, small_block_size: int, dat_size: int
+    base_file_name: str,
+    large_block_size: int,
+    small_block_size: int,
+    dat_size: int,
+    shard_crcs: Optional[Sequence[int]] = None,
 ) -> None:
     """Record the stripe geometry + true .dat size in an .eci sidecar.
 
@@ -190,17 +297,19 @@ def write_ec_info(
     constants; here they are parameters (tests use scaled-down geometry), and
     opening a shard set with the wrong geometry would silently mis-map
     intervals. Shard sets written by stock tooling (no .eci) still open fine
-    with the default constants."""
+    with the default constants. `shard_crcs` (one CRC32 per shard file,
+    computed inline by the streaming encode) rides along when available so
+    rebuilds and fsck can verify shard integrity without a golden copy."""
+    info = {
+        "large_block_size": large_block_size,
+        "small_block_size": small_block_size,
+        "dat_size": dat_size,
+    }
+    if shard_crcs is not None:
+        info["shard_crc32"] = [int(c) for c in shard_crcs]
     tmp = base_file_name + ".eci.tmp"
     with open(tmp, "w") as f:
-        json.dump(
-            {
-                "large_block_size": large_block_size,
-                "small_block_size": small_block_size,
-                "dat_size": dat_size,
-            },
-            f,
-        )
+        json.dump(info, f)
     os.replace(tmp, base_file_name + ".eci")
 
 
@@ -266,66 +375,104 @@ def rebuild_ec_files(
     encoder: Optional[Encoder] = None,
     buffer_size: int = 4 * 1024 * 1024,
     max_batch_bytes: int = 64 * 1024 * 1024,
+    pipeline_depth: Optional[int] = None,
 ) -> list[int]:
     """Reconstruct missing .ecNN files from >=10 survivors (RebuildEcFiles).
 
-    The device-first repair path: chunks are stacked into a
-    (batch, survivors, buffer) tensor and decoded by ONE fused
-    survivors->missing matrix in ONE device dispatch per batch (not per
-    chunk), with the same one-deep inflight pipeline as `_encode_rows` —
-    batch N decodes on-device (async dispatch) while batch N+1's slab
-    reads run; the np.asarray in drain() is the synchronization point.
-    Reads are one contiguous slab per survivor per batch, so disk
-    readahead stays alive. Output is byte-identical to
-    `rebuild_ec_files_serial` (zero-padding the tail chunk is exact: GF
+    The device-first repair path: each batch is one flat
+    (survivors, width) slab — one contiguous read per survivor straight
+    into a reused staging ring (no chunk transpose, no per-batch host
+    allocation) decoded by ONE fused survivors->missing matrix in ONE
+    device dispatch, with the same depth-N inflight pipeline as
+    `_encode_rows`: up to `pipeline_depth` batches decode on-device while
+    the next batch's slab reads run; drains are FIFO so rebuilt files
+    receive bytes in order. Output is byte-identical to
+    `rebuild_ec_files_serial` (zero-padding the tail slab is exact: GF
     matmul maps zero columns to zero columns, and the pad is trimmed
-    before writing).
+    before writing). Rebuilt shards' CRC32s are folded in as the bytes
+    stream out and checked against the .eci-recorded values when present;
+    a mid-stream failure (or CRC mismatch) drains inflight device work
+    and unlinks the partial rebuilt files instead of leaking them.
 
     Returns the rebuilt shard ids."""
     enc = encoder or new_encoder()
     present, missing, shard_size = _check_rebuild_geometry(base_file_name)
     if not missing:
         return []
+    depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
     # first DATA_SHARDS present ids, exactly like Encoder._pick_survivors —
     # the serial path and this one must derive the SAME decode matrix
     survivors = present[:DATA_SHARDS_COUNT]
     chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
     span = chunks_per_batch * buffer_size
-    with ExitStack() as stack:
-        ins = {
-            s: stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
-            for s in survivors
-        }
-        outs = {
-            s: stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
-            for s in missing
-        }
-        inflight: list[tuple[object, int]] = []  # [(decoded_handle, valid_bytes)]
+    ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
+    crcs = {s: 0 for s in missing}
+    try:
+        with ExitStack() as stack:
+            ins = {
+                s: stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
+                for s in survivors
+            }
+            outs = {
+                s: stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
+                for s in missing
+            }
+            inflight: deque = deque()  # FIFO of (decoded_handle, valid_bytes)
 
-        def drain() -> None:
-            if not inflight:
-                return
-            lazy, valid = inflight.pop()
-            out = np.asarray(lazy)  # (B, len(missing), buffer) — sync point
-            for k, s in enumerate(missing):
-                # contiguous view writes via the buffer protocol; the tail
-                # batch trims its zero-pad back off
-                outs[s].write(np.ascontiguousarray(out[:, k, :]).reshape(-1)[:valid])
+            def drain_one() -> None:
+                lazy, valid = inflight.popleft()
+                out = np.asarray(lazy)  # (len(missing), width) — sync point
+                for k, s in enumerate(missing):
+                    # contiguous row slice writes via the buffer protocol;
+                    # the tail batch trims its zero-pad back off
+                    row = out[k, :valid]
+                    outs[s].write(row)
+                    crcs[s] = zlib.crc32(row, crcs[s])
 
-        for off in range(0, shard_size, span):
-            valid = min(span, shard_size - off)
-            nchunks = -(-valid // buffer_size)
-            data = np.empty((DATA_SHARDS_COUNT, nchunks * buffer_size), dtype=np.uint8)
-            for i, s in enumerate(survivors):
-                data[i] = read_padded(ins[s], off, nchunks * buffer_size)
-            chunked = np.ascontiguousarray(
-                data.reshape(DATA_SHARDS_COUNT, nchunks, buffer_size).transpose(1, 0, 2)
-            )
-            decoded = enc.reconstruct_lazy(chunked, survivors, missing)  # async
-            drain()  # materialize + write the PREVIOUS batch while this one runs
-            inflight.append((decoded, valid))
-        drain()
+            try:
+                for off in range(0, shard_size, span):
+                    valid = min(span, shard_size - off)
+                    width = -(-valid // buffer_size) * buffer_size
+                    while len(inflight) >= depth:
+                        drain_one()
+                    staging = ring.take()
+                    for i, s in enumerate(survivors):
+                        read_padded_into(ins[s], off, staging[i, :width])
+                    decoded = enc.reconstruct_lazy(
+                        staging[:, :width], survivors, missing, donate=True
+                    )  # async
+                    inflight.append((decoded, valid))
+                while inflight:
+                    drain_one()
+            except BaseException:
+                _discard_inflight(inflight)
+                raise
+        _verify_rebuilt_crcs(base_file_name, crcs)
+    except BaseException:
+        for s in missing:
+            try:
+                os.unlink(shard_file_name(base_file_name, s))
+            except OSError:
+                pass
+        raise
     return missing
+
+
+def _verify_rebuilt_crcs(base_file_name: str, crcs: dict) -> None:
+    """Integrity gate on the rebuild output: when the volume's .eci recorded
+    per-shard CRC32s at encode time, a rebuilt shard whose streaming CRC
+    disagrees means a silently-corrupt survivor (or a decode bug) produced
+    garbage — fail the rebuild rather than ship a wrong shard."""
+    info = read_ec_info(base_file_name)
+    recorded = (info or {}).get("shard_crc32")
+    if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+        return
+    bad = {s: (c, recorded[s]) for s, c in crcs.items() if c != recorded[s]}
+    if bad:
+        raise IOError(
+            f"rebuilt shard CRC mismatch vs .eci record: "
+            f"{{shard: (got, want)}} = {bad} — corrupt survivor?"
+        )
 
 
 def rebuild_ec_files_serial(

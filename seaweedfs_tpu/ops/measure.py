@@ -2,11 +2,16 @@
 slope method shared by bench.py, scripts/kernel_sweep.py, and
 scripts/device_window.py.
 
-Method: jit a `lax.scan` of K chained encodes into a single dispatch and
-time K=1 vs K=8; the slope (t8-t1)/7 is the per-encode device time, with
+Method: jit a `lax.scan` of K chained applies into a single dispatch and
+time K=1 vs K=8; the slope (t8-t1)/7 is the per-apply device time, with
 the per-dispatch overhead (the ~65 ms axon tunnel RTT) cancelled out.
 The xor-chain keeps every iteration data-dependent so XLA cannot hoist
-or dedupe encodes, while staying byte-reversible (cheap on the VPU).
+or dedupe applies, while staying byte-reversible (cheap on the VPU).
+
+Covers BOTH north-star shapes: encode ((B, C, N) -> (B, C+R, N) parity
+append) and reconstruct ((B, C, N) survivor stack -> (B, W, N) decoded
+shards) — `out_rows` names how many output rows the chain folds back
+into the accumulator (W for a decode matrix, parity count for encode).
 """
 
 from __future__ import annotations
@@ -14,10 +19,14 @@ from __future__ import annotations
 import time
 
 
-def scan_chain_gbps(encode_fn, data, data_bytes: int, iters: int = 3) -> float:
+def scan_chain_gbps(
+    encode_fn, data, data_bytes: int, iters: int = 3, out_rows: int = 4
+) -> float:
     """Steady-state effective GB/s of `encode_fn` ((B, C, N) uint8 ->
-    (B, C+R, N)) on device-resident `data`. Raises ValueError when timing
-    noise swamps the slope — a non-positive slope is an invalid
+    (B, R>=out_rows, N)) on device-resident `data`. `out_rows` is how many
+    of the output's shard rows feed the xor chain (4 for RS(10+4) encode
+    parity; len(wanted) for a fused decode matrix). Raises ValueError when
+    timing noise swamps the slope — a non-positive slope is an invalid
     measurement, never a throughput."""
     import jax
     import jax.numpy as jnp
@@ -29,11 +38,11 @@ def scan_chain_gbps(encode_fn, data, data_bytes: int, iters: int = 3) -> float:
         @jax.jit
         def chain(d):
             def body(acc, i):
-                return acc ^ encode_fn(d ^ i)[:, :4, :], ()
+                return acc ^ encode_fn(d ^ i)[:, :out_rows, :], ()
 
             acc, _ = lax.scan(
                 body,
-                jnp.zeros((b, 4, n), jnp.uint8),
+                jnp.zeros((b, out_rows, n), jnp.uint8),
                 jnp.arange(k, dtype=jnp.uint8),
             )
             return acc

@@ -19,14 +19,22 @@ elimination and cached — the role of the reference codec's inversion tree
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence
 
 import numpy as np
 
 from seaweedfs_tpu.ops import gf8
 
+#: LRU cap on cached decode matrices. A long-lived volume server whose
+#: shard-loss patterns churn (peers flapping, rolling repairs) sees an
+#: unbounded stream of (survivors, wanted) keys — C(14,10) x wanted sets is
+#: thousands of patterns — so the memo must evict, not grow for the life of
+#: the process. Matrices are tiny; the cap bounds the GF-elimination *keys*.
+DECODE_MATRIX_CACHE_SIZE = int(os.environ.get("WEEDTPU_DECODE_MATRIX_CACHE", "512"))
 
-@functools.lru_cache(maxsize=4096)
+
+@functools.lru_cache(maxsize=max(16, DECODE_MATRIX_CACHE_SIZE))
 def _reconstruction_matrix(
     kind: str,
     data_shards: int,
@@ -48,6 +56,16 @@ def _reconstruction_matrix(
     out = np.stack(rows).astype(np.uint8)
     out.setflags(write=False)
     return out
+
+
+def decode_matrix_cache_info():
+    """The decode-matrix memo's (hits, misses, maxsize, currsize) — lets
+    operators/tests assert the cache stays bounded under loss-pattern churn."""
+    return _reconstruction_matrix.cache_info()
+
+
+def clear_decode_matrix_cache() -> None:
+    _reconstruction_matrix.cache_clear()
 
 
 class Encoder:
@@ -94,19 +112,22 @@ class Encoder:
 
     # -- kernel dispatch ----------------------------------------------------
 
-    def _apply_lazy(self, m: np.ndarray, shards: np.ndarray):
+    def _apply_lazy(self, m: np.ndarray, shards: np.ndarray, donate: bool = False):
         """Apply GF matrix m without forcing the result to the host: the
         jax/pallas backends return a device array (async dispatch), numpy/
         native an ndarray. The ONE backend dispatch point — _apply and
-        encode_parity_lazy are both defined in terms of it."""
+        encode_parity_lazy are both defined in terms of it. donate=True
+        (jax/pallas, off-CPU only) releases the input's device buffer at
+        dispatch-consume time so a streaming pipeline's inflight HBM stays
+        bounded (an early-release hint — see rs_jax's donated-twin note)."""
         if self.backend == "pallas":
             from seaweedfs_tpu.ops import rs_pallas
 
-            return rs_pallas.apply_matrix(m, shards)
+            return rs_pallas.apply_matrix(m, shards, donate=donate)
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_jax
 
-            return rs_jax.apply_matrix(m, shards)
+            return rs_jax.apply_matrix(m, shards, donate=donate)
         if self.backend == "native":
             out = self._apply_native(m, shards)
             if out is not None:
@@ -164,17 +185,24 @@ class Encoder:
             axis=1,
         )
 
-    def encode_parity_lazy(self, data: np.ndarray):
+    def encode_parity_lazy(self, data: np.ndarray, donate: bool = False):
         """Batched parity WITHOUT forcing the result to the host:
-        (B, data_shards, N) -> (B, parity_shards, N) device array (jax/
+        (B, data_shards, N) -> (B, parity_shards, N) — or the flat 2-D form
+        (data_shards, N) -> (parity_shards, N), which streaming pipelines
+        prefer (one wide matmul, no batch axis) — as a device array (jax/
         pallas backends) or ndarray (numpy). JAX's async dispatch returns
         immediately, so the caller can overlap the NEXT batch's disk reads
         with this batch's device compute (SURVEY §7.1 double buffering);
-        np.asarray() on the result is the synchronization point."""
+        np.asarray() on the result is the synchronization point. donate=True
+        releases the batch's device buffer at dispatch-consume time
+        (off-CPU; an early-release hint, see rs_jax's donated-twin note)."""
         data = np.asarray(data, dtype=np.uint8)
-        if data.ndim != 3 or data.shape[1] != self.data_shards:
+        if data.ndim == 2:
+            if data.shape[0] != self.data_shards:
+                raise ValueError(f"want ({self.data_shards}, N), got {data.shape}")
+        elif data.ndim != 3 or data.shape[1] != self.data_shards:
             raise ValueError(f"want (B, {self.data_shards}, N), got {data.shape}")
-        return self._apply_lazy(self.parity_matrix, data)
+        return self._apply_lazy(self.parity_matrix, data, donate=donate)
 
     def _pick_survivors(self, shards: Sequence[Optional[np.ndarray]]) -> list[int]:
         present = [i for i, s in enumerate(shards) if s is not None]
@@ -252,19 +280,26 @@ class Encoder:
         stack: np.ndarray,
         survivors: Sequence[int],
         wanted: Sequence[int],
+        donate: bool = False,
     ):
         """Batched repair WITHOUT forcing the result to the host: a
         (B, data_shards, N) survivor stack (rows in `survivors` order)
-        -> (B, len(wanted), N) device array (jax/pallas) or ndarray
-        (numpy/native) — ONE device dispatch for the whole batch, the
-        `encode_parity_lazy` contract mirrored for the repair path. JAX's
-        async dispatch returns immediately, so callers overlap the NEXT
-        batch's disk reads with this batch's decode; np.asarray() on the
-        result is the synchronization point."""
+        -> (B, len(wanted), N) — or the flat 2-D (data_shards, N) ->
+        (len(wanted), N) form streaming rebuilds prefer — as a device
+        array (jax/pallas) or ndarray (numpy/native). ONE device dispatch
+        for the whole batch, the `encode_parity_lazy` contract mirrored
+        for the repair path; np.asarray() on the result is the
+        synchronization point. donate=True releases the stack's device
+        buffer at dispatch-consume time (off-CPU early-release hint)."""
         stack = np.asarray(stack, dtype=np.uint8)
-        if stack.ndim != 3 or stack.shape[1] != self.data_shards:
+        if stack.ndim == 2:
+            if stack.shape[0] != self.data_shards:
+                raise ValueError(f"want ({self.data_shards}, N), got {stack.shape}")
+        elif stack.ndim != 3 or stack.shape[1] != self.data_shards:
             raise ValueError(f"want (B, {self.data_shards}, N), got {stack.shape}")
-        return self._apply_lazy(self.reconstruction_matrix(survivors, wanted), stack)
+        return self._apply_lazy(
+            self.reconstruction_matrix(survivors, wanted), stack, donate=donate
+        )
 
     def reconstruct_batch(
         self,

@@ -44,14 +44,7 @@ def bits_to_bytes(bits: jax.Array) -> jax.Array:
     return out
 
 
-@jax.jit
-def gf_apply(b_bits: jax.Array, data: jax.Array) -> jax.Array:
-    """Apply a lifted GF(2^8) matrix to byte shards.
-
-    b_bits: (R*8, C*8) int8 binary matrix (from gf8.gf_matrix_to_bits).
-    data:   (C, N) or (batch, C, N) uint8 input shards.
-    Returns (R, N) / (batch, R, N) uint8 output shards.
-    """
+def _gf_apply_impl(b_bits: jax.Array, data: jax.Array) -> jax.Array:
     bits = bytes_to_bits(data)
     if data.ndim == 2:
         acc = jax.lax.dot_general(
@@ -65,6 +58,38 @@ def gf_apply(b_bits: jax.Array, data: jax.Array) -> jax.Array:
             "rk,bkn->brn", b_bits, bits, preferred_element_type=jnp.int32
         )
     return bits_to_bytes(acc & 1)
+
+
+@jax.jit
+def gf_apply(b_bits: jax.Array, data: jax.Array) -> jax.Array:
+    """Apply a lifted GF(2^8) matrix to byte shards.
+
+    b_bits: (R*8, C*8) int8 binary matrix (from gf8.gf_matrix_to_bits).
+    data:   (C, N) or (batch, C, N) uint8 input shards.
+    Returns (R, N) / (batch, R, N) uint8 output shards.
+    """
+    return _gf_apply_impl(b_bits, data)
+
+
+# Donated twin: the data argument's device buffer is donated to XLA. The
+# (C, N) input cannot alias the smaller (R<=4, N) output (XLA aliasing
+# requires matching shape+dtype), so this is NOT output aliasing — it is a
+# deterministic early-release hint: the batch's input HBM is freed as soon
+# as the dispatch consumes it rather than when host-side references die,
+# bounding a depth-N pipeline's inflight footprint. Whether that moves the
+# steady number is one of the device-window hypotheses to measure. Only
+# selected off-CPU — XLA CPU ignores donation and warns.
+_gf_apply_donated = jax.jit(_gf_apply_impl, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=1)
+def donation_supported() -> bool:
+    """Buffer donation is a no-op (plus a warning per dispatch) on the XLA
+    CPU backend; only the accelerator paths should request it."""
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:  # noqa: BLE001 — no backend: no donation either
+        return False
 
 
 @functools.lru_cache(maxsize=256)
@@ -84,6 +109,18 @@ def encode_parity(data: jax.Array, parity_m: np.ndarray) -> jax.Array:
     return gf_apply(lifted_matrix(parity_m), data)
 
 
-def apply_matrix(m: np.ndarray, shards: jax.Array) -> jax.Array:
-    """Apply an arbitrary GF(2^8) matrix (e.g. a cached decode matrix)."""
-    return gf_apply(lifted_matrix(m), shards)
+def apply_matrix(m: np.ndarray, shards: jax.Array, donate: bool = False) -> jax.Array:
+    """Apply an arbitrary GF(2^8) matrix (e.g. a cached decode matrix).
+
+    donate=True routes through the donated jit so the input's device buffer
+    is released the moment the dispatch consumes it (streaming pipelines
+    dispatch hundreds of same-shaped batches; the early release keeps the
+    inflight HBM footprint at depth x (in + out) instead of trusting
+    host-side GC timing — see the donated-twin note above for why this is
+    a release hint, not output aliasing). The host array is explicitly
+    device_put first so the donated buffer is one jax owns — never a
+    zero-copy alias of caller memory."""
+    b = lifted_matrix(m)
+    if donate and donation_supported():
+        return _gf_apply_donated(b, jax.device_put(jnp.asarray(shards)))
+    return gf_apply(b, shards)

@@ -35,8 +35,36 @@ from jax.experimental.pallas import tpu as pltpu
 from seaweedfs_tpu.ops import gf8
 
 # bytes of one stripe tile per grid step; 8 KiB x (C*8) bits stays well under
-# VMEM while giving the MXU a wide N dimension
+# VMEM while giving the MXU a wide N dimension. Kept as the floor of the
+# retuned auto chooser below — ROOFLINE_r05 hyp 4: at 8 KiB tiles the
+# per-grid-step overhead (semaphores, window swaps) is material, so the
+# default now scales the tile up to the VMEM budget instead.
 DEFAULT_TILE = 8192
+
+#: VMEM the auto tile chooser may plan against. Half the v5e core's ~16 MiB
+#: so Mosaic retains room to double-buffer the HBM<->VMEM windows.
+DEFAULT_VMEM_BUDGET = 8 << 20
+
+#: snap grid for auto tiles — large power-of-two-ish strides keep the
+#: HBM windows aligned and the grid-step count predictable
+_TILE_STEPS = (65536, 49152, 32768, 24576, 16384, 8192, 4096, 2048, 1024, 512, 256, 128)
+
+
+def auto_tile(
+    c: int, rows: int, mxu: str = "int8", vmem_budget: int = DEFAULT_VMEM_BUDGET
+) -> int:
+    """Largest tile whose per-grid-step VMEM working set fits the budget.
+
+    Working set per byte-position of tile: data window (double-buffered,
+    2C) + bit-plane expansion (8C at the MXU dtype's width) + int32
+    accumulator (32R) + output window (double-buffered, 2R)."""
+    bits_width = 2 if mxu == "bf16" else 1
+    per_byte = 2 * c + 8 * c * bits_width + 32 * rows + 2 * rows
+    cap = max(128, vmem_budget // per_byte)
+    for t in _TILE_STEPS:
+        if t <= cap:
+            return t
+    return 128
 
 
 def _kernel(b_ref, data_ref, out_ref):
@@ -49,11 +77,11 @@ def _kernel(b_ref, data_ref, out_ref):
     # VMEM layout — a byte-major stack(axis=1).reshape forces a per-byte
     # sublane interleave Mosaic must shuffle for. The lifted matrix's
     # columns AND rows are pre-permuted host-side to match (free). The
-    # unpack shifts uint8 directly: an int32 widen quadruples the VMEM
-    # working set and costs a relayout before the shifts.
-    bits = jnp.concatenate(
-        [((data >> j) & 1) for j in range(8)], axis=0
-    ).astype(jnp.int8)
+    # unpack shifts int8 (same width as the bytes, so no VMEM inflation):
+    # Mosaic has no uint8 shift lowering, and (x >> j) & 1 extracts bit j
+    # under arithmetic shift exactly as under logical shift for j < 8.
+    di = data.astype(jnp.int8)
+    bits = jnp.concatenate([((di >> j) & 1) for j in range(8)], axis=0)
     acc = jax.lax.dot_general(
         b_ref[...],
         bits,
@@ -71,6 +99,36 @@ def _kernel(b_ref, data_ref, out_ref):
     for i in range(1, 8):
         out = out | (acc3[i] << i)
     out_ref[0] = out.astype(jnp.uint8)
+
+
+def _kernel_bf16(b_ref, data_ref, out_ref):
+    """Same plane-major layout as `_kernel`, but the MXU matmul runs in
+    bf16: products are 0/1 and K = C*8 <= 80 for RS(10+4), so every partial
+    sum <= 80 < 256 is exactly representable in bf16's 8-bit significand
+    (f32 accumulate is exact a fortiori) — int8 matmul on some TPU
+    generations is emulated at a fraction of bf16 rate, so this can win.
+    Promoted from scripts/kernel_sweep.py so production can select it."""
+    data = data_ref[0]
+    di = data.astype(jnp.int8)  # int8 unpack: see _kernel
+    bits = jnp.concatenate(
+        [((di >> j) & 1) for j in range(8)], axis=0
+    ).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        b_ref[...].astype(jnp.bfloat16),
+        bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    acc = acc & 1
+    rows8, t = acc.shape
+    acc3 = acc.reshape(8, rows8 // 8, t)
+    out = acc3[0]
+    for i in range(1, 8):
+        out = out | (acc3[i] << i)
+    out_ref[0] = out.astype(jnp.uint8)
+
+
+_KERNELS = {"int8": _kernel, "bf16": _kernel_bf16}
 
 
 def _plane_major_columns(b_bits: np.ndarray) -> np.ndarray:
@@ -91,20 +149,22 @@ def _on_tpu() -> bool:
     return is_tpu_device(jax.devices()[0])
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def _apply_padded(b_pm, data, tile: int, interpret: bool):
+def _apply_padded_impl(b_pm, data, tile: int, interpret: bool, mxu: str):
     batch, c, n = data.shape
     rows = b_pm.shape[0] // 8
     grid = (batch, n // tile)
     kwargs = {}
-    if not interpret:
+    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if not interpret and params_cls is not None:
         # every grid step is independent (disjoint tiles): telling Mosaic
         # so unlocks unconstrained pipelining of the HBM<->VMEM windows
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = params_cls(
             dimension_semantics=("parallel", "parallel")
         )
     return pl.pallas_call(
-        _kernel,
+        _KERNELS[mxu],
         grid=grid,
         in_specs=[
             pl.BlockSpec((b_pm.shape[0], b_pm.shape[1]), lambda b, i: (0, 0)),
@@ -117,8 +177,33 @@ def _apply_padded(b_pm, data, tile: int, interpret: bool):
     )(b_pm, data)
 
 
-def _apply_pm(b_pm: jax.Array, data: jax.Array, tile: int) -> jax.Array:
+_STATIC = ("tile", "interpret", "mxu")
+_apply_padded_jit = jax.jit(_apply_padded_impl, static_argnames=_STATIC)
+# donated twin: the (large) data buffer's HBM is released as soon as the
+# dispatch consumes it — an early-release hint, not output aliasing (the
+# (B, C, N) input cannot alias the smaller (B, R, N) output; see the
+# rs_jax donated-twin note). No-op + warning on CPU, so callers gate on
+# rs_jax.donation_supported().
+_apply_padded_donated = jax.jit(
+    _apply_padded_impl, static_argnames=_STATIC, donate_argnums=(1,)
+)
+
+
+def _apply_padded(b_pm, data, tile: int, interpret: bool, mxu: str = "int8"):
+    """Compat shim (tpu_lowering exports through this name)."""
+    return _apply_padded_jit(b_pm, data, tile, interpret, mxu)
+
+
+def _apply_pm(
+    b_pm: jax.Array,
+    data: jax.Array,
+    tile: int | None,
+    mxu: str = "int8",
+    donate: bool = False,
+) -> jax.Array:
     """Shared pad/tile/squeeze plumbing over an already-plane-major matrix."""
+    if mxu not in _KERNELS:
+        raise ValueError(f"unknown mxu dtype {mxu!r} (want {sorted(_KERNELS)})")
     squeeze = data.ndim == 2
     if squeeze:
         data = data[None]
@@ -127,26 +212,45 @@ def _apply_pm(b_pm: jax.Array, data: jax.Array, tile: int) -> jax.Array:
     if n == 0:
         out = jnp.zeros((batch, rows, 0), jnp.uint8)
         return out[0] if squeeze else out
+    if tile is None:
+        tile = auto_tile(c, rows, mxu)
     t = min(tile, _round_up(max(n, 128), 128))
     n_pad = _round_up(n, t)
     if n_pad != n:
         data = jnp.pad(data, ((0, 0), (0, 0), (0, n_pad - n)))
-    out = _apply_padded(b_pm, data, t, not _on_tpu())
+    if donate:
+        from seaweedfs_tpu.ops import rs_jax
+
+        if rs_jax.donation_supported():
+            out = _apply_padded_donated(
+                b_pm, jax.device_put(data), t, not _on_tpu(), mxu
+            )
+            if n_pad != n:
+                out = out[..., :n]
+            return out[0] if squeeze else out
+    out = _apply_padded_jit(b_pm, data, t, not _on_tpu(), mxu)
     if n_pad != n:
         out = out[..., :n]
     return out[0] if squeeze else out
 
 
-def gf_apply_fused(b_bits: jax.Array, data: jax.Array, tile: int = DEFAULT_TILE) -> jax.Array:
+def gf_apply_fused(
+    b_bits: jax.Array,
+    data: jax.Array,
+    tile: int | None = None,
+    mxu: str = "int8",
+) -> jax.Array:
     """Fused equivalent of rs_jax.gf_apply for TPU.
 
     b_bits: (R*8, C*8) int8 lifted matrix; data (C, N) or (B, C, N) uint8.
     Handles any N by zero-padding to the tile size (zero bytes encode to
     zero bytes, so padding never corrupts real lanes). Off-TPU the kernel
     runs in Pallas interpret mode so the exact kernel logic stays testable
-    on the CPU mesh.
+    on the CPU mesh. tile=None picks the largest tile whose working set
+    fits the VMEM budget (`auto_tile`); mxu selects the matmul dtype
+    ("int8" or the exact-by-range "bf16" variant).
     """
-    return _apply_pm(_lifted_plane_major(b_bits), data, tile)
+    return _apply_pm(_lifted_plane_major(b_bits), data, tile, mxu)
 
 
 @functools.lru_cache(maxsize=256)
@@ -202,7 +306,17 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def apply_matrix(m: np.ndarray, shards, tile: int = DEFAULT_TILE) -> jax.Array:
+def apply_matrix(
+    m: np.ndarray,
+    shards,
+    tile: int | None = None,
+    mxu: str = "int8",
+    donate: bool = False,
+) -> jax.Array:
     """GF(2^8) matrix application via the fused kernel: the hot path —
-    lift + permute host-side once per matrix value, no device round-trip."""
-    return _apply_pm(plane_major_matrix(m), jnp.asarray(shards), tile)
+    lift + permute host-side once per matrix value, no device round-trip.
+    donate=True releases the input's device buffer at dispatch-consume
+    time (streaming pipelines; ignored on CPU where donation is a no-op)."""
+    return _apply_pm(
+        plane_major_matrix(m), jnp.asarray(shards), tile, mxu, donate=donate
+    )

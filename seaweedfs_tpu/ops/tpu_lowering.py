@@ -32,6 +32,12 @@ from typing import Optional
 #   small-read:    one-interval degraded read, minimum 128-byte tile
 PROOF_SHAPES = (
     {"name": "encode_10p4_tile8192", "rows": 4, "cols": 10, "tile": 8192, "batch": 4},
+    # the retuned defaults (auto_tile: VMEM-budget tiles + the bf16-MXU
+    # variant) must lower through Mosaic too, or the sweep would be the
+    # first place they ever hit the TPU toolchain
+    {"name": "encode_10p4_tile32768", "rows": 4, "cols": 10, "tile": 32768, "batch": 4},
+    {"name": "encode_10p4_tile24576_bf16", "rows": 4, "cols": 10, "tile": 24576,
+     "batch": 4, "mxu": "bf16"},
     {"name": "reconstruct_4from10_tile8192", "rows": 4, "cols": 10, "tile": 8192, "batch": 1},
     {"name": "reconstruct_10from10_tile8192", "rows": 10, "cols": 10, "tile": 8192, "batch": 1},
     {"name": "small_read_tile128", "rows": 4, "cols": 10, "tile": 128, "batch": 1},
@@ -39,7 +45,7 @@ PROOF_SHAPES = (
 
 
 def export_fused_kernel(
-    rows: int, cols: int, tile: int, batch: int = 1
+    rows: int, cols: int, tile: int, batch: int = 1, mxu: str = "int8"
 ) -> tuple[str, dict]:
     """Lower `_apply_padded` for the TPU platform; return (MLIR text, meta).
 
@@ -62,7 +68,7 @@ def export_fused_kernel(
     b_bits = rs_jax.lifted_matrix(m)
     n = tile * 2
 
-    fn = lambda b, d: rs_pallas._apply_padded(b, d, tile, False)  # noqa: E731
+    fn = lambda b, d: rs_pallas._apply_padded(b, d, tile, False, mxu)  # noqa: E731
     args = (
         jax.ShapeDtypeStruct(b_bits.shape, jnp.int8),
         jax.ShapeDtypeStruct((batch, cols, n), jnp.uint8),
@@ -74,6 +80,7 @@ def export_fused_kernel(
         "cols": cols,
         "tile": tile,
         "batch": batch,
+        "mxu": mxu,
         "n": n,
         "platforms": list(exported.platforms),
         "mlir_bytes": len(mlir),
@@ -108,7 +115,8 @@ for spec in tpu_lowering.PROOF_SHAPES:
     name = spec["name"]
     try:
         mlir, meta = tpu_lowering.export_fused_kernel(
-            spec["rows"], spec["cols"], spec["tile"], spec["batch"])
+            spec["rows"], spec["cols"], spec["tile"], spec["batch"],
+            spec.get("mxu", "int8"))
         meta["name"] = name
         meta["ok"] = meta["has_tpu_custom_call"]
         out.append(meta)

@@ -98,15 +98,16 @@ def test_batched_rebuild_device_backend_matches(tmp_path, backend):
 
 def test_rebuild_one_dispatch_per_batch(tmp_path):
     """The acceptance criterion: dispatches scale with batches (ceil of
-    chunks / batch-cap), never with chunks."""
+    chunks / batch-cap), never with chunks — now as flat (survivors, width)
+    slabs, one wide matmul per batch."""
     base, golden = _make_volume(tmp_path, size=655_360)  # shard = 65536 B
     calls = []
     orig = Encoder.reconstruct_lazy
 
     class Counting(Encoder):
-        def reconstruct_lazy(self, stack, survivors, wanted):
+        def reconstruct_lazy(self, stack, survivors, wanted, **kw):
             calls.append(stack.shape)
-            return orig(self, stack, survivors, wanted)
+            return orig(self, stack, survivors, wanted, **kw)
 
     enc = Counting(10, 4, backend="numpy")
     # 8 chunks of 8 KiB per shard; cap = 3 chunks/batch -> 3 dispatches
@@ -114,7 +115,7 @@ def test_rebuild_one_dispatch_per_batch(tmp_path):
         base, golden, [0, 13], enc, buffer_size=8192, max_batch_bytes=3 * 10 * 8192
     )
     assert len(calls) == 3, f"want 3 batch dispatches for 8 chunks, got {calls}"
-    assert [c[0] for c in calls] == [3, 3, 2]
+    assert [c for c in calls] == [(10, 3 * 8192), (10, 3 * 8192), (10, 2 * 8192)]
 
 
 def test_rebuild_too_few_survivors_raises(tmp_path):

@@ -1,0 +1,329 @@
+"""Depth-N streaming pipeline tests (r6): tile-boundary and odd-size
+byte-exactness against the numpy golden, depth-1 vs depth-N byte-identity,
+fused per-shard CRC recording/verification, exception-safety (a mid-stream
+failure must drain inflight device work and unlink partial shard files),
+decode-matrix cache boundedness, and the kernel_sweep --smoke CI gate."""
+
+import json
+import os
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ops import gf8
+from seaweedfs_tpu.ops.rs_codec import (
+    Encoder,
+    clear_decode_matrix_cache,
+    decode_matrix_cache_info,
+)
+from seaweedfs_tpu.ops.rs_pallas import DEFAULT_TILE
+
+ENC = Encoder(10, 4, backend="numpy")
+
+# sizes straddling DEFAULT_TILE multiples, plus degenerate tails
+TILE_EDGE_SIZES = [
+    1,
+    127,
+    DEFAULT_TILE - 1,
+    DEFAULT_TILE,
+    DEFAULT_TILE + 1,
+    2 * DEFAULT_TILE + 17,
+]
+
+
+# -- kernel-level: odd sizes must match the numpy golden byte-for-byte --------
+
+
+@pytest.mark.parametrize("backend", ["jax", "pallas"])
+@pytest.mark.parametrize("n", TILE_EDGE_SIZES)
+def test_encode_batch_tile_edges_match_golden(backend, n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, size=(2, 10, n), dtype=np.uint8)
+    enc = Encoder(10, 4, backend=backend)
+    got = enc.encode_batch(data)
+    pm = gf8.parity_matrix(10, 4)
+    for b in range(2):
+        want = gf8.gf_mat_mul(pm, data[b])
+        np.testing.assert_array_equal(got[b, :10], data[b])
+        np.testing.assert_array_equal(got[b, 10:], want, err_msg=f"n={n}")
+
+
+@pytest.mark.parametrize("backend", ["jax", "pallas"])
+@pytest.mark.parametrize("n", [1, DEFAULT_TILE - 1, DEFAULT_TILE + 1])
+def test_reconstruct_batch_tile_edges_match_golden(backend, n):
+    rng = np.random.default_rng(n + 1)
+    data = rng.integers(0, 256, size=(10, n), dtype=np.uint8)
+    full = ENC.encode(list(data))
+    lost = [0, 5, 11, 13]
+    survivors = [i for i in range(14) if i not in lost][:10]
+    stack = np.stack([full[s] for s in survivors])[None]
+    enc = Encoder(10, 4, backend=backend)
+    out = enc.reconstruct_batch(stack, survivors, lost)
+    for k, w in enumerate(lost):
+        np.testing.assert_array_equal(out[0, k], full[w], err_msg=f"n={n} shard {w}")
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax", "pallas"])
+def test_encode_empty_width(backend):
+    enc = Encoder(10, 4, backend=backend)
+    out = enc.encode_batch(np.zeros((1, 10, 0), dtype=np.uint8))
+    assert out.shape == (1, 14, 0)
+
+
+# -- file-level: depth-1 vs depth-N byte-identity -----------------------------
+
+
+def _write_dat(tmp_path, size, seed=1):
+    base = os.path.join(str(tmp_path), "v")
+    rng = np.random.default_rng(seed)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    return base
+
+
+@pytest.mark.parametrize("size", [1, 123_457, 655_360])
+def test_encode_depths_byte_identical(tmp_path, size):
+    base = _write_dat(tmp_path, size)
+    shards_by_depth = {}
+    for depth in (1, 3):
+        stripe.write_ec_files(
+            base, large_block_size=16384, small_block_size=4096,
+            buffer_size=4096, encoder=ENC, max_batch_bytes=10 * 3 * 4096,
+            pipeline_depth=depth,
+        )
+        shards_by_depth[depth] = [
+            open(stripe.shard_file_name(base, s), "rb").read()
+            for s in range(TOTAL_SHARDS_COUNT)
+        ]
+    assert shards_by_depth[1] == shards_by_depth[3]
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_rebuild_depths_match_serial_oracle(tmp_path, depth):
+    base = _write_dat(tmp_path, 200_000)
+    stripe.write_ec_files(
+        base, large_block_size=16384, small_block_size=4096, encoder=ENC
+    )
+    golden = {
+        s: open(stripe.shard_file_name(base, s), "rb").read()
+        for s in range(TOTAL_SHARDS_COUNT)
+    }
+    lost = [0, 5, 11, 13]
+    for s in lost:
+        os.unlink(stripe.shard_file_name(base, s))
+    rebuilt = stripe.rebuild_ec_files(
+        base, encoder=ENC, buffer_size=8192,
+        max_batch_bytes=10 * 2 * 8192, pipeline_depth=depth,
+    )
+    assert rebuilt == lost
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            assert f.read() == golden[s], f"depth={depth} shard {s}"
+
+
+def test_empty_dat_roundtrip(tmp_path):
+    base = _write_dat(tmp_path, 0)
+    stripe.write_ec_files(
+        base, large_block_size=16384, small_block_size=4096, encoder=ENC
+    )
+    for s in range(TOTAL_SHARDS_COUNT):
+        assert os.path.getsize(stripe.shard_file_name(base, s)) == 0
+    os.unlink(stripe.shard_file_name(base, 2))
+    assert stripe.rebuild_ec_files(base, encoder=ENC) == [2]
+    assert os.path.getsize(stripe.shard_file_name(base, 2)) == 0
+
+
+# -- fused CRC recording + verification ---------------------------------------
+
+
+def test_eci_records_streaming_crcs(tmp_path):
+    base = _write_dat(tmp_path, 100_000)
+    stripe.write_ec_files(
+        base, large_block_size=16384, small_block_size=4096, encoder=ENC
+    )
+    info = stripe.read_ec_info(base)
+    crcs = info["shard_crc32"]
+    assert len(crcs) == TOTAL_SHARDS_COUNT
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            assert crcs[s] == zlib.crc32(f.read()), f"shard {s}"
+
+
+def test_ec_volume_verify_local_shards(tmp_path):
+    from seaweedfs_tpu.ec.ec_volume import EcVolume
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage import types
+
+    base = _write_dat(tmp_path, 50_000)
+    idx_mod.write_entries([(1, types.offset_to_bytes(0), 100)], base + ".idx")
+    stripe.write_ec_files(
+        base, large_block_size=16384, small_block_size=4096, encoder=ENC
+    )
+    stripe.write_sorted_file_from_idx(base)
+    # flip one byte in one shard without changing its length
+    p = stripe.shard_file_name(base, 7)
+    with open(p, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with EcVolume(
+        base, encoder=ENC, large_block_size=16384, small_block_size=4096,
+        warm_on_mount=False,
+    ) as ev:
+        report = ev.verify_local_shards()
+    assert report is not None
+    assert report[7] is False
+    assert all(ok for s, ok in report.items() if s != 7)
+
+
+def test_rebuild_crc_gate_catches_corrupt_survivor(tmp_path):
+    """A silently-corrupt survivor (same length, flipped bytes) produces a
+    wrong rebuild; the streaming CRC check against the .eci record must
+    fail the rebuild AND unlink the partial outputs."""
+    base = _write_dat(tmp_path, 100_000)
+    stripe.write_ec_files(
+        base, large_block_size=16384, small_block_size=4096, encoder=ENC
+    )
+    os.unlink(stripe.shard_file_name(base, 13))
+    p = stripe.shard_file_name(base, 3)  # survivor used by the decode
+    with open(p, "r+b") as f:
+        f.seek(100)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(IOError, match="CRC mismatch"):
+        stripe.rebuild_ec_files(base, encoder=ENC)
+    assert not os.path.exists(stripe.shard_file_name(base, 13))
+
+
+# -- exception safety ---------------------------------------------------------
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+class _FailingEncoder(Encoder):
+    """Raises on the Nth device dispatch — models a mid-stream read/decode
+    failure with batches still inflight."""
+
+    def __init__(self, *a, fail_at=2, **kw):
+        super().__init__(*a, **kw)
+        self.calls = 0
+        self.fail_at = fail_at
+
+    def _maybe_boom(self):
+        self.calls += 1
+        if self.calls >= self.fail_at:
+            raise _Boom("mid-stream failure")
+
+    def encode_parity_lazy(self, data, donate=False):
+        self._maybe_boom()
+        return super().encode_parity_lazy(data, donate=donate)
+
+    def reconstruct_lazy(self, stack, survivors, wanted, donate=False):
+        self._maybe_boom()
+        return super().reconstruct_lazy(stack, survivors, wanted, donate=donate)
+
+
+def test_encode_failure_unlinks_partial_shards(tmp_path):
+    base = _write_dat(tmp_path, 655_360)
+    enc = _FailingEncoder(10, 4, backend="numpy", fail_at=2)
+    with pytest.raises(_Boom):
+        stripe.write_ec_files(
+            base, large_block_size=16384, small_block_size=4096,
+            buffer_size=4096, encoder=enc, max_batch_bytes=10 * 2 * 4096,
+        )
+    for s in range(TOTAL_SHARDS_COUNT):
+        assert not os.path.exists(stripe.shard_file_name(base, s)), f"shard {s} leaked"
+    assert not os.path.exists(base + ".eci")
+
+
+def test_rebuild_failure_unlinks_partials_keeps_survivors(tmp_path):
+    base = _write_dat(tmp_path, 655_360)
+    stripe.write_ec_files(
+        base, large_block_size=16384, small_block_size=4096, encoder=ENC
+    )
+    lost = [0, 13]
+    for s in lost:
+        os.unlink(stripe.shard_file_name(base, s))
+    enc = _FailingEncoder(10, 4, backend="numpy", fail_at=2)
+    with pytest.raises(_Boom):
+        stripe.rebuild_ec_files(
+            base, encoder=enc, buffer_size=8192, max_batch_bytes=10 * 2 * 8192
+        )
+    for s in lost:
+        assert not os.path.exists(stripe.shard_file_name(base, s)), f"partial {s} leaked"
+    for s in range(TOTAL_SHARDS_COUNT):
+        if s not in lost:
+            assert os.path.exists(stripe.shard_file_name(base, s)), f"survivor {s} gone"
+
+
+# -- decode-matrix cache boundedness (satellite: LRU cap) ---------------------
+
+
+def test_decode_matrix_cache_is_bounded():
+    import itertools
+
+    clear_decode_matrix_cache()
+    try:
+        # churn MORE distinct loss patterns than the cap (flapping peers /
+        # rolling repairs on a long-lived volume server): the memo must
+        # evict, never grow for the life of the process
+        info = decode_matrix_cache_info()
+        n_patterns = 0
+        for survivors in itertools.combinations(range(1, 14), 10):
+            for wanted in (w for w in range(14) if w not in survivors):
+                ENC.reconstruction_matrix(survivors, (wanted,))
+                n_patterns += 1
+            if n_patterns > info.maxsize + 50:
+                break
+        assert n_patterns > info.maxsize, "fixture must overflow the cap"
+        info = decode_matrix_cache_info()
+        assert info.currsize <= info.maxsize
+        assert info.maxsize >= 16
+    finally:
+        clear_decode_matrix_cache()
+
+
+def test_warm_decode_matrices_stays_bounded():
+    clear_decode_matrix_cache()
+    try:
+        built = ENC.warm_decode_matrices()
+        assert built == 14
+        info = decode_matrix_cache_info()
+        assert info.currsize <= info.maxsize
+    finally:
+        clear_decode_matrix_cache()
+
+
+# -- kernel_sweep --smoke CI gate ---------------------------------------------
+
+
+def test_kernel_sweep_smoke_gate():
+    """Kernel refactors must not silently break the sweep: the --smoke mode
+    runs every encode+rebuild variant byte-exactness gate on tiny shapes
+    under JAX_PLATFORMS=cpu and exits nonzero on any failure."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "kernel_sweep.py"), "--smoke"],
+        cwd=root,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout.decode(errors="replace")[-2000:]
+    summary = None
+    for line in proc.stdout.decode(errors="replace").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rec = json.loads(line)
+            if "smoke_ok" in rec:
+                summary = rec
+    assert summary and summary["smoke_ok"], summary
+    assert summary["variants"] >= 8
