@@ -13,7 +13,7 @@ mid-run one volume is EC-encoded so degraded reads join the mix.
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/chaos_soak.py [--seconds 300]
-Writes artifacts/SOAK_r05.json and exits nonzero on any lost byte.
+Writes artifacts/SOAK_r06.json and exits nonzero on any lost byte.
 """
 
 from __future__ import annotations
@@ -119,95 +119,153 @@ def main() -> int:
             client = MasterClient(master.address)
             deadline0 = time.monotonic() + 60
             while time.monotonic() < deadline0:
-            if len(master.topology.nodes) == 3:
-                break
-            time.sleep(0.5)
+                if len(master.topology.nodes) == 3:
+                    break
+                time.sleep(0.5)
             assert len(master.topology.nodes) == 3, "cluster did not form"
 
             blobs: dict[str, bytes] = {}  # fid -> expected bytes
 
             def write_one() -> None:
-            size = rng.randrange(200, 50_000)
-            payload = rng.getrandbits(8 * size).to_bytes(size, "little")
-            for attempt in range(10):
-                try:
-                    a = client.assign(replication="001")
-                    client.upload(a.fid, payload)
-                    blobs[a.fid] = payload
-                    report["writes"] += 1
-                    return
-                except Exception:
-                    time.sleep(0.5)
-            # silent drops would make ok:true vacuous under a collapsed
-            # cluster — every exhausted retry is on the record
-            report["write_failures"] += 1
+                size = rng.randrange(200, 50_000)
+                payload = rng.getrandbits(8 * size).to_bytes(size, "little")
+                for attempt in range(10):
+                    try:
+                        a = client.assign(replication="001")
+                        client.upload(a.fid, payload)
+                        blobs[a.fid] = payload
+                        report["writes"] += 1
+                        return
+                    except Exception:
+                        time.sleep(0.5)
+                # silent drops would make ok:true vacuous under a collapsed
+                # cluster — every exhausted retry is on the record
+                report["write_failures"] += 1
 
             def read_all(final: bool) -> None:
-            for fid, want in list(blobs.items()):
-                got = None
-                for attempt in range(12 if final else 3):
-                    try:
-                        got = client.read(fid)
-                        break
-                    except Exception:
-                        report["read_failures_transient"] += 1
-                        time.sleep(1.0 if final else 0.3)
-                report["reads"] += 1
-                if got is not None and got != want:
-                    report["lost"].append({"fid": fid, "why": "BYTES DIFFER"})
-                    blobs.pop(fid, None)  # record a corruption ONCE
-                elif final and got is None:
-                    report["lost"].append({"fid": fid, "why": "unreadable at end"})
+                for fid, want in list(blobs.items()):
+                    got = None
+                    for attempt in range(12 if final else 3):
+                        try:
+                            got = client.read(fid)
+                            break
+                        except Exception:
+                            report["read_failures_transient"] += 1
+                            time.sleep(1.0 if final else 0.3)
+                    report["reads"] += 1
+                    if got is not None and got != want:
+                        report["lost"].append({"fid": fid, "why": "BYTES DIFFER"})
+                        blobs.pop(fid, None)  # record a corruption ONCE
+                    elif final and got is None:
+                        report["lost"].append({"fid": fid, "why": "unreadable at end"})
 
             for _ in range(30):
-            write_one()
+                write_one()
 
             # EC-encode the first volume mid-soak so degraded reads join in
             def try_ec_encode() -> None:
-            vids = sorted({int(f.split(",")[0]) for f in blobs})
-            if not vids:
-                return
-            vid = vids[0]
-            for n in nodes:
-                if not n.alive:
-                    continue
-                try:
-                    with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
-                        c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
-                        c.call(
-                            VOLUME_SERVICE, "VolumeEcShardsGenerate",
-                            {"volume_id": vid}, timeout=120,
-                        )
-                        # mount FIRST, delete LAST (the shell's ec.encode
-                        # order): the data must be served from somewhere at
-                        # every instant
-                        c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
-                        c.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
-                    report["ec_encoded_vid"] = vid
+                vids = sorted({int(f.split(",")[0]) for f in blobs})
+                if not vids:
                     return
-                except Exception:  # noqa: BLE001 — not the owner: next node
-                    continue
+                vid = vids[0]
+                for n in nodes:
+                    if not n.alive:
+                        continue
+                    try:
+                        with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                            c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                                {"volume_id": vid}, timeout=120,
+                            )
+                            # mount FIRST, delete LAST (the shell's ec.encode
+                            # order): the data must be served from somewhere at
+                            # every instant
+                            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+                            c.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
+                        report["ec_encoded_vid"] = vid
+                        return
+                    except Exception:  # noqa: BLE001 — not the owner: next node
+                        continue
 
             try_ec_encode()
 
+            def try_remote_rebuild() -> None:
+                """Remote-rebuild scenario: drop one EC shard ON the holder,
+                then ask a DIFFERENT node to regenerate it via the
+                distributed (remote:true) rebuild — survivors stream over
+                VolumeEcShardSlabRead while peers are being killed around
+                it. Success = the rebuilt shard mounts on the target and
+                reads keep verifying."""
+                vid = report.get("ec_encoded_vid")
+                if vid is None:
+                    return
+                holder, target = None, None
+                for n in nodes:
+                    if not n.alive:
+                        continue
+                    try:
+                        with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                            st = c.call(VOLUME_SERVICE, "VolumeStatus", {"volume_id": vid})
+                        if st.get("kind") == "ec" and st.get("shard_ids"):
+                            holder = n
+                        else:
+                            target = target or n
+                    except Exception:  # noqa: BLE001 — node has no view of vid
+                        target = target or n
+                if holder is None or target is None:
+                    return
+                try:
+                    # lose one shard on the holder (unmount+delete just it)
+                    with _rpc.RpcClient(f"127.0.0.1:{holder.grpc}") as c:
+                        c.call(
+                            VOLUME_SERVICE, "VolumeEcShardsDelete",
+                            {"volume_id": vid, "shard_ids": [13]},
+                        )
+                    with _rpc.RpcClient(f"127.0.0.1:{target.grpc}") as c:
+                        resp = c.call(
+                            VOLUME_SERVICE, "VolumeEcShardsRebuild",
+                            {"volume_id": vid, "remote": True}, timeout=300,
+                        )
+                        rebuilt = resp.get("rebuilt_shard_ids", [])
+                        if rebuilt:
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsMount",
+                                {"volume_id": vid, "shard_ids": rebuilt},
+                            )
+                    report["remote_rebuild"] = {
+                        "vid": vid,
+                        "rebuilt": rebuilt,
+                        "target": target.i,
+                        "failed_over": resp.get("failed_over", []),
+                    }
+                except Exception as e:  # noqa: BLE001 — recorded, not fatal:
+                    # the kill loop may have taken the holder down; reads
+                    # below still verify zero loss either way
+                    report["remote_rebuild"] = {"vid": vid, "error": str(e)[:200]}
+
             t_end = time.monotonic() + seconds
+            rebuild_tried = False
             while time.monotonic() < t_end:
-            victim = rng.choice(nodes)
-            if victim.alive and sum(n.alive for n in nodes) > 1:
-                victim.kill(hard=rng.random() < 0.5)
-                report["kills"] += 1
-            for _ in range(rng.randrange(2, 6)):
-                write_one()
-            read_all(final=False)
-            time.sleep(rng.uniform(1.0, 3.0))
-            if not victim.alive:
-                victim.start()
-                time.sleep(2.0)
+                victim = rng.choice(nodes)
+                if victim.alive and sum(n.alive for n in nodes) > 1:
+                    victim.kill(hard=rng.random() < 0.5)
+                    report["kills"] += 1
+                for _ in range(rng.randrange(2, 6)):
+                    write_one()
+                read_all(final=False)
+                if not rebuild_tried and report.get("ec_encoded_vid") is not None:
+                    rebuild_tried = True
+                    try_remote_rebuild()
+                time.sleep(rng.uniform(1.0, 3.0))
+                if not victim.alive:
+                    victim.start()
+                    time.sleep(2.0)
 
             # every node back up; the final pass demands every byte
             for n in nodes:
-            if not n.alive:
-                n.start()
+                if not n.alive:
+                    n.start()
             time.sleep(8.0)
             read_all(final=True)
 
@@ -226,7 +284,7 @@ def main() -> int:
     report["files"] = len(blobs)
     report["ok"] = not report["lost"]
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, "SOAK_r05.json"), "w", encoding="utf-8") as f:
+    with open(os.path.join(ART, "SOAK_r06.json"), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["ok"] else 1

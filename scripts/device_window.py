@@ -15,6 +15,11 @@ tunnel, the r4 lesson):
      tunnel-bound (BASELINE.md's protocol wants both numbers; on real
      hardware host<->device is PCIe/ICI, not a tunnel)
      -> artifacts/E2E_DEVICE_r05.json
+  4. remote-survivor distributed rebuild (bench.py's ec_rebuild_remote
+     harness: two in-process volume servers, survivors streamed over
+     VolumeEcShardSlabRead while the decode runs on-device) — the
+     network-overlapped half of the >=10x rebuild target
+     -> artifacts/REMOTE_REBUILD_r07.json
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/device_window.py
 Writes artifacts/ as it goes; safe to re-run.
@@ -209,6 +214,32 @@ def main() -> int:
         log(f"e2e: {rec['e2e_gbps']} GB/s ({rec['e2e_seconds']}s for 128 MiB)")
     else:
         log("skipping e2e: budget")
+
+    # -- 4: remote-survivor distributed rebuild, decode on-device ------------
+    if left() > 240:
+        import tempfile
+
+        import bench as bench_mod
+        from seaweedfs_tpu.ops.rs_codec import Encoder as _Enc
+
+        try:
+            with tempfile.TemporaryDirectory() as td2:
+                rr = bench_mod._measure_rebuild_remote(
+                    td2, encoder=_Enc(10, 4, backend="jax")
+                )
+            with open(
+                os.path.join(ART, "REMOTE_REBUILD_r07.json"), "w", encoding="utf-8"
+            ) as f:
+                json.dump(rr, f, indent=1)
+            log(
+                f"remote rebuild: {rr.get('remote_rebuild_gbps')} GB/s remote, "
+                f"overlap_efficiency={rr.get('overlap_efficiency')}, "
+                f"ok={rr.get('ok')}"
+            )
+        except Exception as e:  # noqa: BLE001 — must not zero the harvest
+            log(f"remote rebuild stage failed: {e}")
+    else:
+        log("skipping remote rebuild: budget")
     log("window complete")
     return 0
 

@@ -33,7 +33,7 @@ import grpc
 from seaweedfs_tpu import rpc, stats
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.security import Guard
-from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NeedleDeleted, NeedleNotFound
 from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE, Heartbeat
 from seaweedfs_tpu.storage.file_id import FileId
@@ -45,6 +45,13 @@ from seaweedfs_tpu.security import tls
 _COPY_CHUNK = 1024 * 1024
 _EC_EXTS = [".ecx", ".ecj", ".eci"]
 EC_SHARD_READ_TIMEOUT = 10.0  # s; per-holder cap on one interval read
+# bulk slab streams (rebuild input): larger windows, so a longer per-call
+# deadline — but still bounded, so a hung holder fails over instead of
+# pinning a rebuild forever
+EC_SLAB_READ_TIMEOUT = 120.0
+_SLAB_CHUNK = 4 * 1024 * 1024  # bound on one CRC-framed slab-stream chunk
+#: parallel survivor-fetch threads for a distributed rebuild (RTT-bound)
+EC_REBUILD_FETCH_WORKERS = 16
 
 
 def _first_multipart_file(body: bytes, ctype: str):
@@ -141,6 +148,14 @@ class VolumeServer:
         self._peer_pool = rpc.ClientPool()
         self._shard_locs: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._shard_locs_lock = threading.Lock()
+        # single-flight dedup: vid -> Event set when an in-flight master
+        # lookup lands (or fails); concurrent misses wait on it instead of
+        # each paying their own LookupEcVolume round-trip
+        self._shard_locs_inflight: dict[int, threading.Event] = {}
+        # per-vid invalidation generation: a leader whose lookup was in
+        # flight when an invalidation landed must not write its (possibly
+        # pre-invalidation) result into the cache
+        self._shard_locs_gen: dict[int, int] = {}
         self.ec_lookup_ttl = ec_lookup_ttl
 
     # -- lifecycle -----------------------------------------------------------
@@ -278,29 +293,60 @@ class VolumeServer:
         """shard_id -> [grpc addresses], via the per-vid cache with expiry.
         The reference caches ShardLocations on the EcVolume and refreshes on
         an interval; an expired or missing entry pays one master round-trip,
-        every other interval read within the TTL is lookup-free."""
-        now = time.monotonic()
-        with self._shard_locs_lock:
-            hit = self._shard_locs.get(vid)
-            if hit is not None and hit[0] > now:
-                return hit[1]
-        resp = self._master_query("LookupEcVolume", {"volume_id": vid})
-        locs: dict[int, list[str]] = {}
-        for entry in resp.get("shard_id_locations", []):
-            addrs = [
-                f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
-                for locd in entry["locations"]
-                if locd["url"] != self.url  # we are not a remote for ourselves
-            ]
-            if addrs:
-                locs[int(entry["shard_id"])] = addrs
-        with self._shard_locs_lock:
-            self._shard_locs[vid] = (now + self.ec_lookup_ttl, locs)
-        return locs
+        every other interval read within the TTL is lookup-free.
+
+        Misses are SINGLE-FLIGHT: a burst of degraded reads against an
+        uncached vid (cold start, post-invalidation) elects one leader to
+        do the master round-trip; the rest wait on its Event and read the
+        fresh cache. A failed leader wakes the waiters with the cache still
+        cold — each retries the loop and the next one through becomes
+        leader, so failures propagate per caller without a thundering herd
+        on the healthy path."""
+        while True:
+            now = time.monotonic()
+            with self._shard_locs_lock:
+                hit = self._shard_locs.get(vid)
+                if hit is not None and hit[0] > now:
+                    return hit[1]
+                ev = self._shard_locs_inflight.get(vid)
+                if ev is None:
+                    ev = self._shard_locs_inflight[vid] = threading.Event()
+                    leader = True
+                    gen0 = self._shard_locs_gen.get(vid, 0)
+                else:
+                    leader = False
+            if not leader:
+                ev.wait(timeout=30.0)
+                continue  # re-check the cache; become leader if still cold
+            try:
+                resp = self._master_query("LookupEcVolume", {"volume_id": vid})
+                locs: dict[int, list[str]] = {}
+                for entry in resp.get("shard_id_locations", []):
+                    addrs = [
+                        f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
+                        for locd in entry["locations"]
+                        if locd["url"] != self.url  # not a remote for ourselves
+                    ]
+                    if addrs:
+                        locs[int(entry["shard_id"])] = addrs
+                with self._shard_locs_lock:
+                    # an invalidation that landed mid-lookup means this
+                    # answer may predate it: serve it to OUR callers (they
+                    # asked before the invalidation) but leave the cache
+                    # cold so the invalidator's own lookup goes to the
+                    # master fresh
+                    if self._shard_locs_gen.get(vid, 0) == gen0:
+                        self._shard_locs[vid] = (now + self.ec_lookup_ttl, locs)
+                return locs
+            finally:
+                with self._shard_locs_lock:
+                    self._shard_locs_inflight.pop(vid, None)
+                ev.set()
 
     def _invalidate_shard_locations(self, vid: int) -> None:
         with self._shard_locs_lock:
             self._shard_locs.pop(vid, None)
+            self._shard_locs_gen[vid] = self._shard_locs_gen.get(vid, 0) + 1
 
     def _remote_reader_for(self, vid: int):
         """RemoteReader closure for EC degraded reads: cached master
@@ -373,6 +419,7 @@ class VolumeServer:
         add("VolumeEcShardsMount", self._rpc_ec_mount)
         add("VolumeEcShardsUnmount", self._rpc_ec_unmount)
         add("VolumeEcShardRead", self._rpc_ec_shard_read, kind="unary_stream", resp_format="bytes")
+        add("VolumeEcShardSlabRead", self._rpc_ec_slab_read, kind="unary_stream", resp_format="bytes")
         add("VolumeEcShardFileCopy", self._rpc_ec_file_copy, kind="unary_stream", resp_format="bytes")
         add("VolumeEcBlobDelete", self._rpc_ec_blob_delete)
         add("VolumeEcShardsToVolume", self._rpc_ec_to_volume)
@@ -569,11 +616,23 @@ class VolumeServer:
             }
         ev = self.store.get_ec_volume(vid)
         if ev is not None:
+            per_shard: dict[str, int] = {}
+            for s in ev.shard_ids:
+                try:
+                    per_shard[str(s)] = os.path.getsize(
+                        stripe.shard_file_name(ev.base, s)
+                    )
+                except OSError:  # racing unmount/delete: omit, don't fault
+                    continue
             return {
                 "volume_id": vid,
                 "kind": "ec",
                 "shard_ids": ev.shard_ids,
                 "shard_size": ev.shard_size,
+                # per-shard, not the max: a remote rebuilder's geometry
+                # preflight must see a truncated shard hiding behind a
+                # healthy sibling on the same holder
+                "shard_file_sizes": per_shard,
             }
         raise rpc.NotFoundFault(f"volume {vid} not found")
 
@@ -776,11 +835,264 @@ class VolumeServer:
                 lock.release()
 
     def _rpc_ec_rebuild(self, req: dict, ctx) -> dict:
-        """VolumeEcShardsRebuild: reconstruct missing shards from >=10 local."""
+        """VolumeEcShardsRebuild: reconstruct missing shards.
+
+        Default mode reads >=10 LOCAL survivors (the pre-distributed shape:
+        the shell first copies every survivor here). With `remote: true`
+        this node becomes the rebuild target without any bulk pre-copy:
+        survivors it lacks stream in over VolumeEcShardSlabRead while the
+        decode runs — the network-overlapped distributed path."""
         vid = int(req["volume_id"])
-        base = self._base_path_for(vid, req.get("collection", ""))
-        rebuilt = stripe.rebuild_ec_files(base, encoder=self.store.encoder)
-        return {"rebuilt_shard_ids": rebuilt}
+        collection = req.get("collection", "")
+        base = self._base_path_for(vid, collection)
+        t0 = time.monotonic()
+        if not req.get("remote"):
+            rebuilt = stripe.rebuild_ec_files(base, encoder=self.store.encoder)
+            stats.EcRebuildSeconds.observe(time.monotonic() - t0)
+            return {"rebuilt_shard_ids": rebuilt}
+        resp = self._ec_rebuild_remote(vid, collection, base, req)
+        stats.EcRebuildSeconds.observe(time.monotonic() - t0)
+        return resp
+
+    def _ec_rebuild_remote(
+        self, vid: int, collection: str, base: str, req: dict
+    ) -> dict:
+        """Distributed rebuild: fetch survivors from peer holders through
+        the triple-overlap pipeline (network prefetch / staging fill /
+        device decode) and regenerate the missing `.ecNN` files locally,
+        CRC-verified against the .eci record. Holder failover happens
+        inside each RemoteSlabSource mid-rebuild; this method only decides
+        WHO is a survivor and wires the transports."""
+        with self.maintenance_lock(vid):
+            # a rebuild wants the freshest holder map, not a TTL-stale one:
+            # routing a GB-scale fetch at a node that dropped its shards
+            # costs a failover round per batch window
+            self._invalidate_shard_locations(vid)
+            locs = self._lookup_shard_locations(vid)
+            local = set(stripe.find_local_shards(base))
+            present = sorted(local | set(locs))
+            missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+            if not missing:
+                return {"rebuilt_shard_ids": []}
+            if len(present) < DATA_SHARDS_COUNT:
+                raise rpc.RpcFault(
+                    f"cannot rebuild volume {vid}: only {len(present)} survivors "
+                    f"reachable, need {DATA_SHARDS_COUNT}",
+                    code=grpc.StatusCode.FAILED_PRECONDITION,
+                )
+            holders = sorted({a for addrs in locs.values() for a in addrs})
+            self._ensure_ec_index_files(vid, collection, base, holders)
+            shard_size = self._resolve_shard_size(vid, base, local, holders)
+            # fetch workers are RTT/IO-bound (they sleep on peer streams),
+            # so size the pool above the survivor count: with prefetch
+            # running `prefetch_batches` windows ahead, a tight pool would
+            # serialize the very round-trips the pipeline exists to hide
+            executor = futures.ThreadPoolExecutor(
+                max_workers=EC_REBUILD_FETCH_WORKERS,
+                thread_name_prefix=f"ec-rebuild-{vid}",
+            )
+            sources: dict[int, stripe.SlabSource] = {}
+            try:
+                for s in present:
+                    if s in local:
+                        sources[s] = stripe.LocalSlabSource(
+                            stripe.shard_file_name(base, s)
+                        )
+                sources.update(
+                    self._remote_slab_sources(
+                        vid, [s for s in present if s not in local], executor
+                    )
+                )
+                tuning = {}
+                if int(req.get("buffer_size") or 0) > 0:
+                    tuning["buffer_size"] = int(req["buffer_size"])
+                if int(req.get("max_batch_bytes") or 0) > 0:
+                    tuning["max_batch_bytes"] = int(req["max_batch_bytes"])
+                if int(req.get("prefetch_batches") or 0) > 0:
+                    tuning["prefetch_batches"] = int(req["prefetch_batches"])
+                rebuilt = stripe.rebuild_ec_files_from_sources(
+                    base,
+                    sources,
+                    shard_size,
+                    encoder=self.store.encoder,
+                    missing=missing,
+                    **tuning,
+                )
+            finally:
+                for src in sources.values():
+                    src.close()
+                executor.shutdown(wait=False, cancel_futures=True)
+            stats.EcRebuildRemoteBytes.inc(
+                shard_size * sum(1 for s in present[:DATA_SHARDS_COUNT] if s not in local)
+            )
+            failed_over = [
+                f"{src.shard_id}:{addr}"
+                for src in sources.values()
+                if isinstance(src, stripe.RemoteSlabSource)
+                for addr in src.failovers
+            ]
+            return {
+                "rebuilt_shard_ids": rebuilt,
+                "local_survivors": sorted(local & set(present[:DATA_SHARDS_COUNT])),
+                "remote_survivors": [
+                    s for s in present[:DATA_SHARDS_COUNT] if s not in local
+                ],
+                "failed_over": failed_over,
+            }
+
+    def _ensure_ec_index_files(
+        self, vid: int, collection: str, base: str, holders: list[str]
+    ) -> None:
+        """A rebuild target that never held this volume lacks .ecx/.ecj/.eci;
+        pull them from any holder so the regenerated shards are mountable
+        and CRC-verifiable. .ecj/.eci are optional upstream, so only a
+        missing .ecx is fatal."""
+        needed = [ext for ext in _EC_EXTS if not os.path.exists(base + ext)]
+        if not needed:
+            return
+        errs: list[str] = []
+        for ext in needed:
+            done = False
+            for addr in holders:
+                try:
+                    chunks = self._peer_pool.get(addr).stream(
+                        VOLUME_SERVICE,
+                        "VolumeEcShardFileCopy",
+                        {"volume_id": vid, "collection": collection, "ext": ext},
+                    )
+                    tmp = base + ext + ".cpy"
+                    try:
+                        with open(tmp, "wb") as f:
+                            for chunk in chunks:
+                                f.write(chunk)
+                        os.replace(tmp, base + ext)
+                    finally:
+                        if os.path.exists(tmp):
+                            os.remove(tmp)
+                    done = True
+                    break
+                except Exception as e:  # noqa: BLE001 — try the next holder
+                    errs.append(f"{addr}{ext}: {e}")
+            if not done and ext == ".ecx":
+                raise rpc.RpcFault(
+                    f"volume {vid}: no holder could supply .ecx: {'; '.join(errs)[:400]}"
+                )
+
+    def _resolve_shard_size(
+        self, vid: int, base: str, local: set[int], holders: list[str]
+    ) -> int:
+        """Uniform shard length from local survivors and holder
+        VolumeStatus reports — and the remote mirror of the local path's
+        survivors-agree-on-length preflight: a truncated survivor would
+        otherwise zero-fill past its EOF exactly like a legitimate tail
+        and decode into silently-wrong shards (the .eci CRC gate only
+        fires after the whole volume has streamed, and only when CRCs
+        were recorded)."""
+        sizes: dict[str, int] = {}
+        for s in local:
+            sizes[f"local:.ec{s:02d}"] = os.path.getsize(
+                stripe.shard_file_name(base, s)
+            )
+        last: Exception | None = None
+        for addr in holders:
+            try:
+                st = self._peer_pool.get(addr).call(
+                    VOLUME_SERVICE, "VolumeStatus", {"volume_id": vid}, timeout=10
+                )
+                if st.get("kind") != "ec":
+                    continue
+                per_shard = st.get("shard_file_sizes") or {}
+                if per_shard:
+                    for k, v in per_shard.items():
+                        sizes[f"{addr}:.ec{int(k):02d}"] = int(v)
+                elif int(st.get("shard_size", 0)) > 0:
+                    # pre-per-shard peers: their max is the best we get
+                    sizes[addr] = int(st["shard_size"])
+            except Exception as e:  # noqa: BLE001 — a dead holder reports nothing
+                last = e
+        if not sizes:
+            raise rpc.RpcFault(
+                f"volume {vid}: could not learn shard size from any holder"
+                + (f" (last error: {last})" if last else "")
+            )
+        if len(set(sizes.values())) != 1:
+            raise rpc.RpcFault(
+                f"volume {vid}: survivors disagree on shard length: {sizes} "
+                "— truncated shard?",
+                code=grpc.StatusCode.FAILED_PRECONDITION,
+            )
+        return next(iter(sizes.values()))
+
+    def _remote_slab_sources(
+        self, vid: int, shard_ids: list[int], executor
+    ) -> dict[int, stripe.RemoteSlabSource]:
+        """RemoteSlabSource per shard, wired to the CRC-checked bulk slab
+        RPC over pooled peer channels, with holder refresh re-asking the
+        master after an invalidation."""
+        locs = self._lookup_shard_locations(vid)
+
+        def fetch_for(sid: int):
+            def fetch(addr: str, offset: int, size: int) -> bytes:
+                # NOTE: no _peer_pool.invalidate here — the pooled channel
+                # is shared by every shard's concurrent slab streams to
+                # this holder, and closing it over ONE stripe failure
+                # (timeout, CRC mismatch) would cancel the other nine
+                # mid-flight and cascade one transient error into a
+                # whole-holder failover for all sources. The source marks
+                # the holder dead for ITSELF; genuinely-broken channels
+                # are redialed by the degraded-read path's invalidation.
+                return self._fetch_slab(addr, vid, sid, offset, size)
+
+            return fetch
+
+        def refresh_for(sid: int):
+            def refresh():
+                self._invalidate_shard_locations(vid)
+                return self._lookup_shard_locations(vid).get(sid, ())
+
+            return refresh
+
+        return {
+            sid: stripe.RemoteSlabSource(
+                sid,
+                locs.get(sid, ()),
+                fetch_for(sid),
+                executor=executor,
+                refresh_holders=refresh_for(sid),
+                fetch_deadline=EC_SLAB_READ_TIMEOUT,
+            )
+            for sid in shard_ids
+            if locs.get(sid)
+        }
+
+    def _fetch_slab(
+        self, addr: str, vid: int, shard_id: int, offset: int, size: int
+    ) -> bytes:
+        """One bulk range via VolumeEcShardSlabRead: CRC-verified chunks,
+        short return on EOF (the caller zero-fills, like a local read)."""
+        frames = self._peer_pool.get(addr).stream(
+            VOLUME_SERVICE,
+            "VolumeEcShardSlabRead",
+            {
+                "volume_id": vid,
+                "shard_id": shard_id,
+                "offset": offset,
+                "size": size,
+            },
+            timeout=EC_SLAB_READ_TIMEOUT,
+        )
+        parts: list[bytes] = []
+        got = 0
+        for frame in frames:
+            chunk = rpc.crc_unframe(frame)
+            got += len(chunk)
+            if got > size:
+                raise IOError(
+                    f"shard {shard_id}@{addr}: slab stream over-answered "
+                    f"({got} > {size})"
+                )
+            parts.append(chunk)
+        return b"".join(parts)
 
     def _rpc_ec_mount(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])
@@ -825,6 +1137,40 @@ class VolumeServer:
             yield buf.tobytes()
             pos += n
             remaining -= n
+
+    def _rpc_ec_slab_read(self, req: dict, ctx):
+        """Bulk slab stream for the distributed rebuild pipeline — the big
+        sibling of VolumeEcShardRead: large windows, bounded chunk size,
+        a CRC32 on every chunk (rebuild input must not trust bare TCP),
+        and a PRIVATE file handle so a long stream never seek-races the
+        serving handles interval reads use. EOF ends the stream short;
+        the client zero-fills, mirroring local read_padded_into."""
+        delay_ms = os.environ.get("WEEDTPU_BENCH_RPC_DELAY_MS", "")
+        if delay_ms:
+            # bench-only RTT model, same rationale as VolumeEcShardRead:
+            # one sleep per bulk window (the per-request latency a real
+            # network charges), GIL-released so client-side overlap shows
+            time.sleep(float(delay_ms) / 1e3)
+        vid = int(req["volume_id"])
+        shard_id = int(req["shard_id"])
+        offset = int(req["offset"])
+        size = int(req["size"])
+        chunk_size = min(max(64 * 1024, int(req.get("chunk_size") or _SLAB_CHUNK)), 8 << 20)
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            raise rpc.NotFoundFault(f"ec volume {vid} not mounted")
+        if shard_id not in ev._shard_files:
+            raise rpc.NotFoundFault(f"shard {shard_id} of volume {vid} not local")
+        path = stripe.shard_file_name(ev.base, shard_id)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            remaining = size
+            while remaining > 0:
+                buf = f.read(min(chunk_size, remaining))
+                if not buf:
+                    break  # EOF: short stream, client zero-fills
+                yield rpc.crc_frame(buf)
+                remaining -= len(buf)
 
     def _rpc_ec_blob_delete(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])

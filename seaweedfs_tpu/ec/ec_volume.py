@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Optional
@@ -253,8 +254,6 @@ class EcVolume:
     def _recover_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         """recoverOneRemoteEcShardInterval: read the same interval from every
         other shard and reconstruct the wanted one."""
-        import time as _time
-
         t0 = _time.monotonic()
         try:
             return self._recover_interval_inner(shard_id, offset, size)
@@ -312,26 +311,33 @@ class EcVolume:
                 for s in candidates
             }
             pending = set(futs)
-            import time as _time
-
             deadline = _time.monotonic() + self.recover_fetch_deadline
-            while pending and have < DATA_SHARDS_COUNT:
-                budget = deadline - _time.monotonic()
-                if budget <= 0:
-                    break
-                done, pending = wait(pending, timeout=budget, return_when=FIRST_COMPLETED)
-                if not done:
-                    break
-                for fut in done:
-                    try:
-                        raw = fut.result()
-                    except Exception:  # noqa: BLE001 — a failed peer is a miss
-                        raw = None
-                    if raw is not None and len(raw) == size:
-                        shards[futs[fut]] = np.frombuffer(raw, dtype=np.uint8).copy()
-                        have += 1
-            for fut in pending:
-                fut.cancel()
+            try:
+                while pending and have < DATA_SHARDS_COUNT:
+                    budget = deadline - _time.monotonic()
+                    if budget <= 0:
+                        break
+                    done, pending = wait(
+                        pending, timeout=budget, return_when=FIRST_COMPLETED
+                    )
+                    if not done:
+                        break
+                    for fut in done:
+                        try:
+                            raw = fut.result()
+                        except Exception:  # noqa: BLE001 — a failed peer is a miss
+                            raw = None
+                        if raw is not None and len(raw) == size:
+                            shards[futs[fut]] = np.frombuffer(raw, dtype=np.uint8).copy()
+                            have += 1
+            finally:
+                # EVERY exit (normal, deadline, or an exception raised
+                # mid-loop) cancels what never started and drains what did:
+                # the discard callback drops a late result/exception on the
+                # floor so a hung peer's thread never outlives the read with
+                # a reference to its buffer (or an unobserved error)
+                for fut in pending:
+                    stripe._abandon_future(fut)
         if have < DATA_SHARDS_COUNT:
             raise IOError(
                 f"shard {shard_id}: only {have} surviving shards reachable, need {DATA_SHARDS_COUNT}"
@@ -352,8 +358,6 @@ class EcVolume:
         if len(items) == 1:
             off, size = items[0]
             return [self._recover_interval(shard_id, off, size)]
-        import time as _time
-
         t0 = _time.monotonic()
         try:
             gathered = [

@@ -26,10 +26,12 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import zlib
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from contextlib import ExitStack
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -52,6 +54,20 @@ from seaweedfs_tpu.storage.needle_map import MemDb
 #: Deeper pipelines hide longer device/tunnel latencies at the cost of
 #: (depth+1) staging buffers of `max_batch_bytes` each.
 DEFAULT_PIPELINE_DEPTH = max(1, int(os.environ.get("WEEDTPU_PIPELINE_DEPTH", "2")))
+
+#: how many batches AHEAD of the reading cursor the rebuild pipeline keeps
+#: network-prefetched on remote slab sources (the third overlap stage: the
+#: network fetches batch k+N while local readinto consumes batch k+1 and
+#: the device decodes batch k). Defaults to the pipeline depth.
+DEFAULT_PREFETCH_BATCHES = max(
+    1, int(os.environ.get("WEEDTPU_REBUILD_PREFETCH_BATCHES", "2"))
+)
+
+#: sub-range size for striped parallel range-fetches within one remote slab
+#: window: a `max_batch_bytes`-sized window is split into stripes fetched
+#: concurrently so one window's latency is holder-RTT + transfer/parallelism,
+#: not a single serial stream.
+DEFAULT_SLAB_STRIPE_BYTES = 4 * 1024 * 1024
 
 
 def to_ext(shard_id: int) -> str:
@@ -99,6 +115,21 @@ class _StagingRing:
         buf = self._bufs[self._next]
         self._next = (self._next + 1) % len(self._bufs)
         return buf
+
+
+def _abandon_future(fut) -> None:
+    """Cancel an abandoned fetch future; if it is already running, attach a
+    callback that observes (and drops) its outcome so late errors never
+    surface as unretrieved-exception noise from a thread nobody waits on."""
+    if not fut.cancel():
+        fut.add_done_callback(_observe_and_drop)
+
+
+def _observe_and_drop(fut) -> None:
+    try:
+        fut.result()
+    except Exception:  # noqa: BLE001 — abandoned by design
+        pass
 
 
 def _discard_inflight(inflight: deque) -> None:
@@ -370,6 +401,291 @@ def _check_rebuild_geometry(base_file_name: str) -> tuple[list[int], list[int], 
     return present, missing, sizes[present[0]]
 
 
+# -- slab sources: where the rebuild pipeline's survivor bytes come from -----
+
+
+class SlabSource:
+    """One survivor shard's slab supplier for the rebuild pipeline.
+
+    The pipeline calls `prefetch(offset, length)` for windows it will want
+    soon (a hint — sources may start the work asynchronously) and
+    `read_into(offset, out)` when the bytes must land in a staging view.
+    Reads past the shard's end zero-fill, exactly like `read_padded_into`,
+    so every backend is byte-interchangeable under the decode."""
+
+    def prefetch(self, offset: int, length: int) -> None:  # noqa: B027 — hint
+        pass
+
+    def read_into(self, offset: int, out: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:  # noqa: B027 — optional teardown
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LocalSlabSource(SlabSource):
+    """Today's path: `readinto` straight from a local shard file."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+
+    def read_into(self, offset: int, out: np.ndarray) -> None:
+        read_padded_into(self._f, offset, out)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class RemoteSlabSource(SlabSource):
+    """Striped parallel range-fetches of one shard from its peer holders.
+
+    `fetch(addr, offset, size) -> bytes` is the transport (injected by the
+    cluster layer: the chunk-streamed, CRC-checked VolumeEcShardSlabRead
+    RPC); it may return SHORT on EOF and must raise on any failure. A
+    prefetched window is split into `stripe_bytes` sub-ranges submitted to
+    the executor so the window's wall time is ~one holder round-trip, not a
+    serial stream.
+
+    Failover is per-holder and mid-rebuild: a failed fetch marks the
+    holder dead and retries the range against the next holder (after a
+    one-shot `refresh_holders()` re-lookup when all known holders are
+    dead) WITHOUT disturbing other inflight ranges — the batch pipeline
+    never restarts. Dead holders are recorded in `self.failovers` for
+    observability. Raises IOError when no holder can serve a range."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        holders: Sequence[str],
+        fetch: Callable[[str, int, int], bytes],
+        executor: Optional[ThreadPoolExecutor] = None,
+        stripe_bytes: int = DEFAULT_SLAB_STRIPE_BYTES,
+        refresh_holders: Optional[Callable[[], Sequence[str]]] = None,
+        fetch_deadline: float = 120.0,
+    ):
+        self.shard_id = shard_id
+        self.failovers: list[str] = []
+        self._holders = [str(h) for h in holders]
+        self._dead: set[str] = set()
+        self._fetch = fetch
+        self._refresh = refresh_holders
+        # bounded, not one-shot: a transient error may kill the only known
+        # holder more than once over a GB-scale rebuild; each refresh
+        # resurrects re-listed holders, while the bound still guarantees
+        # termination against a genuinely dead cluster
+        self._refreshes_left = 2
+        self._stripe = max(64 * 1024, int(stripe_bytes))
+        self._deadline = fetch_deadline
+        self._lock = threading.Lock()
+        self._own_executor = executor is None
+        self._ex = executor or ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"slab-fetch-{shard_id}"
+        )
+        #: offset -> (length, [(rel_offset, size, Future[bytes]), ...])
+        self._pending: dict[int, tuple[int, list]] = {}
+
+    def _live_holders(self) -> list[str]:
+        with self._lock:
+            live = [h for h in self._holders if h not in self._dead]
+            if live or self._refresh is None or self._refreshes_left <= 0:
+                return live
+            self._refreshes_left -= 1
+        try:
+            fresh = list(self._refresh() or ())
+        except Exception:  # noqa: BLE001 — a dead master is "no holders"
+            fresh = []
+        with self._lock:
+            for h in fresh:
+                if h not in self._holders:
+                    self._holders.append(str(h))
+                self._dead.discard(str(h))
+            return [h for h in self._holders if h not in self._dead]
+
+    def _fetch_range(self, offset: int, size: int) -> bytes:
+        while True:
+            live = self._live_holders()
+            if not live:
+                raise IOError(
+                    f"shard {self.shard_id}: no reachable holder for "
+                    f"[{offset}, {offset + size}) — tried {self._holders}"
+                )
+            # rotate the starting holder per stripe so replicated shard
+            # placements split the slab traffic across their holders
+            # instead of hammering the first-sorted one; failover still
+            # walks the remaining live set
+            addr = live[(offset // self._stripe) % len(live)]
+            try:
+                data = self._fetch(addr, offset, size)
+            except Exception:  # noqa: BLE001 — holder down: fail over
+                with self._lock:
+                    if addr not in self._dead:
+                        self._dead.add(addr)
+                        self.failovers.append(addr)
+                continue
+            if len(data) > size:
+                raise IOError(
+                    f"shard {self.shard_id}: holder {addr} over-answered "
+                    f"({len(data)} > {size} bytes)"
+                )
+            return data
+
+    def prefetch(self, offset: int, length: int) -> None:
+        if length <= 0 or offset in self._pending:
+            return
+        futs = []
+        for off in range(offset, offset + length, self._stripe):
+            n = min(self._stripe, offset + length - off)
+            futs.append((off - offset, n, self._ex.submit(self._fetch_range, off, n)))
+        self._pending[offset] = (length, futs)
+
+    def read_into(self, offset: int, out: np.ndarray) -> None:
+        entry = self._pending.pop(offset, None)
+        if entry is not None and entry[0] != out.size:
+            for _, _, fut in entry[1]:  # stale window shape: refetch
+                _abandon_future(fut)
+            entry = None
+        if entry is None:
+            self.prefetch(offset, out.size)
+            entry = self._pending.pop(offset)
+        _, futs = entry
+        # the wait must outlive failover: a holder that HANGS (no error
+        # until the transport deadline) burns one full fetch_deadline
+        # before the worker retries the next holder, so budget one
+        # deadline per holder we could try, plus one for the refresh
+        with self._lock:
+            wait_budget = self._deadline * (len(self._holders) + 1)
+        try:
+            for rel, n, fut in futs:
+                data = fut.result(timeout=wait_budget)
+                got = len(data)
+                if got:
+                    out[rel : rel + got] = np.frombuffer(data, dtype=np.uint8)
+                if got < n:  # EOF inside the window: zero-fill, like local
+                    out[rel + got : rel + n] = 0
+        except BaseException:
+            for _, _, fut in futs:
+                _abandon_future(fut)
+            raise
+
+    def close(self) -> None:
+        for _, futs in self._pending.values():
+            for _, _, fut in futs:
+                _abandon_future(fut)
+        self._pending.clear()
+        if self._own_executor:
+            self._ex.shutdown(wait=False, cancel_futures=True)
+
+
+def rebuild_ec_files_from_sources(
+    base_file_name: str,
+    sources: dict[int, SlabSource],
+    shard_size: int,
+    encoder: Optional[Encoder] = None,
+    missing: Optional[Sequence[int]] = None,
+    buffer_size: int = 4 * 1024 * 1024,
+    max_batch_bytes: int = 64 * 1024 * 1024,
+    pipeline_depth: Optional[int] = None,
+    prefetch_batches: Optional[int] = None,
+) -> list[int]:
+    """The generalized (local OR remote survivor) rebuild pipeline.
+
+    `sources` maps present shard id -> SlabSource; `missing` defaults to
+    every shard id absent from it. Survivor selection is the first
+    DATA_SHARDS of the sorted present ids — the same rule as
+    `rebuild_ec_files_serial` on the same survivor set, so output bytes are
+    identical regardless of where survivors live. Triple overlap: remote
+    sources are told to prefetch batch k+`prefetch_batches` (network) while
+    batch k+1 fills staging (disk / prefetched-buffer copy) and batch k
+    decodes on-device through the same depth-N inflight deque as the local
+    path. Rebuilt shards stream to `<base>.ecNN` with CRC32 folded in and
+    verified against the .eci record when present; any failure drains
+    inflight device work and unlinks the partial outputs."""
+    enc = encoder or new_encoder()
+    present = sorted(sources)
+    if missing is None:
+        missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in sources]
+    missing = sorted(missing)
+    if not missing:
+        return []
+    if len(present) < DATA_SHARDS_COUNT:
+        raise ValueError(
+            f"cannot rebuild: only {len(present)} shards present, need {DATA_SHARDS_COUNT}"
+        )
+    depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
+    ahead = (
+        DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
+    )
+    survivors = present[:DATA_SHARDS_COUNT]
+    chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
+    span = chunks_per_batch * buffer_size
+    ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
+    crcs = {s: 0 for s in missing}
+    #: (offset, valid_bytes, staged_width) per batch, precomputed so the
+    #: prefetch cursor can run `ahead` batches past the read cursor
+    batches = []
+    off = 0
+    while off < shard_size:
+        valid = min(span, shard_size - off)
+        batches.append((off, valid, -(-valid // buffer_size) * buffer_size))
+        off += span
+    try:
+        with ExitStack() as stack:
+            outs = {
+                s: stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
+                for s in missing
+            }
+            inflight: deque = deque()  # FIFO of (decoded_handle, valid_bytes)
+
+            def drain_one() -> None:
+                lazy, valid = inflight.popleft()
+                out = np.asarray(lazy)  # (len(missing), width) — sync point
+                for k, s in enumerate(missing):
+                    row = out[k, :valid]
+                    outs[s].write(row)
+                    crcs[s] = zlib.crc32(row, crcs[s])
+
+            def issue_prefetch(bi: int) -> None:
+                if bi < len(batches):
+                    o, _, wd = batches[bi]
+                    for s in survivors:
+                        sources[s].prefetch(o, wd)
+
+            try:
+                for j in range(min(ahead, len(batches))):
+                    issue_prefetch(j)
+                for bi, (off, valid, width) in enumerate(batches):
+                    issue_prefetch(bi + ahead)  # network runs ahead of reads
+                    while len(inflight) >= depth:
+                        drain_one()
+                    staging = ring.take()
+                    for i, s in enumerate(survivors):
+                        sources[s].read_into(off, staging[i, :width])
+                    decoded = enc.reconstruct_lazy(
+                        staging[:, :width], survivors, missing, donate=True
+                    )  # async
+                    inflight.append((decoded, valid))
+                while inflight:
+                    drain_one()
+            except BaseException:
+                _discard_inflight(inflight)
+                raise
+        _verify_rebuilt_crcs(base_file_name, crcs)
+    except BaseException:
+        for s in missing:
+            try:
+                os.unlink(shard_file_name(base_file_name, s))
+            except OSError:
+                pass
+        raise
+    return missing
+
+
 def rebuild_ec_files(
     base_file_name: str,
     encoder: Optional[Encoder] = None,
@@ -395,67 +711,24 @@ def rebuild_ec_files(
     and unlinks the partial rebuilt files instead of leaking them.
 
     Returns the rebuilt shard ids."""
-    enc = encoder or new_encoder()
     present, missing, shard_size = _check_rebuild_geometry(base_file_name)
     if not missing:
         return []
-    depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
-    # first DATA_SHARDS present ids, exactly like Encoder._pick_survivors —
-    # the serial path and this one must derive the SAME decode matrix
-    survivors = present[:DATA_SHARDS_COUNT]
-    chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
-    span = chunks_per_batch * buffer_size
-    ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
-    crcs = {s: 0 for s in missing}
-    try:
-        with ExitStack() as stack:
-            ins = {
-                s: stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
-                for s in survivors
-            }
-            outs = {
-                s: stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
-                for s in missing
-            }
-            inflight: deque = deque()  # FIFO of (decoded_handle, valid_bytes)
-
-            def drain_one() -> None:
-                lazy, valid = inflight.popleft()
-                out = np.asarray(lazy)  # (len(missing), width) — sync point
-                for k, s in enumerate(missing):
-                    # contiguous row slice writes via the buffer protocol;
-                    # the tail batch trims its zero-pad back off
-                    row = out[k, :valid]
-                    outs[s].write(row)
-                    crcs[s] = zlib.crc32(row, crcs[s])
-
-            try:
-                for off in range(0, shard_size, span):
-                    valid = min(span, shard_size - off)
-                    width = -(-valid // buffer_size) * buffer_size
-                    while len(inflight) >= depth:
-                        drain_one()
-                    staging = ring.take()
-                    for i, s in enumerate(survivors):
-                        read_padded_into(ins[s], off, staging[i, :width])
-                    decoded = enc.reconstruct_lazy(
-                        staging[:, :width], survivors, missing, donate=True
-                    )  # async
-                    inflight.append((decoded, valid))
-                while inflight:
-                    drain_one()
-            except BaseException:
-                _discard_inflight(inflight)
-                raise
-        _verify_rebuilt_crcs(base_file_name, crcs)
-    except BaseException:
-        for s in missing:
-            try:
-                os.unlink(shard_file_name(base_file_name, s))
-            except OSError:
-                pass
-        raise
-    return missing
+    with ExitStack() as stack:
+        sources = {
+            s: stack.enter_context(LocalSlabSource(shard_file_name(base_file_name, s)))
+            for s in present
+        }
+        return rebuild_ec_files_from_sources(
+            base_file_name,
+            sources,
+            shard_size,
+            encoder=encoder,
+            missing=missing,
+            buffer_size=buffer_size,
+            max_batch_bytes=max_batch_bytes,
+            pipeline_depth=pipeline_depth,
+        )
 
 
 def _verify_rebuilt_crcs(base_file_name: str, crcs: dict) -> None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import threading
+import zlib
 from concurrent import futures
 from typing import Any, Callable, Iterator, Optional
 
@@ -80,6 +81,29 @@ def _resolve_serdes(service: str, method: str, req_format: str, resp_format: str
                 if resp_format == "json":
                     resp_ser, resp_de = codec.response_serdes(service, method)
     return req_ser, req_de, resp_ser, resp_de
+
+
+def crc_frame(chunk: bytes) -> bytes:
+    """Frame one bulk-stream chunk as 4-byte big-endian CRC32 + payload.
+
+    The slab-read bulk stream (VolumeEcShardSlabRead) carries rebuild
+    input across the network: a flipped bit there would decode into a
+    silently-wrong shard on the rebuilder, so every chunk is integrity-
+    checked at the transport seam rather than trusting TCP checksums
+    across proxies/retries."""
+    return zlib.crc32(chunk).to_bytes(4, "big") + chunk
+
+
+def crc_unframe(frame: bytes) -> bytes:
+    """Inverse of crc_frame; raises IOError on checksum mismatch."""
+    if len(frame) < 4:
+        raise IOError(f"short CRC frame: {len(frame)} bytes")
+    want = int.from_bytes(frame[:4], "big")
+    chunk = frame[4:]
+    got = zlib.crc32(chunk)
+    if got != want:
+        raise IOError(f"bulk-stream chunk CRC mismatch: got {got:08x}, want {want:08x}")
+    return chunk
 
 
 class RpcFault(Exception):

@@ -399,7 +399,7 @@ def _ec_collections(env: CommandEnv) -> dict[int, str]:
 
 
 def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    fl = parse_flags(args, collection="")
+    fl = parse_flags(args, collection="", remote=False)
     env.confirm_locked()
     nodes = env.topology_nodes()
     colls = _ec_collections(env)
@@ -420,9 +420,39 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
                 f"need {DATA_SHARDS_COUNT} — data LOST\n"
             )
             continue
-        # rebuilder = node already holding the most shards (fewest copies)
+        # rebuilder = node already holding the most shards (fewest copies —
+        # or, in -remote mode, the fewest slabs streamed over the network)
         rebuilder = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
         addr = grpc_addr(rebuilder)
+        if fl.remote:
+            # distributed path: NO bulk survivor pre-copy. The rebuilder
+            # streams the slabs it lacks from peer holders while decoding
+            # (VolumeEcShardSlabRead pipeline), writes + CRC-verifies the
+            # missing .ecNN files, and mounts only those.
+            resp = env.vs_call(
+                addr,
+                "VolumeEcShardsRebuild",
+                {"volume_id": vid, "collection": collection, "remote": True},
+                timeout=600,
+            )
+            rebuilt = resp.get("rebuilt_shard_ids", [])
+            if rebuilt:
+                env.vs_call(
+                    addr,
+                    "VolumeEcShardsMount",
+                    {"volume_id": vid, "collection": collection, "shard_ids": rebuilt},
+                )
+            detail = ""
+            if resp.get("remote_survivors"):
+                detail = f" (remote survivors {resp['remote_survivors']}"
+                if resp.get("failed_over"):
+                    detail += f", failed over {resp['failed_over']}"
+                detail += ")"
+            w.write(
+                f"ec.rebuild volume {vid}: rebuilt {rebuilt} on "
+                f"{rebuilder['url']}{detail}\n"
+            )
+            continue
         copied = _copy_missing_to(env, rebuilder, vid, collection, holders)
         resp = env.vs_call(
             addr, "VolumeEcShardsRebuild", {"volume_id": vid, "collection": collection}
@@ -447,8 +477,10 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
 register(
     ShellCommand(
         "ec.rebuild",
-        "ec.rebuild [-collection <name>]\n\tfind EC volumes with lost shards and "
-        "reconstruct them on a rebuilder node",
+        "ec.rebuild [-collection <name>] [-remote]\n\tfind EC volumes with lost "
+        "shards and reconstruct them on a rebuilder node; -remote streams\n"
+        "\tsurvivors from their holders through the network-overlapped rebuild\n"
+        "\tpipeline instead of bulk-copying shard files first",
         do_ec_rebuild,
     )
 )

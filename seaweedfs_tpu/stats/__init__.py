@@ -255,6 +255,14 @@ EcReconstructSeconds = REGISTRY.histogram(
     "weedtpu_ec_reconstruct_seconds",
     "latency of shard-interval reconstructions (p50 is the north-star)",
 )
+EcRebuildSeconds = REGISTRY.histogram(
+    "weedtpu_ec_rebuild_seconds",
+    "wall time of whole-shard ec.rebuild runs (local or remote survivors)",
+)
+EcRebuildRemoteBytes = REGISTRY.counter(
+    "weedtpu_ec_rebuild_remote_bytes_total",
+    "survivor bytes fetched from peer holders by distributed rebuilds",
+)
 VolumeServerVolumeGauge = REGISTRY.gauge(
     "weedtpu_volume_server_volumes", "volumes hosted", ("type",)
 )

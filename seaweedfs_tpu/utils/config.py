@@ -7,8 +7,15 @@ commented templates. Parsing uses stdlib tomllib."""
 from __future__ import annotations
 
 import os
-import tomllib
 from typing import Any, Optional
+
+try:  # stdlib on 3.11+; this image runs 3.10
+    import tomllib
+except ImportError:  # pragma: no cover — version-dependent
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ImportError:
+        tomllib = None  # type: ignore[assignment] — parse at use time
 
 SEARCH_PATHS = [".", "~/.seaweedfs_tpu", "/etc/seaweedfs_tpu"]
 
@@ -19,6 +26,16 @@ def load_configuration(name: str, required: bool = False) -> dict[str, Any]:
     for d in SEARCH_PATHS:
         path = os.path.join(os.path.expanduser(d), fname)
         if os.path.exists(path):
+            if tomllib is None:
+                # a present config that can't be parsed must FAIL, not be
+                # silently ignored — dropping security.toml would disable
+                # auth without a trace. Absent configs (the common case)
+                # never reach here, so 3.10 servers without TOML configs
+                # run fine.
+                raise RuntimeError(
+                    f"{path} exists but no TOML parser is available "
+                    "(python < 3.11 without the tomli package)"
+                )
             with open(path, "rb") as f:
                 return tomllib.load(f)
     if required:

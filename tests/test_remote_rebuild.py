@@ -1,0 +1,701 @@
+"""Distributed (remote-survivor) rebuild tests: the network-overlapped
+`ec.rebuild` path end to end — byte-identity against the serial oracle with
+survivors split across two in-process volume servers, per-holder failover
+mid-rebuild without a pipeline restart, drain+unlink exception safety when
+too few holders survive (mirroring tests/test_stream_pipeline.py), the
+CRC-framed bulk slab stream, single-flight shard-location lookups, and the
+tier-1 `ec_rebuild_remote` bench smoke."""
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ops.rs_codec import Encoder
+from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+ENC = Encoder(10, 4, backend="numpy")
+LARGE, SMALL = 16384, 4096
+VID = 9
+
+
+def _build_ec_volume(dirpath: str, size: int = 400_000, seed: int = 3):
+    """Write a full 14-shard EC volume (plus .ecx/.eci) under `dirpath`;
+    returns (base_path, {shard: golden_bytes})."""
+    base = os.path.join(dirpath, str(VID))
+    rng = np.random.default_rng(seed)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    with open(base + ".idx", "wb"):
+        pass
+    stripe.write_ec_files(
+        base, large_block_size=LARGE, small_block_size=SMALL, encoder=ENC
+    )
+    stripe.write_sorted_file_from_idx(base)
+    golden = {}
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            golden[s] = f.read()
+    os.unlink(base + ".dat")
+    return base, golden
+
+
+def _move_shards(src_base: str, dst_base: str, shard_ids, with_index=True):
+    for s in shard_ids:
+        os.replace(stripe.shard_file_name(src_base, s), stripe.shard_file_name(dst_base, s))
+    if with_index:
+        for ext in (".ecx", ".eci"):
+            if os.path.exists(src_base + ext) and not os.path.exists(dst_base + ext):
+                shutil.copy(src_base + ext, dst_base + ext)
+
+
+def _wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    """master + 3 volume servers (target + two potential holders)."""
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"srv{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+# -- end to end: byte-identity with survivors split across two servers --------
+
+
+def test_remote_rebuild_byte_identical_split_survivors(cluster3, tmp_path):
+    """Survivors split across the target (7-9 local) and a peer (0-6
+    remote); parity 10-13 lost cluster-wide. The distributed rebuild must
+    produce byte-identical files to the golden shards AND to
+    `rebuild_ec_files_serial` run on the same survivor set."""
+    master, (target, peer, _spare) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, golden = _build_ec_volume(str(stage))
+    base_peer = peer._base_path_for(VID)
+    base_target = target._base_path_for(VID)
+    for s in (10, 11, 12, 13):
+        os.unlink(stripe.shard_file_name(base_stage, s))
+    _move_shards(base_stage, base_peer, range(0, 7))
+    _move_shards(base_stage, base_target, range(7, 10))
+    with rpc.RpcClient(peer.grpc_address) as pc:
+        pc.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    with rpc.RpcClient(target.grpc_address) as tc:
+        tc.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    _wait_for(
+        lambda: len(master.topology.lookup_ec_shards(VID)) == 10,
+        msg="10 survivor shards registered",
+    )
+
+    with rpc.RpcClient(target.grpc_address) as tc:
+        resp = tc.call(
+            VOLUME_SERVICE,
+            "VolumeEcShardsRebuild",
+            {"volume_id": VID, "remote": True},
+            timeout=120,
+        )
+    assert resp["rebuilt_shard_ids"] == [10, 11, 12, 13]
+    assert resp["local_survivors"] == [7, 8, 9]
+    assert resp["remote_survivors"] == [0, 1, 2, 3, 4, 5, 6]
+    for s in (10, 11, 12, 13):
+        with open(stripe.shard_file_name(base_target, s), "rb") as f:
+            assert f.read() == golden[s], f"rebuilt shard {s} differs from golden"
+
+    # direct file-compare against the serial oracle on the SAME survivor set
+    oracle = tmp_path / "oracle"
+    oracle.mkdir()
+    base_oracle = os.path.join(str(oracle), str(VID))
+    for s in range(DATA_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base_oracle, s), "wb") as f:
+            f.write(golden[s])
+    assert stripe.rebuild_ec_files_serial(base_oracle, encoder=ENC) == [10, 11, 12, 13]
+    for s in (10, 11, 12, 13):
+        with open(stripe.shard_file_name(base_oracle, s), "rb") as f1, open(
+            stripe.shard_file_name(base_target, s), "rb"
+        ) as f2:
+            assert f1.read() == f2.read(), f"shard {s}: remote != serial oracle"
+
+    # the regenerated set mounts and serves
+    with rpc.RpcClient(target.grpc_address) as tc:
+        tc.call(
+            VOLUME_SERVICE,
+            "VolumeEcShardsMount",
+            {"volume_id": VID, "shard_ids": [10, 11, 12, 13]},
+        )
+        st = tc.call(VOLUME_SERVICE, "VolumeStatus", {"volume_id": VID})
+    assert set(st["shard_ids"]) >= {7, 8, 9, 10, 11, 12, 13}
+
+
+def test_remote_rebuild_holder_failover_mid_rebuild(cluster3, tmp_path):
+    """Kill one survivor holder mid-rebuild (its slab RPC starts failing):
+    the remaining slabs must fail over to the alternate holder without
+    restarting the pipeline, and the output must stay byte-identical."""
+    master, (target, holder_a, holder_b) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, golden = _build_ec_volume(str(stage))
+    for s in (10, 11, 12, 13):
+        os.unlink(stripe.shard_file_name(base_stage, s))
+    # BOTH holders carry all 10 survivors (replicated shard placement)
+    base_a = holder_a._base_path_for(VID)
+    base_b = holder_b._base_path_for(VID)
+    for s in range(DATA_SHARDS_COUNT):
+        shutil.copy(stripe.shard_file_name(base_stage, s), stripe.shard_file_name(base_a, s))
+        shutil.copy(stripe.shard_file_name(base_stage, s), stripe.shard_file_name(base_b, s))
+    for ext in (".ecx", ".eci"):
+        shutil.copy(base_stage + ext, base_a + ext)
+        shutil.copy(base_stage + ext, base_b + ext)
+    for vs in (holder_a, holder_b):
+        with rpc.RpcClient(vs.grpc_address) as c:
+            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    _wait_for(
+        lambda: all(
+            len(addrs) == 2 for addrs in master.topology.lookup_ec_shards(VID).values()
+        )
+        and len(master.topology.lookup_ec_shards(VID)) == 10,
+        msg="both holders registered for all survivors",
+    )
+
+    # holder A "dies" mid-rebuild: its slab RPC serves 2 windows then fails
+    served = {"n": 0}
+    orig = holder_a._rpc_ec_slab_read
+
+    def dying_slab_read(req, ctx):
+        served["n"] += 1
+        if served["n"] > 2:
+            raise rpc.RpcFault("holder killed mid-rebuild")
+        yield from orig(req, ctx)
+
+    holder_a._rpc_ec_slab_read = dying_slab_read
+    svc = holder_a._grpc._services[VOLUME_SERVICE]
+    svc.add(
+        "VolumeEcShardSlabRead", dying_slab_read, kind="unary_stream", resp_format="bytes"
+    )
+    # the target must try A first for every shard or the kill is untested
+    orig_lookup = target._lookup_shard_locations
+    a_addr = holder_a.grpc_address
+
+    def a_first(vid):
+        locs = orig_lookup(vid)
+        return {
+            sid: sorted(addrs, key=lambda a: a != a_addr) for sid, addrs in locs.items()
+        }
+
+    target._lookup_shard_locations = a_first
+
+    with rpc.RpcClient(target.grpc_address) as tc:
+        resp = tc.call(
+            VOLUME_SERVICE,
+            "VolumeEcShardsRebuild",
+            {"volume_id": VID, "remote": True},
+            timeout=120,
+        )
+    assert resp["rebuilt_shard_ids"] == [10, 11, 12, 13]
+    assert resp["failed_over"], "holder A died but no failover was recorded"
+    assert all(f.endswith(a_addr) for f in resp["failed_over"])
+    base_target = target._base_path_for(VID)
+    for s in (10, 11, 12, 13):
+        with open(stripe.shard_file_name(base_target, s), "rb") as f:
+            assert f.read() == golden[s], f"shard {s} wrong after failover"
+
+
+def test_remote_rebuild_too_few_survivors_faults(cluster3, tmp_path):
+    """Fewer than DATA_SHARDS survivors reachable anywhere -> typed fault,
+    no partial output files on the target."""
+    master, (target, peer, _spare) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, _ = _build_ec_volume(str(stage))
+    base_peer = peer._base_path_for(VID)
+    _move_shards(base_stage, base_peer, range(0, 9))  # only 9 survivors
+    with rpc.RpcClient(peer.grpc_address) as pc:
+        pc.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    _wait_for(
+        lambda: len(master.topology.lookup_ec_shards(VID)) == 9,
+        msg="9 shards registered",
+    )
+    import grpc as _grpc
+
+    with rpc.RpcClient(target.grpc_address) as tc:
+        with pytest.raises(_grpc.RpcError, match="cannot rebuild"):
+            tc.call(
+                VOLUME_SERVICE,
+                "VolumeEcShardsRebuild",
+                {"volume_id": VID, "remote": True},
+                timeout=60,
+            )
+    base_target = target._base_path_for(VID)
+    assert stripe.find_local_shards(base_target) == []
+
+
+def test_remote_rebuild_truncated_local_survivor_faults(cluster3, tmp_path):
+    """The remote path mirrors the local survivors-agree-on-length
+    preflight: a truncated local survivor must fault the rebuild up front,
+    not zero-fill into silently-wrong shards."""
+    master, (target, peer, _spare) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, _ = _build_ec_volume(str(stage))
+    for s in (10, 11, 12, 13):
+        os.unlink(stripe.shard_file_name(base_stage, s))
+    base_peer = peer._base_path_for(VID)
+    base_target = target._base_path_for(VID)
+    _move_shards(base_stage, base_peer, range(0, 7))
+    _move_shards(base_stage, base_target, range(7, 10))
+    p = stripe.shard_file_name(base_target, 8)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    for vs in (peer, target):
+        with rpc.RpcClient(vs.grpc_address) as c:
+            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    _wait_for(
+        lambda: len(master.topology.lookup_ec_shards(VID)) == 10,
+        msg="10 shards registered",
+    )
+    import grpc as _grpc
+
+    with rpc.RpcClient(target.grpc_address) as tc:
+        with pytest.raises(_grpc.RpcError, match="disagree"):
+            tc.call(
+                VOLUME_SERVICE,
+                "VolumeEcShardsRebuild",
+                {"volume_id": VID, "remote": True},
+                timeout=60,
+            )
+    assert not os.path.exists(stripe.shard_file_name(base_target, 10))
+
+
+def test_remote_rebuild_truncated_remote_shard_faults(cluster3, tmp_path):
+    """A truncated shard hiding behind healthy siblings on the SAME remote
+    holder must also fail the preflight: VolumeStatus reports per-shard
+    file sizes, not just the holder's max."""
+    master, (target, peer, _spare) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, _ = _build_ec_volume(str(stage))
+    for s in (10, 11, 12, 13):
+        os.unlink(stripe.shard_file_name(base_stage, s))
+    base_peer = peer._base_path_for(VID)
+    _move_shards(base_stage, base_peer, range(0, 10))
+    with rpc.RpcClient(peer.grpc_address) as pc:
+        pc.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    # truncate AFTER mount: the holder's max-based shard_size still reads
+    # full, only the per-shard report can expose it
+    p = stripe.shard_file_name(base_peer, 3)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    _wait_for(
+        lambda: len(master.topology.lookup_ec_shards(VID)) == 10,
+        msg="10 shards registered",
+    )
+    import grpc as _grpc
+
+    with rpc.RpcClient(target.grpc_address) as tc:
+        with pytest.raises(_grpc.RpcError, match="disagree"):
+            tc.call(
+                VOLUME_SERVICE,
+                "VolumeEcShardsRebuild",
+                {"volume_id": VID, "remote": True},
+                timeout=60,
+            )
+    assert not os.path.exists(
+        stripe.shard_file_name(target._base_path_for(VID), 10)
+    )
+
+
+# -- pipeline-level: deterministic failover + exception safety ----------------
+
+
+def _local_fetch_for(base: str, shard_id: int):
+    """A fetch(addr, offset, size) that reads the real shard file —
+    the transport stub for RemoteSlabSource unit tests."""
+
+    def fetch(addr: str, offset: int, size: int) -> bytes:
+        with open(stripe.shard_file_name(base, shard_id), "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    return fetch
+
+
+def _make_local_volume(tmp_path, size=400_000):
+    base = os.path.join(str(tmp_path), "v")
+    rng = np.random.default_rng(5)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    stripe.write_ec_files(
+        base, large_block_size=LARGE, small_block_size=SMALL, encoder=ENC
+    )
+    golden = {}
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            golden[s] = f.read()
+    return base, golden
+
+
+def test_slab_source_failover_is_mid_pipeline(tmp_path):
+    """RemoteSlabSource: the primary holder dies after one window; later
+    windows re-route to the alternate holder with the batch pipeline (and
+    its earlier output) intact — output byte-identical to the serial path."""
+    base, golden = _make_local_volume(tmp_path)
+    missing = [0, 5, 11, 13]
+    for s in missing:
+        os.unlink(stripe.shard_file_name(base, s))
+    present = [s for s in range(TOTAL_SHARDS_COUNT) if s not in missing]
+    calls = {"dead": 0, "live": 0}
+    sources = {}
+    for s in present:
+        real = _local_fetch_for(base, s)
+
+        def fetch(addr, offset, size, _real=real):
+            calls[addr] += 1
+            if addr == "dead" and calls["dead"] > 3:
+                raise IOError("holder gone")
+            return _real(addr, offset, size)
+
+        sources[s] = stripe.RemoteSlabSource(s, ["dead", "live"], fetch)
+    shard_size = len(golden[1])
+    try:
+        rebuilt = stripe.rebuild_ec_files_from_sources(
+            base,
+            sources,
+            shard_size,
+            encoder=ENC,
+            buffer_size=8192,
+            max_batch_bytes=10 * 2 * 8192,  # several windows -> mid-stream kill
+        )
+    finally:
+        for src in sources.values():
+            src.close()
+    assert rebuilt == sorted(missing)
+    assert calls["live"] > 0, "no window was served by the failover holder"
+    assert any(src.failovers == ["dead"] for src in sources.values())
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            assert f.read() == golden[s], f"shard {s} differs after failover"
+
+
+def test_from_sources_drains_and_unlinks_when_holders_die(tmp_path):
+    """All holders of one survivor die mid-rebuild with no alternate: the
+    pipeline must drain inflight device work and unlink the partial shard
+    files, leaving survivors untouched (test_stream_pipeline mirror)."""
+    base, golden = _make_local_volume(tmp_path)
+    missing = [10, 11, 12, 13]
+    for s in missing:
+        os.unlink(stripe.shard_file_name(base, s))
+    present = [s for s in range(TOTAL_SHARDS_COUNT) if s not in missing]
+    calls = {"n": 0}
+    sources = {}
+    for s in present:
+        real = _local_fetch_for(base, s)
+
+        def fetch(addr, offset, size, _real=real):
+            calls["n"] += 1
+            if calls["n"] > 12:  # past the first window fan-out: all die
+                raise IOError("cluster lost")
+            return _real(addr, offset, size)
+
+        sources[s] = stripe.RemoteSlabSource(s, ["only"], fetch)
+    try:
+        with pytest.raises(IOError, match="no reachable holder"):
+            stripe.rebuild_ec_files_from_sources(
+                base,
+                sources,
+                len(golden[1]),
+                encoder=ENC,
+                buffer_size=8192,
+                max_batch_bytes=10 * 2 * 8192,
+            )
+    finally:
+        for src in sources.values():
+            src.close()
+    for s in missing:
+        assert not os.path.exists(stripe.shard_file_name(base, s)), f"partial {s} leaked"
+    for s in present:
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            assert f.read() == golden[s], f"survivor {s} damaged"
+
+
+def test_from_sources_matches_local_rebuild(tmp_path):
+    """LocalSlabSource through the generalized pipeline == the classic
+    rebuild_ec_files on the same files (the refactor's identity check)."""
+    base, golden = _make_local_volume(tmp_path, size=123_457)
+    missing = [2, 12]
+    for s in missing:
+        os.unlink(stripe.shard_file_name(base, s))
+    assert stripe.rebuild_ec_files(base, encoder=ENC, buffer_size=8192) == missing
+    for s in missing:
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            assert f.read() == golden[s]
+
+
+# -- transport: CRC-framed slab stream ----------------------------------------
+
+
+def test_crc_frame_roundtrip_and_mismatch():
+    chunk = os.urandom(1000)
+    assert rpc.crc_unframe(rpc.crc_frame(chunk)) == chunk
+    framed = bytearray(rpc.crc_frame(chunk))
+    framed[7] ^= 0xFF  # flip a payload bit
+    with pytest.raises(IOError, match="CRC mismatch"):
+        rpc.crc_unframe(bytes(framed))
+    with pytest.raises(IOError, match="short CRC frame"):
+        rpc.crc_unframe(b"\x00")
+
+
+def test_slab_read_rpc_streams_crc_chunks_and_eof(cluster3, tmp_path):
+    """VolumeEcShardSlabRead: bounded CRC-framed chunks for the requested
+    window; a window past EOF ends the stream short (client zero-fills)."""
+    master, (_target, peer, _spare) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, golden = _build_ec_volume(str(stage))
+    base_peer = peer._base_path_for(VID)
+    _move_shards(base_stage, base_peer, range(TOTAL_SHARDS_COUNT))
+    with rpc.RpcClient(peer.grpc_address) as pc:
+        pc.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+        frames = list(
+            pc.stream(
+                VOLUME_SERVICE,
+                "VolumeEcShardSlabRead",
+                {
+                    "volume_id": VID,
+                    "shard_id": 3,
+                    "offset": 100,
+                    "size": 30_000,
+                    "chunk_size": 64 * 1024,  # server clamps to its floor
+                },
+                timeout=30,
+            )
+        )
+        got = b"".join(rpc.crc_unframe(f) for f in frames)
+        assert got == golden[3][100 : 100 + 30_000]
+        # EOF semantics: ask far past the end -> short stream, no error
+        shard_len = len(golden[3])
+        frames = list(
+            pc.stream(
+                VOLUME_SERVICE,
+                "VolumeEcShardSlabRead",
+                {
+                    "volume_id": VID,
+                    "shard_id": 3,
+                    "offset": shard_len - 100,
+                    "size": 10_000,
+                },
+                timeout=30,
+            )
+        )
+        got = b"".join(rpc.crc_unframe(f) for f in frames)
+        assert got == golden[3][-100:]
+
+
+# -- single-flight shard-location lookups -------------------------------------
+
+
+def test_lookup_shard_locations_single_flight(cluster3):
+    """A burst of concurrent cache misses for one vid pays exactly ONE
+    master LookupEcVolume round-trip."""
+    master, (vs, peer, _spare) = cluster3
+    master.topology.ec_locations[88] = {sid: {peer.url} for sid in range(14)}
+    calls = {"n": 0}
+    real_query = vs._master_query
+
+    def slow_counting_query(method, req, timeout=5.0):
+        if method == "LookupEcVolume":
+            calls["n"] += 1
+            time.sleep(0.1)  # widen the race window
+        return real_query(method, req, timeout)
+
+    vs._master_query = slow_counting_query
+    results = []
+    errs = []
+
+    def one():
+        try:
+            results.append(vs._lookup_shard_locations(88))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=one) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    assert not errs
+    assert len(results) == 8
+    assert calls["n"] == 1, f"single-flight broken: {calls['n']} master lookups"
+    assert all(set(r) == set(range(14)) for r in results)
+
+
+def test_lookup_single_flight_leader_failure_wakes_waiters(cluster3):
+    """A failed leader lookup must not strand waiters: they retry and
+    either succeed themselves or raise their own error (no deadlock)."""
+    master, (vs, peer, _spare) = cluster3
+    master.topology.ec_locations[99] = {0: {peer.url}}
+    state = {"n": 0}
+    real_query = vs._master_query
+
+    def first_fails(method, req, timeout=5.0):
+        if method == "LookupEcVolume":
+            state["n"] += 1
+            if state["n"] == 1:
+                time.sleep(0.05)
+                raise RuntimeError("master hiccup")
+        return real_query(method, req, timeout)
+
+    vs._master_query = first_fails
+    outcomes = []
+
+    def one():
+        try:
+            outcomes.append(vs._lookup_shard_locations(99))
+        except Exception as e:  # noqa: BLE001
+            outcomes.append(e)
+
+    threads = [threading.Thread(target=one) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    assert len(outcomes) == 4
+    assert any(isinstance(o, dict) for o in outcomes), "no caller recovered"
+
+
+# -- ec_volume satellite: abandoned fetches are cancelled+drained -------------
+
+
+def test_gather_survivors_cancels_pending_on_raise(tmp_path):
+    """An exception mid-fan-out must cancel/drain the still-pending remote
+    futures (no hung-peer thread keeps a buffer or unobserved error)."""
+    from seaweedfs_tpu.ec.ec_volume import EcVolume
+
+    base, _ = _make_local_volume(tmp_path, size=60_000)
+    with open(base + ".idx", "wb"):
+        pass
+    stripe.write_sorted_file_from_idx(base)
+    # keep ONE local shard: too few to reconstruct locally, so the fan-out
+    # must go remote for the rest
+    for s in range(1, TOTAL_SHARDS_COUNT):
+        os.unlink(stripe.shard_file_name(base, s))
+    release = threading.Event()
+
+    def hanging_reader(shard_id, offset, size):
+        release.wait(5)  # a hung peer
+        return None
+
+    with EcVolume(
+        base,
+        encoder=ENC,
+        large_block_size=LARGE,
+        small_block_size=SMALL,
+        warm_on_mount=False,
+        shard_size=60_000,
+        remote_reader=hanging_reader,
+        recover_fetch_deadline=0.3,
+    ) as ev:
+        with pytest.raises(IOError, match="surviving shards"):
+            ev._gather_survivors(1, 0, 100)
+        release.set()
+
+
+# -- operator path: ec.rebuild -remote ----------------------------------------
+
+
+def test_shell_ec_rebuild_remote(cluster3, tmp_path):
+    """`ec.rebuild -remote` end to end: the shell picks the
+    fullest-shard-count node as rebuild target, the target streams the
+    survivors it lacks, and the regenerated shard is mounted and
+    topology-visible — no bulk survivor pre-copy RPCs."""
+    import io
+
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    master, (srv0, srv1, srv2) = cluster3
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    base_stage, golden = _build_ec_volume(str(stage))
+    base1 = srv1._base_path_for(VID)
+    base2 = srv2._base_path_for(VID)
+    _move_shards(base_stage, base1, range(0, 7))
+    _move_shards(base_stage, base2, range(7, 13))
+    os.unlink(stripe.shard_file_name(base_stage, 13))  # shard 13 lost
+    for vs in (srv1, srv2):
+        with rpc.RpcClient(vs.grpc_address) as c:
+            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    _wait_for(
+        lambda: len(master.topology.lookup_ec_shards(VID)) == 13,
+        msg="13 shards registered",
+    )
+    env = CommandEnv(master.address)
+    try:
+        out = io.StringIO()
+        run_command(env, "lock", out)
+        run_command(env, "ec.rebuild -remote", out)
+        text = out.getvalue()
+    finally:
+        env.close()
+    assert "rebuilt [13]" in text, text
+    # the rebuilder was the 7-shard holder and now serves shard 13 too
+    rebuilt_base = srv1._base_path_for(VID)
+    with open(stripe.shard_file_name(rebuilt_base, 13), "rb") as f:
+        assert f.read() == golden[13]
+    _wait_for(
+        lambda: 13 in master.topology.lookup_ec_shards(VID),
+        msg="rebuilt shard in topology",
+    )
+
+
+# -- tier-1 CI smoke: the bench harness on tiny shards ------------------------
+
+
+def test_bench_rebuild_remote_smoke(tmp_path):
+    """Fast CPU smoke of bench.py's ec_rebuild_remote harness (tiny shards,
+    two in-process servers): the distributed rebuild must complete, match
+    golden bytes, and report the overlap metrics — wired into tier-1 like
+    kernel_sweep --smoke, without asserting timing ratios (1-core CI)."""
+    import bench
+
+    out = bench._measure_rebuild_remote(
+        str(tmp_path),
+        dat_bytes=1 << 20,
+        large=65536,
+        small=16384,
+        buffer_size=16384,
+        max_batch_bytes=10 * 2 * 16384,
+        delay_ms=0,
+    )
+    assert out["ok"], out
+    assert out["match"] is True
+    assert out["rebuilt_shard_ids"] == [10, 11, 12, 13]
+    assert out["remote_survivors"] == list(range(10))
+    for key in (
+        "remote_rebuild_gbps",
+        "local_rebuild_gbps",
+        "overlap_efficiency",
+        "pipelined_vs_serial_fetch_then_decode",
+    ):
+        assert isinstance(out.get(key), float), f"missing metric {key}: {out}"
