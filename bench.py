@@ -478,6 +478,13 @@ def mode_cpu() -> None:
             out["ec_rebuild"] = _measure_rebuild(td)
     except Exception as e:  # noqa: BLE001
         out["ec_rebuild_error"] = str(e)[:200]
+    try:
+        from seaweedfs_tpu.ops.rs_codec import new_encoder
+
+        # the factory's audited decision (evidence file, numbers, reason)
+        out["auto_backend"] = new_encoder().selection
+    except Exception as e:  # noqa: BLE001
+        out["auto_backend_error"] = str(e)[:200]
     _emit(out)
 
 
@@ -1158,6 +1165,15 @@ def mode_device() -> None:
         out["rebuild_error"] = str(e)[:300]
     out["best_gbps"] = round(best_gbps, 3)
     out["best_backend"] = best_name
+    try:
+        from seaweedfs_tpu.ops.rs_codec import new_encoder
+
+        # what production would ACTUALLY select on this device right now —
+        # the evidence-based factory decision, next to the live numbers it
+        # should eventually reflect (flips only via a committed artifact)
+        out["auto_backend"] = new_encoder().selection
+    except Exception as e:  # noqa: BLE001
+        out["auto_backend_error"] = str(e)[:200]
     out["dispatch_floor_note"] = (
         "per-call numbers are floored by the axon tunnel's ~65 ms dispatch "
         "RTT; steady-state (scan-chain slope) is the device-side throughput"
@@ -1378,6 +1394,16 @@ def main() -> None:
             pass
     if probe:
         result["device_probe"] = {k: probe[k] for k in ("secs", "platform") if k in probe}
+    # the evidence-based auto-backend decision for a TPU deployment, from
+    # committed artifacts alone (no jax import in the parent: reading a
+    # JSON file cannot wedge the tunnel) — what new_encoder("auto") will
+    # select on-chip, and why
+    try:
+        from seaweedfs_tpu.ops.rs_codec import pick_device_backend
+
+        result["auto_backend_on_tpu"] = pick_device_backend()[1]
+    except Exception as e:  # noqa: BLE001
+        result["auto_backend_on_tpu_error"] = str(e)[:200]
     result["vs_baseline"] = round(result["value"] / TARGET_GBPS, 4)
     _emit(result)
 

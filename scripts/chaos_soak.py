@@ -10,9 +10,16 @@ filer client path via the master HTTP API. Files are written with
 replication 001 (2 copies) so any single kill leaves a live replica;
 mid-run one volume is EC-encoded so degraded reads join the mix.
 
+`--wedge` switches the chaos from kills to WEDGES: victims get SIGSTOP
+(the process is alive but answers nothing — the failure mode a crashed
+disk controller or a stopped container exhibits, and the one the
+per-holder cap + suspicion window on the degraded-read ladder exists
+for) and SIGCONT a few seconds later. No process ever restarts, so any
+stall in the read path is the ladder's fault, not a reboot's.
+
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
-      python scripts/chaos_soak.py [--seconds 300]
+      python scripts/chaos_soak.py [--seconds 300] [--wedge]
 Writes artifacts/SOAK_r06.json and exits nonzero on any lost byte.
 """
 
@@ -49,6 +56,7 @@ class Node:
         self.http = _free_port()
         self.grpc = _free_port()
         self.proc: subprocess.Popen | None = None
+        self.wedged = False
 
     def start(self) -> None:
         env = {**os.environ, "JAX_PLATFORMS": "cpu"}
@@ -76,6 +84,20 @@ class Node:
             except subprocess.TimeoutExpired:
                 self.proc.kill()
             self.proc = None
+        self.wedged = False
+
+    def wedge(self) -> None:
+        """SIGSTOP: the server is alive (sockets open, connections
+        accepted by the kernel backlog) but answers NOTHING — the exact
+        shape the per-holder cap on degraded reads must absorb."""
+        if self.proc is not None and not self.wedged:
+            self.proc.send_signal(signal.SIGSTOP)
+            self.wedged = True
+
+    def unwedge(self) -> None:
+        if self.proc is not None and self.wedged:
+            self.proc.send_signal(signal.SIGCONT)
+            self.wedged = False
 
     @property
     def alive(self) -> bool:
@@ -86,6 +108,7 @@ def main() -> int:
     seconds = 300
     if "--seconds" in sys.argv:
         seconds = int(sys.argv[sys.argv.index("--seconds") + 1])
+    wedge_mode = "--wedge" in sys.argv
     rng = random.Random(7)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -97,7 +120,9 @@ def main() -> int:
     report: dict = {
         "when": time.strftime("%FT%TZ", time.gmtime()),
         "seconds": seconds,
+        "mode": "wedge" if wedge_mode else "kill",
         "kills": 0,
+        "wedges": 0,
         "writes": 0,
         "write_failures": 0,
         "reads": 0,
@@ -248,7 +273,18 @@ def main() -> int:
             rebuild_tried = False
             while time.monotonic() < t_end:
                 victim = rng.choice(nodes)
-                if victim.alive and sum(n.alive for n in nodes) > 1:
+                if wedge_mode:
+                    # wedge rather than kill: the victim stays alive but
+                    # answers nothing for a few seconds — reads and
+                    # writes must route around it (per-holder cap +
+                    # suspicion on the EC ladder, replica failover on
+                    # the plain path), never stall on it
+                    if victim.alive and sum(
+                        n.alive and not n.wedged for n in nodes
+                    ) > 1:
+                        victim.wedge()
+                        report["wedges"] += 1
+                elif victim.alive and sum(n.alive for n in nodes) > 1:
                     victim.kill(hard=rng.random() < 0.5)
                     report["kills"] += 1
                 for _ in range(rng.randrange(2, 6)):
@@ -257,13 +293,24 @@ def main() -> int:
                 if not rebuild_tried and report.get("ec_encoded_vid") is not None:
                     rebuild_tried = True
                     try_remote_rebuild()
-                time.sleep(rng.uniform(1.0, 3.0))
-                if not victim.alive:
+                if wedge_mode and victim.wedged:
+                    # the wedge must OUTLAST the volume server's per-holder
+                    # transport timeout (EC_SHARD_READ_TIMEOUT = 10 s) or
+                    # the degraded-read suspicion path under test never
+                    # fires — reads would just ride out a short stall
+                    time.sleep(rng.uniform(11.0, 14.0))
+                else:
+                    time.sleep(rng.uniform(1.0, 3.0))
+                if wedge_mode:
+                    victim.unwedge()
+                elif not victim.alive:
                     victim.start()
                     time.sleep(2.0)
 
-            # every node back up; the final pass demands every byte
+            # every node back up (and un-wedged); the final pass demands
+            # every byte
             for n in nodes:
+                n.unwedge()
                 if not n.alive:
                     n.start()
             time.sleep(8.0)
@@ -271,11 +318,14 @@ def main() -> int:
 
         finally:
             # teardown must run on ANY exit path (a failed form-up assert
-            # must not leak three subprocesses writing into the tempdir)
+            # must not leak three subprocesses writing into the tempdir).
+            # SIGCONT first: a SIGSTOPped child cannot process SIGTERM and
+            # would eat the 10 s escalation wait.
             if client is not None:
                 client.close()
             for n in nodes:
                 try:
+                    n.unwedge()
                     n.kill(hard=False)
                 except Exception:
                     pass

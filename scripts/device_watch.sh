@@ -1,19 +1,39 @@
 #!/bin/bash
-# Detached tunnel watcher: probe the axon TPU every 10 min; on the first
-# healthy probe run the full window worker (scripts/device_window.py:
-# fresh measurement + kernel sweep + e2e encode). Exits after one
-# successful window or when the deadline passes. Never SIGTERMs a device
-# run mid-flight (that wedges the tunnel): the worker self-budgets.
+# Detached tunnel watcher: probe the axon TPU every 10 min; on the FIRST
+# healthy probe, immediately fire the incremental kernel sweep
+# (kernel_sweep.py --out artifacts/SWEEP_r06.jsonl — one JSON line
+# persists per config AS IT LANDS, so even a window that dies mid-sweep
+# leaves committed evidence), then run the full window worker
+# (scripts/device_window.py: fresh scan-chain measurement + resumed
+# sweep + e2e encode + remote rebuild + assembly of the committed
+# DEVICE_MEASUREMENT_r06.json the auto backend reads). Exits after one
+# successful window or when the deadline passes. NEVER SIGTERMs a device
+# run mid-flight (the r4 lesson: that wedges the tunnel machine-wide) —
+# both children self-budget and the sweep is resumable, so an aborted
+# attempt costs nothing on the next probe.
 cd /root/repo
 DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-6} * 3600 ))
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if bash scripts/probe_device.sh | grep -q "probe ok"; then
-    echo "$(date -u +%FT%TZ) tunnel alive — running device window" >> artifacts/device_watch.log
+    echo "$(date -u +%FT%TZ) tunnel alive — firing incremental sweep" >> artifacts/device_watch.log
+    # sweep FIRST: evidence starts persisting within the first alive
+    # minute; a later wedge cannot take what already landed. Resumable:
+    # a re-fire skips configs already in the harvest file.
+    PYTHONPATH=/root/repo:/root/.axon_site python scripts/kernel_sweep.py \
+      --out artifacts/SWEEP_r06.jsonl >> artifacts/device_watch.log 2>&1
+    sweep_rc=$?
+    echo "$(date -u +%FT%TZ) sweep rc=$sweep_rc — assembling evidence" >> artifacts/device_watch.log
+    # fold whatever landed into the committed measurement artifact even
+    # before the window worker runs (new_encoder("auto") reads it)
+    PYTHONPATH=/root/repo:/root/.axon_site python scripts/device_window.py \
+      --assemble >> artifacts/device_watch.log 2>&1
+    echo "$(date -u +%FT%TZ) running device window" >> artifacts/device_watch.log
     PYTHONPATH=/root/repo:/root/.axon_site python scripts/device_window.py >> artifacts/device_watch.log 2>&1
     rc=$?
     echo "$(date -u +%FT%TZ) window rc=$rc" >> artifacts/device_watch.log
     # only a COMPLETED window ends the watch: a failed/aborted attempt
-    # must not burn the remaining deadline (the next probe retries)
+    # must not burn the remaining deadline (the next probe retries; the
+    # sweep resumes where the harvest file left off)
     [ "$rc" -eq 0 ] && exit 0
   fi
   sleep 600

@@ -7,8 +7,12 @@ tunnel, the r4 lesson):
      kernel (auto-tile + bf16 MXU variants), AND the rebuild decode path
      (`rebuild_xla_steady_gbps` — the ROADMAP's missing number)
      -> artifacts/DEVICE_MEASUREMENT_r06.json
-  2. kernel sweep (tiles x dtypes, byte-exact gated)
-     -> artifacts/SWEEP_r05.jsonl
+  2. kernel sweep (tiles x staged variants, byte-exact gated) with
+     INCREMENTAL persistence: kernel_sweep.py --out appends one JSON
+     line per config as it lands and resumes past configs a previous
+     window (or the device_watch.sh-fired sweep) already harvested
+     -> artifacts/SWEEP_r06.jsonl, assembled into the committed
+     DEVICE_MEASUREMENT_r06.json (the auto-backend evidence file)
   3. config-2-shaped END-TO-END encode through ec/stripe's real file
      path (disk -> device -> .ecNN writes) — device-side AND e2e GB/s;
      e2e here crosses the ~20-25 MB/s axon tunnel, so it is labeled
@@ -23,6 +27,12 @@ tunnel, the r4 lesson):
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/device_window.py
 Writes artifacts/ as it goes; safe to re-run.
+
+`--assemble [SWEEP_PATH]` skips the stages and only re-assembles the
+committed DEVICE_MEASUREMENT artifact from the existing stage-1 numbers
+plus the (possibly still-growing) sweep harvest — the parse seam the
+device_watch.sh -> kernel_sweep --out -> assembler round-trip test
+exercises.
 """
 
 from __future__ import annotations
@@ -48,6 +58,103 @@ def log(msg: str) -> None:
     print(line, flush=True)
     with open(os.path.join(ART, "device_window.log"), "a", encoding="utf-8") as f:
         f.write(line + "\n")
+
+
+SWEEP_PATH = os.path.join(ART, "SWEEP_r06.jsonl")
+MEASUREMENT_PATH = os.path.join(ART, "DEVICE_MEASUREMENT_r06.json")
+
+
+def parse_sweep_jsonl(path: str) -> dict:
+    """Parse a kernel_sweep.py --out harvest into evidence tables:
+    {"encode": {variant: steady_gbps}, "rebuild": {...}, "failed": [...],
+    "records": N}. Tolerant of a torn tail line (a sweep crashed
+    mid-write) and of cpu-platform sanity records (excluded — only
+    on-chip numbers may become auto-backend evidence)."""
+    out: dict = {
+        "encode": {}, "rebuild": {}, "failed": [], "records": 0,
+        "platform": None, "when": None,
+    }
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail from a crash mid-write
+        name = rec.get("variant")
+        if not name:
+            continue
+        out["records"] += 1
+        if rec.get("platform") == "cpu" or rec.get("tiny"):
+            continue  # sanity run — neither evidence NOR an on-chip failure
+        if rec.get("error"):
+            out["failed"].append(name)
+            continue
+        gbps = rec.get("steady_gbps")
+        if isinstance(gbps, (int, float)):
+            table = "rebuild" if name.startswith("rebuild-") else "encode"
+            out[table][name] = gbps
+            out["platform"] = out["platform"] or rec.get("platform")
+            if rec.get("when"):
+                out["when"] = max(out["when"] or "", rec["when"])
+    return out
+
+
+def assemble_measurement(meas: dict, sweep_path: str = SWEEP_PATH) -> dict:
+    """Fold the incremental sweep harvest into the measurement dict the
+    auto-backend factory reads (rs_codec.pick_device_backend): adds the
+    `sweep` tables plus `sweep_best_encode` / `sweep_best_rebuild`
+    summaries. Safe to call while the sweep is still appending — it
+    assembles whatever has landed so far."""
+    meas = dict(meas)
+    sweep = parse_sweep_jsonl(sweep_path)
+    if sweep["records"]:
+        # a sweep-only assembly (watch fired the sweep, no stage-1 pass
+        # yet) still needs platform/when for the evidence gates
+        if sweep["platform"] and not meas.get("platform"):
+            meas["platform"] = sweep["platform"]
+        if sweep["when"]:
+            meas["when"] = max(str(meas.get("when", "")), sweep["when"])
+        meas["sweep"] = {"encode": sweep["encode"], "rebuild": sweep["rebuild"]}
+        if sweep["failed"]:
+            meas["sweep"]["failed"] = sweep["failed"]
+        for key, table in (("sweep_best_encode", sweep["encode"]),
+                           ("sweep_best_rebuild", sweep["rebuild"])):
+            if table:
+                best = max(table, key=table.get)
+                meas[key] = {"variant": best, "steady_gbps": table[best]}
+    return meas
+
+
+def write_measurement(meas: dict) -> None:
+    with open(MEASUREMENT_PATH, "w", encoding="utf-8") as f:
+        json.dump(meas, f, indent=1)
+
+
+def assemble_only(sweep_path: str = SWEEP_PATH) -> int:
+    """--assemble: merge the harvest into the committed artifact without
+    touching the device (works even while the watch-fired sweep runs)."""
+    try:
+        with open(MEASUREMENT_PATH, encoding="utf-8") as f:
+            meas = json.load(f)
+    except (OSError, ValueError):
+        meas = {
+            "when": time.strftime("%FT%TZ", time.gmtime()),
+            "round": 6,
+            "note": "assembled from sweep harvest only; stage-1 scan-chain "
+            "numbers pending a device window",
+        }
+    meas.pop("_file", None)  # reader-side provenance tag, never committed
+    assembled = assemble_measurement(meas, sweep_path)
+    write_measurement(assembled)
+    print(json.dumps(assembled, indent=1))
+    return 0
 
 
 def main() -> int:
@@ -111,6 +218,21 @@ def main() -> int:
         "pallas_bf16_steady_gbps",
         lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, mxu="bf16")),
     )
+    # the r6 staged variants (ROOFLINE verification plan): shift-free
+    # unpack, multi-plane accumulation, manual double-buffered DMA — the
+    # full tile grid belongs to the sweep; these are the headline configs
+    stage(
+        "pallas_u8_steady_gbps",
+        lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, mxu="u8")),
+    )
+    stage(
+        "pallas_mplane_steady_gbps",
+        lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, mxu="mplane")),
+    )
+    stage(
+        "pallas_dma_steady_gbps",
+        lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, mxu="dma")),
+    )
     stage(
         "pallas_tile8192_steady_gbps",
         lambda: steady(lambda x: rs_pallas.gf_apply_fused(b_bits, x, tile=8192)),
@@ -136,29 +258,42 @@ def main() -> int:
             lambda x: rs_pallas.gf_apply_fused(dm_bits, x), out_rows=len(lost)
         ),
     )
-    with open(os.path.join(ART, "DEVICE_MEASUREMENT_r06.json"), "w", encoding="utf-8") as f:
-        json.dump(meas, f, indent=1)
+    write_measurement(meas)
 
     # -- 2: sweep ------------------------------------------------------------
     # budget is checked BEFORE starting and the sweep runs UNBOUNDED: a
     # subprocess timeout would SIGTERM a device dispatch mid-flight — the
-    # exact tunnel-wedging action this worker exists to avoid (r4 lesson)
+    # exact tunnel-wedging action this worker exists to avoid (r4 lesson).
+    # --out makes the sweep RESUMABLE: one JSON line persists per config
+    # as it lands, and configs the device_watch.sh-fired sweep (or a prior
+    # aborted window) already harvested are skipped, so every alive minute
+    # extends the harvest instead of restarting it.
     if left() > 600:
-        log("running kernel sweep")
+        log("running kernel sweep (incremental, resumes prior harvest)")
         import subprocess
 
-        with open(os.path.join(ART, "SWEEP_r06.jsonl"), "w") as out, open(
-            os.path.join(ART, "SWEEP_r06.err"), "w"
+        with open(os.path.join(ART, "SWEEP_r06.log"), "a") as out, open(
+            os.path.join(ART, "SWEEP_r06.err"), "a"
         ) as err:
             subprocess.run(
-                [sys.executable, "scripts/kernel_sweep.py"],
+                [sys.executable, "scripts/kernel_sweep.py", "--out", SWEEP_PATH],
                 cwd=os.path.dirname(ART),
                 stdout=out,  # stderr kept separate: warnings must not
-                stderr=err,  # corrupt the JSONL record stream
+                stderr=err,  # corrupt the record stream
             )
         log("sweep done")
     else:
-        log("skipping sweep: budget")
+        log("skipping sweep: budget (assembling whatever already landed)")
+
+    # assemble the committed evidence artifact: stage-1 scan-chain numbers
+    # + every sweep config that has landed so far. new_encoder("auto")
+    # reads exactly this file (rs_codec.pick_device_backend).
+    meas = assemble_measurement(meas)
+    write_measurement(meas)
+    log(
+        "assembled %s: sweep_best_encode=%s"
+        % (os.path.basename(MEASUREMENT_PATH), meas.get("sweep_best_encode"))
+    )
 
     # -- 3: e2e encode through the real file path ----------------------------
     if left() > 180:
@@ -245,4 +380,8 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--assemble" in sys.argv:
+        i = sys.argv.index("--assemble")
+        path = sys.argv[i + 1] if i + 1 < len(sys.argv) else SWEEP_PATH
+        raise SystemExit(assemble_only(path))
     raise SystemExit(main())

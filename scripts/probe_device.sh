@@ -16,10 +16,10 @@ try:
     err = "" if ok else (r.stderr[-200:] or r.stdout[-200:])
 except subprocess.TimeoutExpired:
     ok, err = False, "timeout after 90s"
-rec = {"when": "round-5-loop", "ts": datetime.datetime.now(datetime.UTC).strftime("%Y-%m-%dT%H:%MZ"),
+rec = {"when": "round-6-loop", "ts": datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ"),
        "method": "subprocess jax.devices(), 90s cap", "ok": ok, "dt_s": round(time.time()-t0, 1)}
 if err: rec["error"] = err
-with open("PROBES_r05.jsonl", "a") as f:
+with open("PROBES_r06.jsonl", "a") as f:
     f.write(json.dumps(rec) + "\n")
 print("probe ok" if ok else "probe fail")
 PY

@@ -18,6 +18,7 @@ import threading
 import time as _time
 import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from concurrent.futures import TimeoutError as _FutureTimeout  # 3.10: not builtins.TimeoutError
 from typing import Callable, Optional
 
 import numpy as np
@@ -62,6 +63,9 @@ class EcVolume:
         ecj_compact_threshold: int = 1 << 20,
         recover_fetch_parallelism: int = 8,
         recover_fetch_deadline: float = 30.0,
+        recover_holder_timeout: float = 30.0,
+        recover_holder_backoff: float = 30.0,
+        recover_suspect_after: float = 5.0,
     ):
         self.base = base_file_name
         self.encoder = encoder or new_encoder()
@@ -71,6 +75,28 @@ class EcVolume:
         # take a reconstructing read, and a pool per mount would leak threads)
         self.recover_fetch_parallelism = recover_fetch_parallelism
         self.recover_fetch_deadline = recover_fetch_deadline
+        # per-HOLDER cap + suspicion window: a WEDGED holder (SIGSTOPped
+        # process, dead NIC — it neither answers nor errors) is cut at
+        # `recover_holder_timeout` per attempt, then skipped entirely for
+        # `recover_holder_backoff` seconds, so one wedged peer costs the
+        # ladder ONE capped attempt — not a per-read stall — and the
+        # serving p50 returns to healthy levels until the window expires.
+        # The cap default (30 s) deliberately exceeds the volume server's
+        # remote_reader internals (per-holder 10 s transport timeout x a
+        # couple of replica holders): a reader mid-failover to a healthy
+        # replica must never be aborted and suspected by this layer. The
+        # cap's hard cut matters for readers WITHOUT internal timeouts.
+        # `recover_suspect_after` is the complementary soft signal: a
+        # remote fetch that runs at least this long and still yields
+        # NOTHING (the shape of a reader whose internal timeout swallowed
+        # a wedged peer) marks the shard suspect — a genuine miss (shard
+        # simply absent) answers None fast and is never suspected.
+        self.recover_holder_timeout = recover_holder_timeout
+        self.recover_holder_backoff = recover_holder_backoff
+        self.recover_suspect_after = recover_suspect_after
+        self._holder_suspect_until: dict[int, float] = {}
+        self._wedged_inflight: dict[int, object] = {}  # shard -> blocked future
+        self._suspect_lock = threading.Lock()
         self._fetch_pool: Optional[ThreadPoolExecutor] = None
         self._fetch_pool_lock = threading.Lock()
         # recorded stripe geometry (.eci) wins over constructor defaults —
@@ -228,6 +254,94 @@ class EcVolume:
             return None
         return np.frombuffer(raw, dtype=np.uint8).copy()
 
+    def _holder_suspected(self, shard_id: int) -> bool:
+        with self._suspect_lock:
+            if self._holder_suspect_until.get(shard_id, 0.0) > _time.monotonic():
+                return True
+            # a previous attempt is STILL blocked inside remote_reader: the
+            # holder stays unavailable past any backoff expiry, so we never
+            # stack a second pool thread onto a wedged peer (one blocked
+            # worker per wedged holder is the hard ceiling)
+            return shard_id in self._wedged_inflight
+
+    def _mark_holder_suspect(self, shard_id: int) -> None:
+        with self._suspect_lock:
+            self._holder_suspect_until[shard_id] = (
+                _time.monotonic() + self.recover_holder_backoff
+            )
+
+    def _track_wedged(self, shard_id: int, fut) -> None:
+        """Remember that `fut` is a call into a wedged holder whose pool
+        thread is still blocked; the holder reads as suspected until the
+        call finally returns (SIGCONT, TCP reset, ...)."""
+        with self._suspect_lock:
+            self._wedged_inflight[shard_id] = fut
+
+        def _clear(f, _s=shard_id):
+            with self._suspect_lock:
+                if self._wedged_inflight.get(_s) is f:
+                    del self._wedged_inflight[_s]
+
+        fut.add_done_callback(_clear)
+
+    def _remote_fetch_capped(
+        self, shard_id: int, offset: int, size: int
+    ) -> Optional[np.ndarray]:
+        """One remote attempt under the per-holder cap: the call runs on
+        the fetch pool and is abandoned once it has RUN for
+        `recover_holder_timeout` — a SIGSTOPped/wedged holder (answers
+        nothing, errors nothing) costs exactly one capped wait, gets
+        marked suspect for the backoff window, and later reads skip it.
+        The cap is measured from the call's ACTUAL start, same rule as
+        the fan-out: an attempt stuck in the pool queue is the pool's
+        fault, not the holder's, and must never suspect a healthy peer
+        (the read gives up after ~2x the cap either way)."""
+        if self.remote_reader is None or self._holder_suspected(shard_id):
+            return None
+        started: list[float] = []
+
+        def _call():
+            started.append(_time.monotonic())
+            return self.remote_reader(shard_id, offset, size)
+
+        cap = self.recover_holder_timeout
+        fut = self._fetch_executor().submit(_call)
+        try:
+            raw = fut.result(timeout=cap)
+        except _FutureTimeout:
+            if not started:
+                # never left the queue: saturated pool, holder unproven —
+                # a miss for this read, no suspicion
+                stripe._abandon_future(fut)
+                return None
+            remaining = cap - (_time.monotonic() - started[0])
+            raw = None
+            if remaining > 0:
+                try:
+                    raw = fut.result(timeout=remaining)
+                except _FutureTimeout:
+                    remaining = 0.0
+                except Exception:  # noqa: BLE001 — a down holder is a miss
+                    return None
+            if remaining <= 0:
+                self._mark_holder_suspect(shard_id)
+                self._track_wedged(shard_id, fut)
+                stripe._abandon_future(fut)
+                return None
+        except Exception:  # noqa: BLE001 — a down holder is a miss,
+            return None  # not a failed read: survivors can still serve it
+        if raw is None:
+            # a long-running NOTHING is the wedge signature when the
+            # reader has its own internal transport timeout (it swallows
+            # the stall and reports a miss): suspect without re-probing
+            if (
+                started
+                and _time.monotonic() - started[0] >= self.recover_suspect_after
+            ):
+                self._mark_holder_suspect(shard_id)
+            return None
+        return np.frombuffer(raw, dtype=np.uint8).copy()
+
     def _read_present(self, shard_id: int, offset: int, size: int) -> Optional[np.ndarray]:
         """The non-degraded rungs of the read ladder (local -> remote), or
         None when the shard is unreachable and only reconstruction can
@@ -235,14 +349,7 @@ class EcVolume:
         data = self._read_local(shard_id, offset, size)
         if data is not None:
             return data
-        if self.remote_reader is not None:
-            try:
-                raw = self.remote_reader(shard_id, offset, size)
-            except Exception:  # noqa: BLE001 — a down holder is a miss,
-                raw = None  # not a failed read: survivors can still serve it
-            if raw is not None:
-                return np.frombuffer(raw, dtype=np.uint8).copy()
-        return None
+        return self._remote_fetch_capped(shard_id, offset, size)
 
     def _read_shard_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         """One interval: local -> remote -> reconstruct-from-survivors."""
@@ -300,28 +407,63 @@ class EcVolume:
             # cost one RTT per survivor and dominated the reconstruct p50.
             # Late arrivals beyond `need` are ignored; a hung peer is cut by
             # the overall deadline rather than stalling the read forever.
+            # suspected-wedged holders are skipped outright: the fan-out
+            # needs only `need` of the remaining survivors, and a holder
+            # inside its backoff window would just burn a pool thread
             candidates = [
                 s
                 for s in range(TOTAL_SHARDS_COUNT)
-                if s != shard_id and shards[s] is None
+                if s != shard_id
+                and shards[s] is None
+                and not self._holder_suspected(s)
             ]
             pool = self._fetch_executor()
-            futs = {
-                pool.submit(self.remote_reader, s, offset, size): s
-                for s in candidates
-            }
+            # per-holder cap is measured from each call's ACTUAL start (a
+            # queued attempt waiting for a pool slot is not the holder's
+            # fault): the worker records its entry time, and the wait loop
+            # cuts any holder that has been RUNNING past the cap — wedged,
+            # not merely slow — marking it suspect. The OVERALL read is
+            # still bounded by `recover_fetch_deadline`, unchanged.
+            started: dict[int, float] = {}
+
+            def _attempt(s: int):
+                started[s] = _time.monotonic()
+                return self.remote_reader(s, offset, size)
+
+            futs = {pool.submit(_attempt, s): s for s in candidates}
             pending = set(futs)
             deadline = _time.monotonic() + self.recover_fetch_deadline
+            cap = self.recover_holder_timeout
             try:
                 while pending and have < DATA_SHARDS_COUNT:
-                    budget = deadline - _time.monotonic()
+                    now = _time.monotonic()
+                    for fut in list(pending):
+                        sid = futs[fut]
+                        t0s = started.get(sid)
+                        if t0s is not None and now - t0s >= cap and not fut.done():
+                            # running past the per-holder cap: wedged.
+                            # Suspect it, remember the blocked thread, and
+                            # stop waiting on it (the read may still
+                            # complete from the other survivors).
+                            pending.discard(fut)
+                            self._mark_holder_suspect(sid)
+                            self._track_wedged(sid, fut)
+                            stripe._abandon_future(fut)
+                    if not pending:
+                        break
+                    budget = deadline - now
                     if budget <= 0:
                         break
+                    next_cap = min(
+                        (started[futs[f]] + cap - now
+                         for f in pending if futs[f] in started),
+                        default=None,
+                    )
+                    if next_cap is not None:
+                        budget = min(budget, max(next_cap, 0.005))
                     done, pending = wait(
                         pending, timeout=budget, return_when=FIRST_COMPLETED
                     )
-                    if not done:
-                        break
                     for fut in done:
                         try:
                             raw = fut.result()
@@ -330,12 +472,24 @@ class EcVolume:
                         if raw is not None and len(raw) == size:
                             shards[futs[fut]] = np.frombuffer(raw, dtype=np.uint8).copy()
                             have += 1
+                        else:
+                            # slow NOTHING = internally-timed-out wedge
+                            # (see _remote_fetch_capped); fast None is a
+                            # plain miss and never suspects
+                            sid = futs[fut]
+                            t0s = started.get(sid)
+                            if (
+                                t0s is not None
+                                and _time.monotonic() - t0s
+                                >= self.recover_suspect_after
+                            ):
+                                self._mark_holder_suspect(sid)
             finally:
                 # EVERY exit (normal, deadline, or an exception raised
                 # mid-loop) cancels what never started and drains what did:
                 # the discard callback drops a late result/exception on the
                 # floor so a hung peer's thread never outlives the read with
-                # a reference to its buffer (or an unobserved error)
+                # a reference to its buffer (or an unobserved error).
                 for fut in pending:
                     stripe._abandon_future(fut)
         if have < DATA_SHARDS_COUNT:

@@ -20,11 +20,27 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 from seaweedfs_tpu.ops import gf8
+
+#: committed on-chip measurement evidence older than this many days no
+#: longer flips the auto backend away from its conservative default: the
+#: kernels under measurement keep changing round to round, so an ancient
+#: number says nothing about today's binary.
+EVIDENCE_MAX_AGE_DAYS = float(os.environ.get("WEEDTPU_EVIDENCE_MAX_AGE_DAYS", "120"))
+
+#: the staged fused-kernel family (rs_pallas re-exports this as VARIANTS
+#: and asserts its kernel table matches). Lives HERE, jax-free, so
+#: evidence parsing (parse_fused_variant — called from bench's parent
+#: process, which must never import jax: a jax import can wedge the
+#: single-client TPU tunnel) needs no rs_pallas/jax import.
+FUSED_VARIANTS = ("int8", "bf16", "u8", "mplane", "dma")
+
+_BACKENDS = ("numpy", "native", "jax", "pallas")
 
 #: LRU cap on cached decode matrices. A long-lived volume server whose
 #: shard-loss patterns churn (peers flapping, rolling repairs) sees an
@@ -92,6 +108,8 @@ class Encoder:
         parity_shards: int = 4,
         matrix_kind: str = "vandermonde",
         backend: str = "numpy",
+        pallas_mxu: str = "int8",
+        pallas_tile: Optional[int] = None,
     ):
         if data_shards <= 0 or parity_shards <= 0:
             raise ValueError("shard counts must be positive")
@@ -100,13 +118,20 @@ class Encoder:
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
-        if backend not in ("numpy", "native", "jax", "pallas"):
+        if backend not in _BACKENDS:
             raise ValueError(
-                f"unknown backend {backend!r} "
-                "(want 'numpy', 'native', 'jax' or 'pallas')"
+                f"unknown backend {backend!r} (want one of {_BACKENDS})"
             )
         self.matrix_kind = matrix_kind
         self.backend = backend
+        # fused-kernel variant config (pallas backend only): which staged
+        # kernel (rs_pallas.VARIANTS) and tile the dispatches use — set by
+        # new_encoder("auto") from the winning committed measurement
+        self.pallas_mxu = pallas_mxu
+        self.pallas_tile = pallas_tile
+        #: how this encoder's backend was chosen (new_encoder fills it;
+        #: direct construction is an explicit choice)
+        self.selection: dict = {"backend": backend, "source": "explicit"}
         self.gen_matrix = gf8.generator_matrix(matrix_kind, data_shards, self.total_shards)
         self.parity_matrix = np.ascontiguousarray(self.gen_matrix[data_shards:])
 
@@ -123,7 +148,10 @@ class Encoder:
         if self.backend == "pallas":
             from seaweedfs_tpu.ops import rs_pallas
 
-            return rs_pallas.apply_matrix(m, shards, donate=donate)
+            return rs_pallas.apply_matrix(
+                m, shards, tile=self.pallas_tile, mxu=self.pallas_mxu,
+                donate=donate,
+            )
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_jax
 
@@ -419,6 +447,193 @@ def _cpu_backend() -> str:
         return "numpy"
 
 
+# -- on-chip measurement evidence (the auto-backend decision input) ----------
+
+
+def _artifacts_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "artifacts",
+    )
+
+
+def load_device_evidence(art_dir: Optional[str] = None) -> Optional[dict]:
+    """Newest committed `DEVICE_MEASUREMENT_r*.json` (lexically latest
+    round), with `_file` recording its provenance. None when no readable
+    measurement artifact exists."""
+    art_dir = art_dir or _artifacts_dir()
+    try:
+        names = sorted(
+            f
+            for f in os.listdir(art_dir)
+            if f.startswith("DEVICE_MEASUREMENT_") and f.endswith(".json")
+        )
+    except OSError:
+        return None
+    for name in reversed(names):
+        try:
+            import json
+
+            with open(os.path.join(art_dir, name), encoding="utf-8") as f:
+                ev = json.load(f)
+            if isinstance(ev, dict):
+                ev["_file"] = name
+                return ev
+        except (OSError, ValueError):
+            continue  # an unreadable newest artifact must not hide older ones
+    return None
+
+
+def _evidence_age_days(ev: dict) -> Optional[float]:
+    """Days since the measurement's `when` stamp; None when unparseable."""
+    import datetime
+
+    when = str(ev.get("when", ""))
+    for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%MZ", "%Y-%m-%d"):
+        try:
+            t = datetime.datetime.strptime(when, fmt)
+            return (datetime.datetime.utcnow() - t).total_seconds() / 86400.0
+        except ValueError:
+            continue
+    return None
+
+
+def parse_fused_variant(label: str) -> tuple[str, Optional[int]]:
+    """Map a measurement key / sweep variant name to (mxu, tile) kernel
+    config: 'pallas-bf16-16384' -> ('bf16', 16384), 'pallas_tile8192_
+    steady_gbps' -> ('int8', 8192), 'pallas-auto'/'pallas_steady_gbps'
+    -> ('int8', None), 'pallas-dma-65536' -> ('dma', 65536)."""
+    s = label.replace("_steady_gbps", "").replace("_", "-")
+    mxu, tile = "int8", None
+    for tok in s.split("-"):
+        if not tok or tok in ("pallas", "rebuild", "auto"):
+            continue
+        if tok in FUSED_VARIANTS:
+            mxu = tok
+        else:
+            digits = tok[4:] if tok.startswith("tile") else tok
+            if digits.isdigit():
+                tile = int(digits)
+    return mxu, tile
+
+
+def _best_fused(ev: dict) -> tuple[Optional[str], float]:
+    """Best committed fused-kernel ENCODE number in a measurement dict:
+    scans both the stage-1 `pallas*_steady_gbps` keys and the assembled
+    sweep section (`sweep.encode`: variant name -> steady GB/s). Rebuild-
+    path numbers never pick the encode backend."""
+    best_label, best = None, 0.0
+    for k, v in ev.items():
+        if (
+            k.startswith("pallas")
+            and k.endswith("_steady_gbps")
+            and isinstance(v, (int, float))
+            and v > best
+        ):
+            best_label, best = k, float(v)
+    sweep = ev.get("sweep") or {}
+    for name, v in (sweep.get("encode") or {}).items():
+        if (
+            str(name).startswith("pallas")
+            and isinstance(v, (int, float))
+            and v > best
+        ):
+            best_label, best = str(name), float(v)
+    return best_label, best
+
+
+def pick_device_backend(art_dir: Optional[str] = None) -> tuple[str, dict]:
+    """The auto-backend decision ON TPU: flip to the fused Pallas kernel
+    ONLY when a committed on-chip measurement shows a fused variant
+    beating the XLA steady-state; otherwise the XLA bit-plane path. The
+    returned decision dict (also exported through stats and reported by
+    bench.py) names the evidence file, both numbers, and the reason, so
+    the selection is auditable rather than folklore."""
+    ev = load_device_evidence(art_dir)
+    if ev is None:
+        return "jax", {
+            "backend": "jax",
+            "reason": "no committed on-chip measurement evidence",
+        }
+    decision: dict = {"evidence_file": ev.get("_file")}
+    xla = ev.get("xla_steady_gbps") or 0.0
+    rm = ev.get("remeasured") or {}
+    if isinstance(rm, dict) and rm.get("xla_steady_gbps"):
+        xla = max(xla, rm["xla_steady_gbps"])
+    # a sweep-only assembly (watch fired the sweep but the window worker
+    # never ran — the short-tunnel case the incremental harvest exists
+    # for) carries its XLA anchor in the sweep table, not stage-1 keys
+    sweep_xla = ((ev.get("sweep") or {}).get("encode") or {}).get("xla")
+    if isinstance(sweep_xla, (int, float)):
+        xla = max(xla, sweep_xla)
+    label, fused = _best_fused(ev)
+    decision["xla_steady_gbps"] = xla
+    decision["fused_steady_gbps"] = fused or None
+    decision["fused_variant"] = label
+    age = _evidence_age_days(ev)
+    if "tpu" not in str(ev.get("platform", "")).lower():
+        decision.update(backend="jax", reason="evidence is not an on-chip measurement")
+        return "jax", decision
+    if age is None:
+        # conservative default: evidence whose age cannot be established
+        # must not flip production (a hand-edited or malformed `when`
+        # would otherwise count as fresh forever)
+        decision.update(
+            backend="jax",
+            reason=f"evidence age unparseable (when={ev.get('when')!r}): treated as stale",
+        )
+        return "jax", decision
+    if age > EVIDENCE_MAX_AGE_DAYS:
+        decision.update(
+            backend="jax",
+            reason=f"evidence stale ({age:.0f}d > {EVIDENCE_MAX_AGE_DAYS:.0f}d)",
+        )
+        return "jax", decision
+    if label is not None and xla and fused > xla:
+        mxu, tile = parse_fused_variant(label)
+        decision.update(
+            backend="pallas",
+            pallas_mxu=mxu,
+            pallas_tile=tile,
+            reason=f"committed on-chip {label}={fused} beats xla_steady={xla}",
+        )
+        return "pallas", decision
+    decision.update(
+        backend="jax",
+        reason=(
+            f"no fused number beats xla_steady={xla}"
+            if xla
+            else "evidence lacks an XLA steady-state to beat"
+        ),
+    )
+    return "jax", decision
+
+
+def _export_selection(selection: dict) -> None:
+    """Mirror the factory's decision into the Prometheus registry: the
+    previously-selected label (if any) drops to 0 so a scrape shows ONE
+    current backend (read-modify-write under a lock: concurrent factories
+    must not leave two label-sets at 1)."""
+    try:
+        from seaweedfs_tpu import stats
+
+        global _last_selection_labels
+        backend = str(selection.get("backend", ""))
+        source = str(selection.get("source", ""))
+        with _selection_lock:
+            prev = _last_selection_labels
+            if prev is not None and prev != (backend, source):
+                stats.EcBackendSelected.labels(*prev).set(0)
+            stats.EcBackendSelected.labels(backend, source).set(1)
+            _last_selection_labels = (backend, source)
+    except Exception:  # noqa: BLE001 — metrics must never break the factory
+        pass
+
+
+_last_selection_labels: Optional[tuple] = None
+_selection_lock = threading.Lock()
+
+
 def new_encoder(
     data_shards: int = 10,
     parity_shards: int = 4,
@@ -430,14 +645,29 @@ def new_encoder(
     backend: "auto" picks the measured-fastest device path on TPU, the XLA
     path on other accelerators, and the C++ AVX2 library (numpy if it can't
     load) on plain CPU — the reference's SIMD role; explicit values force a
-    path.
+    path. `WEEDTPU_BACKEND` overrides an "auto" request (operator seam;
+    explicit callers are never overridden).
 
-    On TPU, auto resolves to the XLA bit-plane path: on-chip measurement
-    (artifacts/DEVICE_MEASUREMENT_r04.json) has XLA at 31-32 GB/s steady
-    vs the fused Pallas kernel's 18.7. Production must never select the
-    slower kernel; flip this back only with a newer committed measurement
-    where Pallas wins. backend="pallas" still forces the fused kernel.
+    On TPU the decision is EVIDENCE-BASED: `pick_device_backend` reads the
+    newest committed `artifacts/DEVICE_MEASUREMENT_r*.json` and flips to
+    the fused Pallas kernel (with the winning variant's tile/mxu config)
+    only when a committed on-chip steady-state number beats the XLA path's;
+    absent, stale, or losing evidence keeps the measured-safe XLA default
+    (r4 numbers: XLA 31-32 GB/s vs fused 18.7). The decision lands on
+    `encoder.selection`, in the `weedtpu_ec_backend_selected` stats gauge,
+    and in bench.py output. backend="pallas" still forces the fused kernel.
     """
+    selection: dict = {"requested": backend}
+    pallas_kwargs: dict = {}
+    if backend == "auto":
+        env = os.environ.get("WEEDTPU_BACKEND", "").strip().lower()
+        if env and env != "auto":
+            if env not in _BACKENDS:
+                raise ValueError(
+                    f"WEEDTPU_BACKEND={env!r} is not one of {('auto',) + _BACKENDS}"
+                )
+            backend = env
+            selection.update(backend=backend, source="env:WEEDTPU_BACKEND")
     if backend == "auto":
         try:
             import jax
@@ -449,11 +679,45 @@ def new_encoder(
             honor_platform_env()
             d = jax.devices()[0]
             if is_tpu_device(d):
-                backend = "jax"
+                backend, decision = pick_device_backend()
+                selection.update(decision)
+                # provenance must be honest: absent evidence is a default,
+                # not an evidence-based decision
+                selection["source"] = (
+                    "on-chip-evidence"
+                    if decision.get("evidence_file")
+                    else "tpu-default-no-evidence"
+                )
+                if backend == "pallas":
+                    pallas_kwargs = {
+                        "pallas_mxu": decision.get("pallas_mxu", "int8"),
+                        "pallas_tile": decision.get("pallas_tile"),
+                    }
             elif d.platform != "cpu":
                 backend = "jax"
+                selection.update(
+                    backend="jax", source="platform",
+                    reason=f"non-TPU accelerator ({d.platform}): XLA path",
+                )
             else:
                 backend = _cpu_backend()
+                selection.update(
+                    backend=backend, source="platform",
+                    reason="cpu host: AVX2 library when loadable, else numpy",
+                )
         except Exception:
             backend = _cpu_backend()
-    return Encoder(data_shards, parity_shards, matrix_kind=matrix_kind, backend=backend)
+            selection.update(
+                backend=backend, source="platform",
+                reason="no jax backend: cpu fallback",
+            )
+    else:
+        selection.setdefault("backend", backend)
+        selection.setdefault("source", "explicit")
+    enc = Encoder(
+        data_shards, parity_shards, matrix_kind=matrix_kind, backend=backend,
+        **pallas_kwargs,
+    )
+    enc.selection = selection
+    _export_selection(selection)
+    return enc

@@ -20,6 +20,30 @@ HBM traffic is exactly C+R bytes/byte-position — the algorithmic minimum —
 vs ~(9C + 5R) for the unfused path. Replaces the reference codec's AVX2/GFNI
 galois kernels (klauspost/reedsolomon galois_gen_amd64.s [VERIFY: mount
 empty]) as SURVEY.md §2.2 prescribes.
+
+The kernel is a staged FAMILY of variants (ROOFLINE_r05.md verification
+plan; all byte-exact vs the gf8 golden, all Mosaic-lowering-proven via
+tpu_lowering.PROOF_SHAPES, selected by the `mxu` argument):
+
+  int8    the r5 baseline: int8 plane lift, 8 arithmetic shift+mask
+          unpacks, one (R*8, C*8) int8 MXU matmul.
+  bf16    same unpack, bf16 MXU matmul (exact: partial sums <= 80 < 256).
+  u8      shift-free unpack — bit j is extracted as a mask+compare
+          ((x & (1<<j)) != 0; bit 7 = sign test) instead of the 8-deep
+          arithmetic-shift chain; the tile is reinterpreted int8 once
+          (width-preserving — Mosaic has no uint8 elementwise lowerings
+          on this toolchain) but is never widened or shifted
+          (ROOFLINE hyp 1: the shift+mask chain is VPU-bound).
+  mplane  multi-plane ACCUMULATION: 8 small K=C matmuls, one per bit
+          plane, summed into a single int32 accumulator — the (8C, T)
+          concatenated bit matrix is never materialized in VMEM, cutting
+          the unpack working set 8x and folding all 8 planes of the
+          lifted Cauchy/Vandermonde matrix into one grid pass.
+  dma     manual DOUBLE-BUFFERED tile DMA: the data operand stays in HBM
+          (pl.ANY) and the kernel streams (C, chunk) sub-tiles through a
+          2-slot VMEM scratch ring with make_async_copy, overlapping the
+          HBM load of chunk k+1 with the MXU/VPU work on chunk k inside
+          one big grid step (ROOFLINE hyp 4: per-grid-step overhead).
 """
 
 from __future__ import annotations
@@ -50,6 +74,13 @@ DEFAULT_VMEM_BUDGET = 8 << 20
 _TILE_STEPS = (65536, 49152, 32768, 24576, 16384, 8192, 4096, 2048, 1024, 512, 256, 128)
 
 
+#: bytes of one DMA chunk for the `dma` variant — the unit the manual
+#: double buffer streams through VMEM. Small enough that two slots plus
+#: the per-chunk bit expansion stay far under budget, large enough that
+#: each chunk's matmul amortizes the copy-start overhead.
+DMA_CHUNK = 2048
+
+
 def auto_tile(
     c: int, rows: int, mxu: str = "int8", vmem_budget: int = DEFAULT_VMEM_BUDGET
 ) -> int:
@@ -57,9 +88,20 @@ def auto_tile(
 
     Working set per byte-position of tile: data window (double-buffered,
     2C) + bit-plane expansion (8C at the MXU dtype's width) + int32
-    accumulator (32R) + output window (double-buffered, 2R)."""
+    accumulator (32R) + output window (double-buffered, 2R). The `mplane`
+    variant never materializes the concatenated planes (one C-wide plane
+    at a time); the `dma` variant's data working set is the 2-slot chunk
+    ring, not the tile, so both can plan much larger tiles."""
     bits_width = 2 if mxu == "bf16" else 1
-    per_byte = 2 * c + 8 * c * bits_width + 32 * rows + 2 * rows
+    if mxu == "mplane":
+        # one (C, T) plane live at a time instead of the (8C, T) stack
+        per_byte = 2 * c + 2 * c + 32 * rows + 2 * rows
+    elif mxu == "dma":
+        # per-TILE-byte cost is just the output window + accumulator
+        # amortization; the chunk ring is a constant (2*C*DMA_CHUNK)
+        per_byte = 32 * rows + 2 * rows + 1
+    else:
+        per_byte = 2 * c + 8 * c * bits_width + 32 * rows + 2 * rows
     cap = max(128, vmem_budget // per_byte)
     for t in _TILE_STEPS:
         if t <= cap:
@@ -128,7 +170,140 @@ def _kernel_bf16(b_ref, data_ref, out_ref):
     out_ref[0] = out.astype(jnp.uint8)
 
 
-_KERNELS = {"int8": _kernel, "bf16": _kernel_bf16}
+def _pack_planes(acc):
+    """(8*R, T) int32 plane-major 0/1 rows -> (R, T) uint8 bytes.
+
+    With plane-major rows each plane is a CONTIGUOUS (R, T) block
+    (sublane stride 1); a byte-major pack would read with sublane
+    stride 8, which Mosaic lowers to per-sublane shuffles."""
+    rows8, t = acc.shape
+    acc3 = acc.reshape(8, rows8 // 8, t)
+    out = acc3[0]
+    for i in range(1, 8):
+        out = out | (acc3[i] << i)
+    return out.astype(jnp.uint8)
+
+
+def _kernel_u8(b_ref, data_ref, out_ref):
+    """Shift-free unpack: bit j extracted as a VPU mask+compare
+    ((x & (1<<j)) != 0; bit 7 is the sign test x < 0) instead of the
+    8-deep arithmetic-shift chain of `_kernel` (ROOFLINE_r05 hyp 1: the
+    shift+mask unpack is the VPU-bound stage). The tile is reinterpreted
+    int8 ONCE — a width-preserving convert, not a plane lift; it exists
+    only because Mosaic on this toolchain has NO uint8 elementwise
+    lowerings at all (`and`/`shift`/`compare` on u8 all raise
+    NotImplementedError — probed r6), so the mask ops must run on int8
+    lanes. Same bytes, no VMEM inflation, zero shifts."""
+    di = data_ref[0].astype(jnp.int8)  # (C, T) reinterpret, not a widen
+    planes = [(di & jnp.int8(1 << j)) != 0 for j in range(7)]
+    planes.append(di < 0)  # bit 7 == int8 sign
+    bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        b_ref[...],
+        bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out_ref[0] = _pack_planes(acc & 1)
+
+
+def _kernel_mplane(b_ref, data_ref, out_ref):
+    """Multi-plane accumulation: instead of materializing the (8C, T)
+    concatenated bit matrix and one K=8C matmul, run 8 small K=C matmuls
+    — one per bit plane of the lifted matrix (B's columns are plane-major,
+    so plane j is the contiguous column block [j*C, (j+1)*C)) — summed
+    into ONE int32 accumulator. All 8 planes fold into a single grid
+    pass with an 8x smaller unpack working set; mod-2 commutes with the
+    sum (acc = sum_j B_j @ bits_j over Z, & 1 at the end)."""
+    data = data_ref[0]
+    c, _t = data.shape
+    di = data.astype(jnp.int8)  # int8 shifts: see _kernel
+    acc = None
+    for j in range(8):
+        plane = (di >> j) & 1  # (C, T) int8 — one plane live at a time
+        part = jax.lax.dot_general(
+            b_ref[:, j * c : (j + 1) * c],
+            plane,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        acc = part if acc is None else acc + part
+    out_ref[0] = _pack_planes(acc & 1)
+
+
+def _make_dma_kernel(chunk: int):
+    """Manual double-buffered HBM->VMEM streaming: the data operand stays
+    in HBM (pl.ANY BlockSpec) and the kernel DMAs (C, chunk) sub-tiles
+    into a 2-slot VMEM scratch ring, starting the copy of chunk k+1
+    before computing on chunk k — HBM loads overlap MXU/VPU work inside
+    one large grid step instead of relying on Mosaic's window pipelining
+    across many small steps (ROOFLINE_r05 hyp 4)."""
+
+    def kernel(b_ref, data_ref, out_ref):
+        bi = pl.program_id(0)
+        ti = pl.program_id(1)
+        c = data_ref.shape[1]
+        tile = out_ref.shape[2]
+        nchunks = tile // chunk
+
+        def body(scratch, sem):
+            def chunk_dma(slot, k):
+                return pltpu.make_async_copy(
+                    data_ref.at[bi, :, pl.ds(ti * tile + k * chunk, chunk)],
+                    scratch.at[slot],
+                    sem.at[slot],
+                )
+
+            chunk_dma(0, 0).start()
+
+            def loop(k, carry):
+                slot = k % 2
+
+                @pl.when(k + 1 < nchunks)
+                def _():
+                    chunk_dma((k + 1) % 2, k + 1).start()
+
+                chunk_dma(slot, k).wait()
+                di = scratch[slot].astype(jnp.int8)
+                bits = jnp.concatenate(
+                    [((di >> j) & 1) for j in range(8)], axis=0
+                )
+                acc = jax.lax.dot_general(
+                    b_ref[...],
+                    bits,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+                out_ref[0, :, pl.ds(k * chunk, chunk)] = _pack_planes(acc & 1)
+                return carry
+
+            jax.lax.fori_loop(0, nchunks, loop, 0)
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((2, c, chunk), jnp.uint8),
+            sem=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    return kernel
+
+
+_KERNELS = {
+    "int8": _kernel,
+    "bf16": _kernel_bf16,
+    "u8": _kernel_u8,
+    "mplane": _kernel_mplane,
+    "dma": None,  # built per tile/chunk by _make_dma_kernel
+}
+
+#: the staged fused-kernel family, in sweep order. The canonical name
+#: tuple lives jax-free in rs_codec (evidence parsing in bench's parent
+#: must not import this module); the kernel table here must match it.
+from seaweedfs_tpu.ops.rs_codec import FUSED_VARIANTS as VARIANTS  # noqa: E402
+
+assert tuple(_KERNELS) == VARIANTS, (
+    f"kernel table {tuple(_KERNELS)} drifted from rs_codec.FUSED_VARIANTS {VARIANTS}"
+)
 
 
 def _plane_major_columns(b_bits: np.ndarray) -> np.ndarray:
@@ -149,6 +324,15 @@ def _on_tpu() -> bool:
     return is_tpu_device(jax.devices()[0])
 
 
+def _dma_chunk(tile: int) -> int:
+    """Largest chunk <= DMA_CHUNK dividing the tile (tiles are always
+    multiples of 128, so 128 is the floor)."""
+    for ch in (DMA_CHUNK, 1024, 512, 256, 128):
+        if ch <= tile and tile % ch == 0:
+            return ch
+    return 128
+
+
 def _apply_padded_impl(b_pm, data, tile: int, interpret: bool, mxu: str):
     batch, c, n = data.shape
     rows = b_pm.shape[0] // 8
@@ -163,12 +347,21 @@ def _apply_padded_impl(b_pm, data, tile: int, interpret: bool, mxu: str):
         kwargs["compiler_params"] = params_cls(
             dimension_semantics=("parallel", "parallel")
         )
+    if mxu == "dma":
+        # the data operand never gets a Mosaic-managed VMEM window: it
+        # stays in HBM and the kernel streams it through its own 2-slot
+        # scratch ring (chunk k+1's copy overlaps chunk k's compute)
+        kernel = _make_dma_kernel(_dma_chunk(tile))
+        data_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    else:
+        kernel = _KERNELS[mxu]
+        data_spec = pl.BlockSpec((1, c, tile), lambda b, i: (b, 0, i))
     return pl.pallas_call(
-        _KERNELS[mxu],
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((b_pm.shape[0], b_pm.shape[1]), lambda b, i: (0, 0)),
-            pl.BlockSpec((1, c, tile), lambda b, i: (b, 0, i)),
+            data_spec,
         ],
         out_specs=pl.BlockSpec((1, rows, tile), lambda b, i: (b, 0, i)),
         out_shape=jax.ShapeDtypeStruct((batch, rows, n), jnp.uint8),
@@ -247,8 +440,9 @@ def gf_apply_fused(
     zero bytes, so padding never corrupts real lanes). Off-TPU the kernel
     runs in Pallas interpret mode so the exact kernel logic stays testable
     on the CPU mesh. tile=None picks the largest tile whose working set
-    fits the VMEM budget (`auto_tile`); mxu selects the matmul dtype
-    ("int8" or the exact-by-range "bf16" variant).
+    fits the VMEM budget (`auto_tile`); mxu selects the staged kernel
+    variant (`VARIANTS`: "int8", "bf16", "u8", "mplane", "dma" — see the
+    module docstring for strategies).
     """
     return _apply_pm(_lifted_plane_major(b_bits), data, tile, mxu)
 

@@ -38,6 +38,19 @@ PROOF_SHAPES = (
     {"name": "encode_10p4_tile32768", "rows": 4, "cols": 10, "tile": 32768, "batch": 4},
     {"name": "encode_10p4_tile24576_bf16", "rows": 4, "cols": 10, "tile": 24576,
      "batch": 4, "mxu": "bf16"},
+    # the r6 staged variants (ROOFLINE_r05 verification plan): uint8-native
+    # unpack, multi-plane accumulation, and the manual double-buffered DMA
+    # streamer — each must lower through Mosaic BEFORE the sweep ever
+    # dispatches it, or the first tunnel-alive window burns its budget on
+    # compile failures instead of measurements
+    {"name": "encode_10p4_tile32768_u8", "rows": 4, "cols": 10, "tile": 32768,
+     "batch": 4, "mxu": "u8"},
+    {"name": "encode_10p4_tile32768_mplane", "rows": 4, "cols": 10, "tile": 32768,
+     "batch": 4, "mxu": "mplane"},
+    {"name": "encode_10p4_tile65536_dma", "rows": 4, "cols": 10, "tile": 65536,
+     "batch": 4, "mxu": "dma"},
+    {"name": "reconstruct_4from10_tile32768_dma", "rows": 4, "cols": 10,
+     "tile": 32768, "batch": 1, "mxu": "dma"},
     {"name": "reconstruct_4from10_tile8192", "rows": 4, "cols": 10, "tile": 8192, "batch": 1},
     {"name": "reconstruct_10from10_tile8192", "rows": 10, "cols": 10, "tile": 8192, "batch": 1},
     {"name": "small_read_tile128", "rows": 4, "cols": 10, "tile": 128, "batch": 1},

@@ -34,6 +34,7 @@ from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from seaweedfs_tpu.ops import rs_jax
+from seaweedfs_tpu.parallel import shard_map
 from seaweedfs_tpu.parallel.sharded import matrix_bits, pad_survivor_matrix, place_survivors
 
 
@@ -58,7 +59,7 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("dp", "sp", None),),
         out_specs=P("dp", None, "sp"),

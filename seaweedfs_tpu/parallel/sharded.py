@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from seaweedfs_tpu.ops import gf8, rs_jax
+from seaweedfs_tpu.parallel import shard_map
 
 
 def matrix_bits(m: np.ndarray) -> jax.Array:
@@ -74,7 +75,7 @@ def make_encode_fn(mesh: Mesh, parity_m: np.ndarray):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec,),
         out_specs=spec,
@@ -93,7 +94,7 @@ def make_apply_fn(mesh: Mesh, matrix: np.ndarray):
     spec = P("dp", None, "sp")
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
     def apply(survivors):
         return rs_jax.gf_apply(b_bits, survivors)
 
@@ -119,7 +120,7 @@ def make_ec_cycle_fn(mesh: Mesh, parity_m: np.ndarray, recon_m: np.ndarray, lost
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec,),
         out_specs=(spec, P()),
@@ -206,7 +207,7 @@ def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("dp", "sp", None),),
         out_specs=P("dp", None, "sp"),

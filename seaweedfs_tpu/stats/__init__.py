@@ -263,6 +263,12 @@ EcRebuildRemoteBytes = REGISTRY.counter(
     "weedtpu_ec_rebuild_remote_bytes_total",
     "survivor bytes fetched from peer holders by distributed rebuilds",
 )
+EcBackendSelected = REGISTRY.gauge(
+    "weedtpu_ec_backend_selected",
+    "codec backend chosen by new_encoder (1 = currently selected; source "
+    "says why: on-chip-evidence, platform, env:WEEDTPU_BACKEND, explicit)",
+    ("backend", "source"),
+)
 VolumeServerVolumeGauge = REGISTRY.gauge(
     "weedtpu_volume_server_volumes", "volumes hosted", ("type",)
 )

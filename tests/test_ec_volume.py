@@ -263,9 +263,15 @@ def test_recover_fetches_survivors_in_parallel(volume, tmp_path):
     # interval — that ladder is not under test); each interval on the
     # missing shard additionally pays the recover fan-out, which parallel
     # costs <=2 waves (~120 ms) but serial costs 6 survivors x 60 ms.
+    # Since r6 the direct attempt rides the fetch pool (per-holder cap),
+    # adding a thread-scheduling hop per interval — budget it as fixed
+    # slack (NOT extra RTTs: the total must stay under the serial floor
+    # so a serialized fan-out still fails this test).
     rtt = 0.06
-    parallel_budget = rtt * (n_intervals + 3 * n_on_missing)
+    sched_slack = 0.02 * n_intervals
+    parallel_budget = rtt * (n_intervals + 3 * n_on_missing) + sched_slack
     serial_floor = rtt * (n_intervals + 6 * n_on_missing)
+    assert parallel_budget < serial_floor - rtt  # budget still discriminates
     assert dt < min(parallel_budget, serial_floor - rtt), (
         f"degraded reads took {dt:.2f}s over {n_intervals} intervals "
         f"({n_on_missing} reconstructing) — fan-out looks serial"
@@ -308,3 +314,212 @@ def test_recover_tolerates_hung_and_failing_peers(volume, tmp_path):
         _, _, rec = records[nid]
         assert ev.read_needle_blob(nid)[: len(rec)] == rec
         assert time.monotonic() - t0 < 3.0, "read waited on the hung peer"
+
+
+def test_wedged_holder_per_holder_cap_and_deadline(volume, tmp_path):
+    """SIGSTOP-style chaos: a WEDGED holder (neither answers nor errors —
+    the semantics of a SIGSTOPped volume server) that the reconstruct
+    NEEDS must cost exactly one per-holder-capped wait, not the overall
+    deadline and never a hang; afterwards the holder sits in the
+    suspicion window."""
+    import threading
+    import time
+
+    base, records = volume
+    remote_dir = tmp_path / "remote"
+    remote_dir.mkdir()
+    # 4 local shards (10-13), 10 remote; the target's remote copy is gone,
+    # three more remote copies are gone, and one remote holder is wedged —
+    # leaving exactly 9 fast survivors (4 local + 5 remote) + the wedged
+    # one, so reconstruction NEEDS the wedged holder to reach 10
+    for s in range(10):
+        shutil.move(stripe.shard_file_name(base, s), remote_dir / f"v7.ec{s:02d}")
+    for s in (0, 1, 2, 4):
+        os.remove(remote_dir / f"v7.ec{s:02d}")
+    wedge = threading.Event()
+
+    def remote(shard_id, offset, size):
+        if shard_id == 3:
+            wedge.wait(30.0)  # SIGSTOPped: no answer, no error
+            return None
+        p = remote_dir / f"v7.ec{shard_id:02d}"
+        if not p.exists():
+            return None
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    try:
+        with open_vol(
+            base,
+            remote_reader=remote,
+            recover_fetch_parallelism=16,
+            recover_fetch_deadline=30.0,
+            recover_holder_timeout=0.6,
+            recover_holder_backoff=60.0,
+        ) as ev:
+            # only needles with an interval ON the lost shard reconstruct
+            on_missing = [
+                nid
+                for nid in records
+                if any(
+                    iv.to_shard_id_and_offset(LARGE, SMALL)[0] == 0
+                    for iv in ev.locate_needle(nid)[2]
+                )
+            ]
+            assert on_missing, "fixture should place intervals on shard 0"
+            t0 = time.monotonic()
+            with pytest.raises(IOError, match="surviving"):
+                ev.read_needle_blob(on_missing[0])
+            dt = time.monotonic() - t0
+            # the per-holder cap cut the wedged holder — NOT the 30 s
+            # overall deadline, and no unbounded wait
+            assert 0.5 <= dt < 5.0, f"expected ~0.6s per-holder cap, took {dt:.2f}s"
+            assert ev._holder_suspected(3), "wedged holder must enter the suspicion window"
+            # while suspected, the fan-out skips the wedged holder outright:
+            # the next read fails FAST instead of re-paying the cap
+            t0 = time.monotonic()
+            with pytest.raises(IOError, match="surviving"):
+                ev.read_needle_blob(on_missing[-1])
+            assert time.monotonic() - t0 < 0.4, "suspected holder was re-waited on"
+    finally:
+        wedge.set()
+
+
+def test_internally_timed_out_reader_marks_suspect(volume, tmp_path):
+    """Production remote readers carry their own transport timeout and
+    report a wedged peer as a SLOW None — the ladder must read that
+    slow-nothing signature as suspicion (without the hard cap firing),
+    while a fast None (shard simply absent) never suspects."""
+    import time
+
+    base, records = volume
+    remote_dir = tmp_path / "remote"
+    remote_dir.mkdir()
+    for s in range(10):
+        shutil.move(stripe.shard_file_name(base, s), remote_dir / f"v7.ec{s:02d}")
+
+    def remote(shard_id, offset, size):
+        if shard_id == 0:
+            time.sleep(0.6)  # internal transport timeout swallowed a wedge
+            return None
+        p = remote_dir / f"v7.ec{shard_id:02d}"
+        if not p.exists():
+            return None  # fast miss
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    with open_vol(
+        base,
+        remote_reader=remote,
+        recover_fetch_parallelism=16,
+        recover_fetch_deadline=10.0,
+        recover_holder_timeout=30.0,  # hard cap never fires here
+        recover_suspect_after=0.3,
+        recover_holder_backoff=60.0,
+    ) as ev:
+        for nid, (off, size, rec) in records.items():
+            assert ev.read_needle_blob(nid)[: len(rec)] == rec
+        assert ev._holder_suspected(0), "slow-None holder not suspected"
+        assert not any(ev._holder_suspected(s) for s in range(1, 14)), (
+            "a fast miss or healthy holder was suspected"
+        )
+
+
+def test_slow_but_healthy_holders_use_full_deadline(volume, tmp_path):
+    """The per-holder cap must not collapse the OVERALL deadline: holders
+    that answer slower than the cap-wait granularity but well within the
+    configured `recover_fetch_deadline` still serve the read, and none of
+    them is marked suspect (slow is not wedged)."""
+    import threading
+    import time
+
+    base, records = volume
+    remote_dir = tmp_path / "remote"
+    remote_dir.mkdir()
+    for s in range(10):
+        shutil.move(stripe.shard_file_name(base, s), remote_dir / f"v7.ec{s:02d}")
+    for s in (0, 1, 2, 4):
+        os.remove(remote_dir / f"v7.ec{s:02d}")
+
+    def remote(shard_id, offset, size):
+        time.sleep(0.35)  # slower than the 0.2 s cap granularity below
+        p = remote_dir / f"v7.ec{shard_id:02d}"
+        if not p.exists():
+            return None
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    with open_vol(
+        base,
+        remote_reader=remote,
+        recover_fetch_parallelism=16,
+        recover_fetch_deadline=10.0,
+        recover_holder_timeout=2.0,
+        recover_holder_backoff=60.0,
+    ) as ev:
+        for nid, (off, size, rec) in records.items():
+            assert ev.read_needle_blob(nid)[: len(rec)] == rec
+        assert not any(
+            ev._holder_suspected(s) for s in range(14)
+        ), "a slow-but-answering holder was marked suspect"
+
+
+def test_wedged_holder_latency_ladder_holds(volume, tmp_path):
+    """The p50/p99 ladder under a wedged (SIGSTOPped) holder of the READ
+    TARGET's shard: the first degraded read pays one capped direct
+    attempt, marks the holder suspect, and every later read skips it —
+    so p50 stays at reconstruct-path levels and p99 is bounded by the
+    per-holder cap, while every byte still reads back correct."""
+    import threading
+    import time
+
+    base, records = volume
+    remote_dir = tmp_path / "remote"
+    remote_dir.mkdir()
+    # shard 0 lives ONLY on the wedged holder; shards 1-9 healthy remote
+    for s in range(10):
+        shutil.move(stripe.shard_file_name(base, s), remote_dir / f"v7.ec{s:02d}")
+    wedge = threading.Event()
+
+    def remote(shard_id, offset, size):
+        if shard_id == 0:
+            wedge.wait(30.0)  # SIGSTOPped holder of the target shard
+            return None
+        p = remote_dir / f"v7.ec{shard_id:02d}"
+        if not p.exists():
+            return None
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    cap = 0.5
+    try:
+        with open_vol(
+            base,
+            remote_reader=remote,
+            recover_fetch_parallelism=16,
+            recover_fetch_deadline=10.0,
+            recover_holder_timeout=cap,
+            recover_holder_backoff=60.0,
+        ) as ev:
+            lat = []
+            for _ in range(3):  # several passes: p50 must reflect steady state
+                for nid, (off, size, rec) in records.items():
+                    t0 = time.monotonic()
+                    got = ev.read_needle_blob(nid)
+                    lat.append(time.monotonic() - t0)
+                    assert got[: len(rec)] == rec, f"needle {nid} under wedge"
+            assert ev._holder_suspected(0)
+            lat.sort()
+            p50 = lat[len(lat) // 2]
+            p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+            # p50: suspicion makes the steady state one reconstruct, no
+            # capped waits; p99: at most the first read's single capped
+            # attempt (plus reconstruct slack)
+            assert p50 < cap / 2, f"p50 {p50:.3f}s — wedged holder still on the p50 path"
+            assert p99 < cap + 2.0, f"p99 {p99:.3f}s — more than one capped wait leaked in"
+    finally:
+        wedge.set()

@@ -16,6 +16,7 @@ def parity_bits():
     return rs_jax.lifted_matrix(gf8.parity_matrix(10, 4))
 
 
+@pytest.mark.parametrize("mxu", rs_pallas.VARIANTS)
 @pytest.mark.parametrize(
     "shape",
     [
@@ -26,12 +27,49 @@ def parity_bits():
         (1, 10, 3 * 8192),
     ],
 )
-def test_fused_matches_xla(parity_bits, shape):
+def test_fused_matches_xla(parity_bits, shape, mxu):
+    """EVERY staged kernel variant (int8/bf16/u8/mplane/dma) must be
+    byte-exact vs the XLA path across tile-edge and odd-size shapes."""
     rng = np.random.default_rng(7)
     data = rng.integers(0, 256, size=shape, dtype=np.uint8)
-    got = np.asarray(rs_pallas.gf_apply_fused(parity_bits, jnp.asarray(data)))
+    got = np.asarray(rs_pallas.gf_apply_fused(parity_bits, jnp.asarray(data), mxu=mxu))
     want = np.asarray(rs_jax.gf_apply(parity_bits, jnp.asarray(data)))
     assert np.array_equal(got, want)
+
+
+def test_every_variant_in_lowering_proof_shapes():
+    """Each staged variant must be registered in tpu_lowering.PROOF_SHAPES
+    — a variant outside the proof would hit Mosaic for the first time
+    inside a scarce tunnel-alive window."""
+    from seaweedfs_tpu.ops import tpu_lowering
+
+    proven = {s.get("mxu", "int8") for s in tpu_lowering.PROOF_SHAPES}
+    assert proven >= set(rs_pallas.VARIANTS), (
+        f"variants missing from PROOF_SHAPES: {set(rs_pallas.VARIANTS) - proven}"
+    )
+
+
+@pytest.mark.parametrize("mxu", rs_pallas.VARIANTS)
+def test_variant_reconstruction_matrix(parity_bits, mxu):
+    """Every variant must also serve arbitrary decode matrices (the
+    rebuild path) — not just the 4x10 parity shape."""
+    from seaweedfs_tpu.ops.rs_codec import _reconstruction_matrix
+
+    lost = (1, 6, 12, 13)
+    surv = tuple(i for i in range(14) if i not in lost)
+    recon = _reconstruction_matrix("vandermonde", 10, 4, surv, lost)
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, size=(10, 500), dtype=np.uint8)
+    enc = Encoder(10, 4, backend="numpy")
+    shards = np.stack(enc.encode(list(data)))
+    got = np.asarray(rs_pallas.apply_matrix(recon, shards[list(surv)], mxu=mxu))
+    assert np.array_equal(got, shards[list(lost)])
+
+
+def test_dma_chunk_divides_every_tile():
+    for t in rs_pallas._TILE_STEPS:
+        assert t % rs_pallas._dma_chunk(t) == 0
+    assert rs_pallas._dma_chunk(8448) == 256  # non-2048-multiple width
 
 
 def test_fused_reconstruction_matrix(parity_bits):

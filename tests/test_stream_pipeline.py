@@ -307,7 +307,12 @@ def test_warm_decode_matrices_stays_bounded():
 def test_kernel_sweep_smoke_gate():
     """Kernel refactors must not silently break the sweep: the --smoke mode
     runs every encode+rebuild variant byte-exactness gate on tiny shapes
-    under JAX_PLATFORMS=cpu and exits nonzero on any failure."""
+    under JAX_PLATFORMS=cpu (interpret mode) and exits nonzero on any
+    failure. EVERY staged kernel variant (rs_pallas.VARIANTS: int8, bf16,
+    u8, mplane, dma) must appear in the gated set — a variant missing from
+    the sweep would reach its first device window uncompiled."""
+    from seaweedfs_tpu.ops import rs_pallas
+
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "scripts", "kernel_sweep.py"), "--smoke"],
@@ -319,11 +324,18 @@ def test_kernel_sweep_smoke_gate():
     )
     assert proc.returncode == 0, proc.stdout.decode(errors="replace")[-2000:]
     summary = None
+    seen = set()
     for line in proc.stdout.decode(errors="replace").splitlines():
         line = line.strip()
         if line.startswith("{"):
             rec = json.loads(line)
             if "smoke_ok" in rec:
                 summary = rec
+            elif rec.get("variant"):
+                seen.add(rec["variant"])
     assert summary and summary["smoke_ok"], summary
-    assert summary["variants"] >= 8
+    assert summary["variants"] >= 14
+    for mxu in rs_pallas.VARIANTS:
+        tag = "pallas-auto" if mxu == "int8" else f"pallas-{mxu}-auto"
+        assert tag in seen, f"variant {mxu} missing from the smoke gate: {sorted(seen)}"
+    assert any(v.startswith("rebuild-") for v in seen)
