@@ -41,6 +41,7 @@ from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import VolumeReadOnly
 from seaweedfs_tpu.security import tls
+from seaweedfs_tpu.utils import config
 
 _COPY_CHUNK = 1024 * 1024
 _EC_EXTS = [".ecx", ".ecj", ".eci"]
@@ -352,15 +353,40 @@ class VolumeServer:
         """RemoteReader closure for EC degraded reads: cached master
         LookupEcVolume -> pooled VolumeEcShardRead on a holder
         (SURVEY.md §3.2)."""
+        # Peer-identity state for the process-wide suspicion registry.
+        # THREE layers, most-accurate first:
+        #   `attempts` — one PER-CALL token per live read, naming the addr
+        #     that call is inside right now + when it entered. A capped
+        #     timeout fires while the pool thread still sits in the wedged
+        #     holder, so the LONGEST-RUNNING live attempt for the shard is
+        #     exact blame — per-call tokens mean a concurrent fast-failing
+        #     read can neither clobber nor erase a blocked read's entry.
+        #   `slowest` — per shard, the addr that consumed the most wall
+        #     time in the most recent COMPLETED read. The slow-miss signal
+        #     (recover_suspect_after) fires after the read returned; the
+        #     attempt that ate the time is the wedge suspect, NOT whichever
+        #     holder happened to be tried last before the miss.
+        #   `last_locs` — the most recent successful lookup; deliberately
+        #     survives _invalidate_shard_locations (failed reads invalidate
+        #     the SERVING cache, but identity keying must not collapse to
+        #     per-volume scope exactly when a peer goes bad).
+        attempts: dict[object, tuple[int, str, float]] = {}
+        slowest: dict[int, str] = {}
+        last_locs: dict[int, list[str]] = {}
 
         def read(shard_id: int, offset: int, size: int) -> Optional[bytes]:
             try:
                 locs = self._lookup_shard_locations(vid)
             except Exception:  # noqa: BLE001
                 return None
+            last_locs.update(locs)
+            token = object()
+            slow_addr, slow_dur = None, -1.0
             failed = False
             try:
                 for addr in locs.get(shard_id, ()):
+                    t0 = time.monotonic()
+                    attempts[token] = (shard_id, addr, t0)
                     try:
                         chunks = self._peer_pool.get(addr).stream(
                             VOLUME_SERVICE,
@@ -385,12 +411,57 @@ class VolumeServer:
                     except Exception:  # noqa: BLE001 — try next holder
                         self._peer_pool.invalidate(addr)
                         failed = True
+                    finally:
+                        dur = time.monotonic() - t0
+                        if dur > slow_dur:
+                            slow_addr, slow_dur = addr, dur
                 return None
             finally:
+                attempts.pop(token, None)
+                if slow_addr is not None:
+                    slowest[shard_id] = slow_addr
                 if failed:
                     # shards may have moved; next read re-asks the master
                     self._invalidate_shard_locations(vid)
 
+        def peer_for(shard_id: int) -> Optional[str]:
+            """Peer identity behind `shard_id` for suspicion keying —
+            LOCAL-STATE-ONLY (checks run per candidate on the read ladder
+            and must never add a master round-trip). Precedence: the addr
+            the LONGEST-RUNNING live attempt is blocked on, then the addr
+            that consumed the most time in the last completed read, then
+            the primary holder from the last successful lookup. None until
+            this reader has looked up at least once (EcVolume then keys
+            suspicion per-volume, the narrower fallback)."""
+            live = [
+                (started, addr)
+                for (s, addr, started) in list(attempts.values())
+                if s == shard_id
+            ]
+            if live:
+                return min(live)[1]
+            addrs = last_locs.get(shard_id) or ()
+            slow = slowest.get(shard_id)
+            if slow and (not addrs or slow in addrs):
+                # still a listed holder (or no fresher list exists): the
+                # addr that ate the last read's wall time is best blame
+                return slow
+            if addrs:
+                return addrs[0]
+            # this reader never completed a read, but the SERVER may have
+            # the locations cached (serving cache, possibly TTL-stale —
+            # identity doesn't care): without this, a volume's FIRST
+            # degraded read can't see a peer another volume already marked
+            # wedged and pays its own capped attempt anyway
+            with self._shard_locs_lock:
+                hit = self._shard_locs.get(vid)
+            if hit is not None:
+                cached = hit[1].get(shard_id)
+                if cached:
+                    return cached[0]
+            return None
+
+        read.peer_for = peer_for
         return read
 
     def _open_ec_volume(self, vid: int) -> Optional[EcVolume]:
@@ -1110,13 +1181,13 @@ class VolumeServer:
 
     def _rpc_ec_shard_read(self, req: dict, ctx):
         """Stream bytes from one local shard (remote interval reads)."""
-        delay_ms = os.environ.get("WEEDTPU_BENCH_RPC_DELAY_MS", "")
+        delay_ms = config.env("WEEDTPU_BENCH_RPC_DELAY_MS")
         if delay_ms:
             # bench-only network simulation: on a 1-core loopback host the
             # real cost of a remote fetch is CPU, so parallelism cannot
             # show; a server-side sleep models the RTT that dominates real
             # clusters (and releases the GIL, so overlap is measurable)
-            time.sleep(float(delay_ms) / 1e3)
+            time.sleep(delay_ms / 1e3)
         vid = int(req["volume_id"])
         shard_id = int(req["shard_id"])
         offset = int(req["offset"])
@@ -1145,12 +1216,12 @@ class VolumeServer:
         and a PRIVATE file handle so a long stream never seek-races the
         serving handles interval reads use. EOF ends the stream short;
         the client zero-fills, mirroring local read_padded_into."""
-        delay_ms = os.environ.get("WEEDTPU_BENCH_RPC_DELAY_MS", "")
+        delay_ms = config.env("WEEDTPU_BENCH_RPC_DELAY_MS")
         if delay_ms:
             # bench-only RTT model, same rationale as VolumeEcShardRead:
             # one sleep per bulk window (the per-request latency a real
             # network charges), GIL-released so client-side overlap shows
-            time.sleep(float(delay_ms) / 1e3)
+            time.sleep(delay_ms / 1e3)
         vid = int(req["volume_id"])
         shard_id = int(req["shard_id"])
         offset = int(req["offset"])

@@ -13,6 +13,7 @@ import argparse
 import json
 import os
 import sys
+from contextlib import ExitStack
 
 from seaweedfs_tpu.command import Command, register
 from seaweedfs_tpu.ec import stripe
@@ -109,17 +110,19 @@ def _run_verify(args: argparse.Namespace) -> int:
     enc = new_encoder()
     shard_size = os.path.getsize(stripe.shard_file_name(args.base, 0))
     chunk = 4 * 1024 * 1024
-    files = [open(stripe.shard_file_name(args.base, s), "rb") for s in range(TOTAL_SHARDS_COUNT)]
-    try:
+    # ExitStack, not try/finally around a list comprehension: an open()
+    # failing mid-comprehension would leak every handle opened before it
+    with ExitStack() as stack:
+        files = [
+            stack.enter_context(open(stripe.shard_file_name(args.base, s), "rb"))
+            for s in range(TOTAL_SHARDS_COUNT)
+        ]
         for off in range(0, shard_size, chunk):
             n = min(chunk, shard_size - off)
             shards = [stripe.read_padded(f, off, n) for f in files]
             if not enc.verify(shards):
                 print(json.dumps({"verified": False, "bad_chunk_offset": off}))
                 return 1
-    finally:
-        for f in files:
-            f.close()
     print(json.dumps({"verified": True, "shard_bytes": shard_size}))
     return 0
 

@@ -27,6 +27,7 @@ from seaweedfs_tpu import stats
 
 from seaweedfs_tpu.ec import locate as locate_mod
 from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.ec import suspicion as suspicion_mod
 from seaweedfs_tpu.ec.constants import (
     DATA_SHARDS_COUNT,
     ERASURE_CODING_LARGE_BLOCK_SIZE,
@@ -66,6 +67,7 @@ class EcVolume:
         recover_holder_timeout: float = 30.0,
         recover_holder_backoff: float = 30.0,
         recover_suspect_after: float = 5.0,
+        suspicion: Optional[suspicion_mod.HolderSuspicion] = None,
     ):
         self.base = base_file_name
         self.encoder = encoder or new_encoder()
@@ -94,9 +96,11 @@ class EcVolume:
         self.recover_holder_timeout = recover_holder_timeout
         self.recover_holder_backoff = recover_holder_backoff
         self.recover_suspect_after = recover_suspect_after
-        self._holder_suspect_until: dict[int, float] = {}
-        self._wedged_inflight: dict[int, object] = {}  # shard -> blocked future
-        self._suspect_lock = threading.Lock()
+        # suspicion state lives in a PROCESS-WIDE registry keyed by peer
+        # identity when the reader can name peers (see _holder_key): a
+        # wedged peer serving many volumes costs one capped attempt
+        # process-wide, not one per volume
+        self._suspicion = suspicion if suspicion is not None else suspicion_mod.GLOBAL
         self._fetch_pool: Optional[ThreadPoolExecutor] = None
         self._fetch_pool_lock = threading.Lock()
         # recorded stripe geometry (.eci) wins over constructor defaults —
@@ -130,6 +134,7 @@ class EcVolume:
         for s in range(TOTAL_SHARDS_COUNT):
             p = stripe.shard_file_name(base_file_name, s)
             if os.path.exists(p):
+                # weedlint: ignore[open-no-ctx] serving handles owned by the volume, closed in close()
                 self._shard_files[s] = open(p, "rb")
                 self.shard_size = max(self.shard_size, os.path.getsize(p))
         if self.shard_size == 0 and remote_reader is not None and len(self._index):
@@ -167,6 +172,10 @@ class EcVolume:
         for f in self._shard_files.values():
             f.close()
         self._shard_files.clear()
+        # unmount forgets this volume's (volume, shard)-scoped suspicion —
+        # a remount must not inherit stale windows (peer-scoped windows
+        # persist: they describe the peer, not this volume)
+        self._suspicion.forget_volume(self.base)
         with self._fetch_pool_lock:
             pool, self._fetch_pool = self._fetch_pool, None
         if pool is not None:
@@ -254,35 +263,36 @@ class EcVolume:
             return None
         return np.frombuffer(raw, dtype=np.uint8).copy()
 
+    def _holder_key(self, shard_id: int) -> tuple:
+        """Suspicion key for the holder behind `shard_id`. When the
+        injected reader can name the peer (the volume server's closures
+        carry a cache-only `peer_for` attribute), the key IS the peer
+        identity — suspicion then applies to every shard of every volume
+        that peer serves, so one wedged peer costs one capped attempt
+        process-wide. Readers without peer identity fall back to a
+        (volume, shard) key: the old per-volume scope, never wrong, just
+        narrower."""
+        peer_for = getattr(self.remote_reader, "peer_for", None)
+        if peer_for is not None:
+            try:
+                peer = peer_for(shard_id)
+            except Exception:  # noqa: BLE001 — identity is best-effort
+                peer = None
+            if peer:
+                return ("peer", peer)
+        return ("volume-shard", self.base, shard_id)
+
     def _holder_suspected(self, shard_id: int) -> bool:
-        with self._suspect_lock:
-            if self._holder_suspect_until.get(shard_id, 0.0) > _time.monotonic():
-                return True
-            # a previous attempt is STILL blocked inside remote_reader: the
-            # holder stays unavailable past any backoff expiry, so we never
-            # stack a second pool thread onto a wedged peer (one blocked
-            # worker per wedged holder is the hard ceiling)
-            return shard_id in self._wedged_inflight
+        return self._suspicion.suspected(self._holder_key(shard_id))
 
     def _mark_holder_suspect(self, shard_id: int) -> None:
-        with self._suspect_lock:
-            self._holder_suspect_until[shard_id] = (
-                _time.monotonic() + self.recover_holder_backoff
-            )
+        self._suspicion.mark(self._holder_key(shard_id), self.recover_holder_backoff)
 
     def _track_wedged(self, shard_id: int, fut) -> None:
         """Remember that `fut` is a call into a wedged holder whose pool
         thread is still blocked; the holder reads as suspected until the
         call finally returns (SIGCONT, TCP reset, ...)."""
-        with self._suspect_lock:
-            self._wedged_inflight[shard_id] = fut
-
-        def _clear(f, _s=shard_id):
-            with self._suspect_lock:
-                if self._wedged_inflight.get(_s) is f:
-                    del self._wedged_inflight[_s]
-
-        fut.add_done_callback(_clear)
+        self._suspicion.track_wedged(self._holder_key(shard_id), fut)
 
     def _remote_fetch_capped(
         self, shard_id: int, offset: int, size: int

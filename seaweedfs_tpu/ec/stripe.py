@@ -46,6 +46,7 @@ from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types
 from seaweedfs_tpu.storage.needle_map import MemDb
+from seaweedfs_tpu.utils import config
 
 
 #: inflight depth of the streaming encode/rebuild pipelines: how many
@@ -53,15 +54,13 @@ from seaweedfs_tpu.storage.needle_map import MemDb
 #: pre-r6 behavior (one batch overlapped), 2 = double buffering, 3 = triple.
 #: Deeper pipelines hide longer device/tunnel latencies at the cost of
 #: (depth+1) staging buffers of `max_batch_bytes` each.
-DEFAULT_PIPELINE_DEPTH = max(1, int(os.environ.get("WEEDTPU_PIPELINE_DEPTH", "2")))
+DEFAULT_PIPELINE_DEPTH = config.env("WEEDTPU_PIPELINE_DEPTH")
 
 #: how many batches AHEAD of the reading cursor the rebuild pipeline keeps
 #: network-prefetched on remote slab sources (the third overlap stage: the
 #: network fetches batch k+N while local readinto consumes batch k+1 and
 #: the device decodes batch k). Defaults to the pipeline depth.
-DEFAULT_PREFETCH_BATCHES = max(
-    1, int(os.environ.get("WEEDTPU_REBUILD_PREFETCH_BATCHES", "2"))
-)
+DEFAULT_PREFETCH_BATCHES = config.env("WEEDTPU_REBUILD_PREFETCH_BATCHES")
 
 #: sub-range size for striped parallel range-fetches within one remote slab
 #: window: a `max_batch_bytes`-sized window is split into stripes fetched
@@ -433,6 +432,7 @@ class LocalSlabSource(SlabSource):
     """Today's path: `readinto` straight from a local shard file."""
 
     def __init__(self, path: str):
+        # weedlint: ignore[open-no-ctx] handle owned by the source, closed in close()
         self._f = open(path, "rb")
 
     def read_into(self, offset: int, out: np.ndarray) -> None:

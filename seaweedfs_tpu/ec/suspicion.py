@@ -1,0 +1,103 @@
+"""Process-wide holder-suspicion registry for degraded EC reads.
+
+PR 4 gave each EcVolume a per-holder cap + suspicion window so a wedged
+peer (SIGSTOPped process, dead NIC) costs one capped attempt instead of
+a per-read stall. But the window was keyed per-VOLUME by shard id: one
+wedged peer serving shards of many volumes was rediscovered — one capped
+attempt plus one parked pool thread — once per volume. This registry is
+the fix: suspicion state lives here, shared by every EcVolume in the
+process, and is keyed by PEER IDENTITY whenever the volume's
+remote_reader can name the peer behind a shard (the `peer_for` attribute
+the volume server attaches to its reader closures). A wedged peer then
+costs ONE capped attempt process-wide among volumes whose holder
+locations are known (live attempt, completed-read history, or the
+server's location cache); a volume whose holders were never looked up
+cannot name the peer without a master round-trip — which the check path
+must never pay — so its first degraded read still spends one capped
+attempt before converging on the shared peer key. Volumes whose readers
+cannot name peers at all fall back to a (volume, shard) key, which
+reproduces the old per-volume behavior exactly.
+
+Keys are opaque tuples built by EcVolume._holder_key; this registry only
+stores and expires them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+
+class HolderSuspicion:
+    """Thread-safe map of suspicion keys -> backoff expiry, plus the
+    wedged-inflight set (keys whose capped attempt is STILL blocked inside
+    a reader — suspected past any backoff expiry, so a second pool thread
+    is never stacked onto the same wedged peer)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._until: dict[tuple, float] = {}
+        self._wedged: dict[tuple, object] = {}
+
+    def suspected(self, key: tuple) -> bool:
+        with self._lock:
+            until = self._until.get(key)
+            if until is not None:
+                if until > _time.monotonic():
+                    return True
+                # expired: prune on sight — this registry outlives every
+                # volume, so dead keys must not ride along for the life
+                # of the server
+                del self._until[key]
+            return key in self._wedged
+
+    def mark(self, key: tuple, backoff: float) -> None:
+        """Start (or extend) the suspicion window for `key`."""
+        with self._lock:
+            now = _time.monotonic()
+            # marks are rare (one per wedge discovery): sweep the whole
+            # map here so churn in peers/volumes can never grow it
+            # unboundedly between checks
+            for k in [k for k, t in self._until.items() if t <= now]:
+                del self._until[k]
+            self._until[key] = now + backoff
+
+    def track_wedged(self, key: tuple, fut) -> None:
+        """Remember that `fut` is a call into a wedged holder whose pool
+        thread is still blocked; the key reads as suspected until the call
+        finally returns (SIGCONT, TCP reset, ...)."""
+        with self._lock:
+            self._wedged[key] = fut
+
+        def _clear(f, _k=key):
+            with self._lock:
+                if self._wedged.get(_k) is f:
+                    del self._wedged[_k]
+
+        fut.add_done_callback(_clear)
+
+    def forget_volume(self, base: str) -> None:
+        """Drop the (volume, shard)-scoped fallback keys for one volume —
+        called from EcVolume.close() so an unmount/remount cycle starts
+        with a clean slate (the pre-registry behavior, where suspicion
+        died with the instance). PEER-scoped windows persist on purpose:
+        they describe the peer process, not this volume, and are bounded
+        by the backoff window either way."""
+        with self._lock:
+            for d in (self._until, self._wedged):
+                for k in [
+                    k for k in d
+                    if k[0] == "volume-shard" and len(k) > 1 and k[1] == base
+                ]:
+                    del d[k]
+
+    def reset(self) -> None:
+        """Drop all state (test isolation: ports get reused across test
+        servers, and a stale peer key must not leak suspicion forward)."""
+        with self._lock:
+            self._until.clear()
+            self._wedged.clear()
+
+
+#: the process-wide default every EcVolume shares unless handed its own
+GLOBAL = HolderSuspicion()

@@ -90,6 +90,7 @@ class Filer:
         self._log_file = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+            # weedlint: ignore[open-no-ctx] append-only meta log, lives as long as the filer
             self._log_file = open(
                 os.path.join(log_dir, "filer.meta.log"), "a", encoding="utf-8"
             )

@@ -49,6 +49,7 @@ class LogFileQueue(NotificationQueue):
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # weedlint: ignore[open-no-ctx] queue-lifetime append handle
         self._f = open(path, "a", encoding="utf-8")
         self._lock = threading.Lock()
 

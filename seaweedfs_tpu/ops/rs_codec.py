@@ -26,12 +26,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 from seaweedfs_tpu.ops import gf8
+from seaweedfs_tpu.utils import config
 
 #: committed on-chip measurement evidence older than this many days no
 #: longer flips the auto backend away from its conservative default: the
 #: kernels under measurement keep changing round to round, so an ancient
 #: number says nothing about today's binary.
-EVIDENCE_MAX_AGE_DAYS = float(os.environ.get("WEEDTPU_EVIDENCE_MAX_AGE_DAYS", "120"))
+EVIDENCE_MAX_AGE_DAYS = config.env("WEEDTPU_EVIDENCE_MAX_AGE_DAYS")
 
 #: the staged fused-kernel family (rs_pallas re-exports this as VARIANTS
 #: and asserts its kernel table matches). Lives HERE, jax-free, so
@@ -47,7 +48,7 @@ _BACKENDS = ("numpy", "native", "jax", "pallas")
 #: unbounded stream of (survivors, wanted) keys — C(14,10) x wanted sets is
 #: thousands of patterns — so the memo must evict, not grow for the life of
 #: the process. Matrices are tiny; the cap bounds the GF-elimination *keys*.
-DECODE_MATRIX_CACHE_SIZE = int(os.environ.get("WEEDTPU_DECODE_MATRIX_CACHE", "512"))
+DECODE_MATRIX_CACHE_SIZE = config.env("WEEDTPU_DECODE_MATRIX_CACHE")
 
 
 @functools.lru_cache(maxsize=max(16, DECODE_MATRIX_CACHE_SIZE))
@@ -660,7 +661,7 @@ def new_encoder(
     selection: dict = {"requested": backend}
     pallas_kwargs: dict = {}
     if backend == "auto":
-        env = os.environ.get("WEEDTPU_BACKEND", "").strip().lower()
+        env = config.env("WEEDTPU_BACKEND").strip().lower()
         if env and env != "auto":
             if env not in _BACKENDS:
                 raise ValueError(

@@ -51,6 +51,8 @@ import shutil
 import subprocess
 import threading
 
+from seaweedfs_tpu.utils import config
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 PROTO_PATH = os.path.join(_HERE, "contracts.proto")
 DESC_PATH = os.path.join(_HERE, "contracts.desc")
@@ -128,7 +130,7 @@ def _scalar_out_converter(fd):
 
 def wire_format() -> str:
     """'proto' or 'json' — the process-wide wire selection."""
-    return "proto" if os.environ.get("WEEDTPU_WIRE", "") == "proto" else "json"
+    return config.env("WEEDTPU_WIRE")
 
 
 def _descriptor_set_bytes() -> bytes:

@@ -47,6 +47,7 @@ def index_entries_array(buf: bytes) -> np.ndarray:
 
 def write_entries(entries, out: BinaryIO | str) -> None:
     """Write (key, stored_offset, size) triples as 16-byte records."""
+    # weedlint: ignore[open-no-ctx] conditional open (path-or-handle API), closed in the finally below
     sink = open(out, "wb") if isinstance(out, str) else out
     try:
         for key, off, size in entries:

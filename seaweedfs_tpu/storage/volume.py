@@ -63,6 +63,7 @@ class Volume:
             exists = True
         else:
             exists = os.path.exists(self.dat_path)
+            # weedlint: ignore[open-no-ctx] mount-lifetime .dat handle, closed in close()
             self._dat = open(self.dat_path, "r+b" if exists else "w+b")
         try:
             if exists:
@@ -99,6 +100,7 @@ class Volume:
                     from seaweedfs_tpu.storage.needle_map import new_needle_map
 
                     self.nm = new_needle_map(needle_map_kind, self.base_path)
+            # weedlint: ignore[open-no-ctx] mount-lifetime .idx handle, closed in close()
             self._idx = open(self.idx_path, "ab")
             # live-byte accounting for the garbage ratio that drives the
             # master's automatic vacuum (topology_vacuum.go analog): one
@@ -411,8 +413,9 @@ class Volume:
             self._idx.close()
             os.replace(cpd_dat, self.dat_path)
             os.replace(cpd_idx, self.idx_path)
+            # weedlint: ignore[open-no-ctx] compaction swap reopens the mount-lifetime handles
             self._dat = open(self.dat_path, "r+b")
-            self._idx = open(self.idx_path, "ab")
+            self._idx = open(self.idx_path, "ab")  # weedlint: ignore[open-no-ctx] see above
             self.super_block = new_sb
             if self.needle_map_kind != "memory":
                 from seaweedfs_tpu.storage.needle_map import new_needle_map

@@ -2,12 +2,20 @@
 [VERIFY: mount empty; SURVEY.md §5 "Config/flag system"]: named TOML
 files (security.toml, master.toml, filer.toml, shell.toml) searched in
 `.`, `~/.seaweedfs_tpu/`, `/etc/seaweedfs_tpu/`; `scaffold` prints
-commented templates. Parsing uses stdlib tomllib."""
+commented templates. Parsing uses stdlib tomllib.
+
+Also the typed WEEDTPU_* environment-variable registry: every env knob
+the package reads is declared here ONCE (name, type, default, doc) and
+read through `env()`. weedlint's env-registry checker flags any raw
+`os.environ`/`os.getenv` read elsewhere in the package, and the README
+env-var table is generated from this registry — so the docs, the
+defaults, and the code cannot drift apart."""
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 try:  # stdlib on 3.11+; this image runs 3.10
     import tomllib
@@ -128,3 +136,162 @@ dir = "./filerlog"
 
 def scaffold(name: str) -> Optional[str]:
     return SCAFFOLDS.get(name)
+
+
+# -- WEEDTPU_* environment-variable registry ----------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment knob. `type` drives parsing (bool accepts
+    1/true/yes/on, case-insensitive); `parse` overrides it for knobs with
+    extra constraints (clamps, enums) so every call site agrees on the
+    same coercion instead of re-implementing it."""
+
+    name: str
+    type: type
+    default: Any
+    doc: str
+    parse: Optional[Callable[[str], Any]] = None
+
+    def value(self) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None or raw == "":
+            return self.default
+        if self.parse is not None:
+            return self.parse(raw)
+        if self.type is bool:
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return self.type(raw)
+
+
+ENV_REGISTRY: dict[str, EnvVar] = {}
+
+
+def register_env(
+    name: str,
+    type_: type,
+    default: Any,
+    doc: str,
+    parse: Optional[Callable[[str], Any]] = None,
+) -> EnvVar:
+    if not name.startswith("WEEDTPU_"):
+        raise ValueError(f"env knob {name!r} must be WEEDTPU_-prefixed")
+    prev = ENV_REGISTRY.get(name)
+    if prev is not None:
+        # `parse` compares by identity: the registry is declared ONCE
+        # below, so any re-registration bringing its own parser (even a
+        # semantically identical closure) is a second source of truth and
+        # must fail loudly rather than silently keep the first parser
+        if (prev.type, prev.default) != (type_, default) or prev.parse is not parse:
+            raise ValueError(
+                f"{name} re-registered with conflicting spec: "
+                f"{(prev.type, prev.default, prev.parse)} vs "
+                f"{(type_, default, parse)}"
+            )
+        return prev
+    var = EnvVar(name, type_, default, doc, parse)
+    ENV_REGISTRY[name] = var
+    return var
+
+
+def env(name: str) -> Any:
+    """Parsed value of a REGISTERED env knob (default when unset/empty).
+    Unknown names raise — a typo'd knob must fail loudly, not silently
+    read as its default forever."""
+    var = ENV_REGISTRY.get(name)
+    if var is None:
+        raise KeyError(f"{name} is not in the WEEDTPU env registry")
+    return var.value()
+
+
+def _clamped_int(minimum: int) -> Callable[[str], int]:
+    return lambda raw: max(minimum, int(raw))
+
+
+def _enum(*allowed: str) -> Callable[[str], str]:
+    def parse(raw: str) -> str:
+        v = raw.strip().lower()
+        if v not in allowed:
+            raise ValueError(f"expected one of {allowed}, got {raw!r}")
+        return v
+
+    return parse
+
+
+# The full knob catalog. Declarations live here (not at call sites) so one
+# import renders the complete table; call sites look their knob up by name.
+register_env(
+    "WEEDTPU_PIPELINE_DEPTH", int, 2,
+    "Inflight depth of the streaming encode/rebuild pipelines (1 = one "
+    "batch overlapped, 2 = double buffering, 3 = triple; clamped to >= 1). "
+    "Deeper hides longer device latency at (depth+1) staging buffers of "
+    "memory.",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_REBUILD_PREFETCH_BATCHES", int, 2,
+    "How many batches ahead of the reading cursor the rebuild pipeline "
+    "keeps network-prefetched on remote slab sources (clamped to >= 1).",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_BACKEND", str, "",
+    "Operator override of the evidence-based auto backend selection: one "
+    "of numpy | native | jax | pallas (empty/auto = measured decision). "
+    "Explicit new_encoder(backend=...) callers are never overridden.",
+)
+register_env(
+    "WEEDTPU_EVIDENCE_MAX_AGE_DAYS", float, 120.0,
+    "Committed on-chip measurement evidence older than this no longer "
+    "flips the auto backend away from its conservative XLA default.",
+)
+register_env(
+    "WEEDTPU_DECODE_MATRIX_CACHE", int, 512,
+    "LRU cap on cached decode matrices (bounds the GF-elimination keys a "
+    "long-lived server with churning shard-loss patterns accumulates).",
+)
+register_env(
+    "WEEDTPU_V", int, 0,
+    "glog verbosity level: glog.V(n) call sites with n <= this emit.",
+)
+register_env(
+    "WEEDTPU_WIRE", str, "json",
+    "Process-wide RPC wire selection: `proto` flips every unary JSON "
+    "method in the pinned schema to binary protobuf; anything else means "
+    "JSON. All processes of a cluster must agree.",
+    parse=lambda raw: "proto" if raw.strip().lower() == "proto" else "json",
+)
+register_env(
+    "WEEDTPU_BENCH_RPC_DELAY_MS", float, 0.0,
+    "Bench-only per-RPC server-side sleep (ms) modeling network RTT on "
+    "loopback hosts, so fetch/decode overlap is measurable. 0 = off.",
+)
+register_env(
+    "WEEDTPU_LOCK_OBSERVE", bool, False,
+    "Opt-in dynamic lock-order recorder: instruments threading.Lock/RLock "
+    "at test-session start, records actual acquisition-order edges, and "
+    "fails the run if the observed graph has a cycle (see "
+    "seaweedfs_tpu/analysis/lockrec.py).",
+)
+register_env(
+    "WEEDTPU_LOCK_OBSERVE_OUT", str, "",
+    "Optional path: the instrumented-lock run dumps the observed "
+    "acquisition-order graph here as JSON (edges + acquisition sites).",
+)
+
+
+def env_table_markdown() -> str:
+    """The README `WEEDTPU_*` table, generated from the registry."""
+    lines = [
+        "| Variable | Type | Default | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for name in sorted(ENV_REGISTRY):
+        var = ENV_REGISTRY[name]
+        default = "(empty)" if var.default == "" else f"`{var.default}`"
+        doc = " ".join(var.doc.split()).replace("|", "\\|")
+        lines.append(
+            f"| `{name}` | {var.type.__name__} | {default} | {doc} |"
+        )
+    return "\n".join(lines) + "\n"

@@ -14,6 +14,7 @@ def honor_platform_env() -> None:
     (single-client) TPU tunnel backend and can hang when another process
     holds it. Call this right after `import jax`, before any backend
     touch, wherever the framework imports jax in a server process."""
+    # weedlint: ignore[env-raw-read] foreign (jax) env var, not a WEEDTPU knob
     want = os.environ.get("JAX_PLATFORMS", "")
     if not want:
         return

@@ -6,8 +6,9 @@ Verbosity comes from set_verbosity() or the WEEDTPU_V env var."""
 from __future__ import annotations
 
 import logging
-import os
 import sys
+
+from seaweedfs_tpu.utils import config
 
 _logger = logging.getLogger("seaweedfs_tpu")
 if not _logger.handlers:
@@ -19,7 +20,7 @@ if not _logger.handlers:
     _logger.setLevel(logging.INFO)
     _logger.propagate = False
 
-_verbosity = int(os.environ.get("WEEDTPU_V", "0"))
+_verbosity = config.env("WEEDTPU_V")
 
 
 def set_verbosity(v: int) -> None:

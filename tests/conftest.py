@@ -14,9 +14,51 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Opt-in instrumented-lock mode (WEEDTPU_LOCK_OBSERVE=1): wrap
+# threading.Lock/RLock BEFORE anything else imports, so every lock the
+# package creates carries its creation site and the session records the
+# actual acquisition-order graph. pytest_sessionfinish asserts the
+# package's observed graph is acyclic — the dynamic half of weedlint's
+# lock-discipline family.
+from seaweedfs_tpu.utils import config as _weedtpu_config  # noqa: E402
+
+_LOCK_RECORDER = None
+if _weedtpu_config.env("WEEDTPU_LOCK_OBSERVE"):
+    from seaweedfs_tpu.analysis import lockrec as _lockrec
+
+    _LOCK_RECORDER = _lockrec.install()
+
 # The axon sitecustomize (interpreter start) calls
 # jax.config.update("jax_platforms", "axon,cpu"), which outranks the env var —
 # push it back to cpu before any backend initializes.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_holder_suspicion():
+    """Holder suspicion is process-wide and keyed by peer address; test
+    servers reuse ephemeral ports, so suspicion leaking forward would make
+    a later test's healthy peer read as wedged."""
+    yield
+    from seaweedfs_tpu.ec import suspicion
+
+    suspicion.GLOBAL.reset()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Instrumented-lock gate: the tier-1 run's OBSERVED lock-order graph
+    (package locks only — jax/stdlib internals order their own locks)
+    must be acyclic, or the session fails even with every test green."""
+    if _LOCK_RECORDER is None:
+        return
+    out_path = _weedtpu_config.env("WEEDTPU_LOCK_OBSERVE_OUT")
+    if out_path:
+        _LOCK_RECORDER.dump(out_path)
+    report = _LOCK_RECORDER.report(only_containing="seaweedfs_tpu")
+    print(f"\n{report}")
+    if _LOCK_RECORDER.cycles(only_containing="seaweedfs_tpu"):
+        session.exitstatus = 1

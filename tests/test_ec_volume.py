@@ -523,3 +523,131 @@ def test_wedged_holder_latency_ladder_holds(volume, tmp_path):
             assert p99 < cap + 2.0, f"p99 {p99:.3f}s — more than one capped wait leaked in"
     finally:
         wedge.set()
+
+
+def test_wedged_peer_suspected_process_wide(volume, tmp_path):
+    """The PR 4 follow-up: one wedged PEER serving shards of MANY volumes
+    must cost one capped attempt process-wide, not one per volume. Readers
+    that can name the peer behind a shard (`peer_for`) share suspicion
+    through the process-wide registry: volume A's capped timeout marks the
+    peer, and volume B skips it without ever calling its reader."""
+    import threading
+
+    from seaweedfs_tpu.ec import suspicion
+
+    base_a, _ = volume
+    base_b = str(tmp_path / "v8")
+    for ext in [".ecx", ".ecj", ".eci"] + [stripe.to_ext(s) for s in range(14)]:
+        if os.path.exists(base_a + ext):
+            shutil.copy(base_a + ext, base_b + ext)
+
+    calls = {"a": 0, "b": 0}
+    wedge = threading.Event()
+    PEER = "10.0.0.9:18080"
+
+    def reader_a(shard_id, offset, size):
+        calls["a"] += 1
+        wedge.wait(30.0)  # SIGSTOPped peer: no answer, no error
+        return None
+
+    reader_a.peer_for = lambda shard_id: PEER
+
+    def reader_b(shard_id, offset, size):
+        calls["b"] += 1
+        return None
+
+    reader_b.peer_for = lambda shard_id: PEER
+
+    reg = suspicion.HolderSuspicion()
+    try:
+        with open_vol(
+            base_a,
+            remote_reader=reader_a,
+            warm_on_mount=False,
+            recover_holder_timeout=0.3,
+            recover_holder_backoff=60.0,
+            suspicion=reg,
+        ) as ev_a, open_vol(
+            base_b,
+            remote_reader=reader_b,
+            warm_on_mount=False,
+            recover_holder_backoff=60.0,
+            suspicion=reg,
+        ) as ev_b:
+            # volume A pays the one capped attempt against the wedged peer
+            assert ev_a._remote_fetch_capped(0, 0, 16) is None
+            assert calls["a"] == 1
+            assert ev_a._holder_suspected(0)
+            # volume B sees the SAME peer suspected — for every shard it
+            # serves, with zero reader calls
+            assert ev_b._holder_suspected(0) and ev_b._holder_suspected(5)
+            assert ev_b._remote_fetch_capped(0, 0, 16) is None
+            assert calls["b"] == 0, "wedged peer was rediscovered by volume B"
+    finally:
+        wedge.set()
+
+
+def test_suspicion_without_peer_identity_stays_per_volume(volume, tmp_path):
+    """Fallback scope check: a reader that CANNOT name peers keys
+    suspicion by (volume, shard) — another volume with its own reader is
+    unaffected (the narrower pre-peer-identity behavior, preserved)."""
+    from seaweedfs_tpu.ec import suspicion
+
+    base_a, _ = volume
+    base_b = str(tmp_path / "v9")
+    for ext in [".ecx", ".ecj", ".eci"] + [stripe.to_ext(s) for s in range(14)]:
+        if os.path.exists(base_a + ext):
+            shutil.copy(base_a + ext, base_b + ext)
+
+    reg = suspicion.HolderSuspicion()
+    with open_vol(
+        base_a, remote_reader=lambda s, o, n: None, warm_on_mount=False, suspicion=reg
+    ) as ev_a, open_vol(
+        base_b, remote_reader=lambda s, o, n: None, warm_on_mount=False, suspicion=reg
+    ) as ev_b:
+        ev_a._mark_holder_suspect(3)
+        assert ev_a._holder_suspected(3)
+        assert not ev_a._holder_suspected(4)
+        assert not ev_b._holder_suspected(3), "per-volume suspicion leaked across volumes"
+
+
+def test_suspicion_registry_prunes_expired_keys():
+    """The process-wide registry outlives every volume: expired windows
+    must be dropped (on check and on the next mark), not accumulate for
+    the life of the server."""
+    from seaweedfs_tpu.ec import suspicion
+
+    reg = suspicion.HolderSuspicion()
+    reg.mark(("peer", "a:1"), backoff=-1.0)  # already expired
+    reg.mark(("peer", "b:2"), backoff=-1.0)
+    assert not reg.suspected(("peer", "a:1"))  # prunes a:1 on sight
+    assert ("peer", "a:1") not in reg._until
+    reg.mark(("peer", "c:3"), backoff=60.0)  # mark sweeps b:2
+    assert ("peer", "b:2") not in reg._until
+    assert reg.suspected(("peer", "c:3"))
+    assert list(reg._until) == [("peer", "c:3")]
+
+
+def test_unmount_forgets_volume_scoped_suspicion(volume):
+    """close() drops this volume's (volume, shard) fallback keys — a
+    remount after repairing a flaky holder must not inherit the stale
+    window — while PEER-scoped windows persist (they describe the peer
+    process, and are bounded by the backoff either way)."""
+    from seaweedfs_tpu.ec import suspicion
+
+    base, _ = volume
+    reg = suspicion.HolderSuspicion()
+    with open_vol(
+        base, remote_reader=lambda s, o, n: None, warm_on_mount=False,
+        recover_holder_backoff=60.0, suspicion=reg,
+    ) as ev:
+        ev._mark_holder_suspect(2)
+        assert ev._holder_suspected(2)
+    reg.mark(("peer", "10.0.0.9:18080"), backoff=60.0)  # unrelated peer window
+    # remount: volume-scoped window is gone, peer window untouched
+    with open_vol(
+        base, remote_reader=lambda s, o, n: None, warm_on_mount=False,
+        suspicion=reg,
+    ) as ev2:
+        assert not ev2._holder_suspected(2), "remount inherited stale suspicion"
+    assert reg.suspected(("peer", "10.0.0.9:18080"))

@@ -1,0 +1,401 @@
+"""weedlint (seaweedfs_tpu.analysis) tests: every checker family fires on
+its planted-violation fixture, the real tree stays clean in --strict
+(the tier-1 CI gate, run exactly as CI runs it), suppression-comment
+semantics, the env registry, and the dynamic lock-order recorder
+(synthetic deadlock + real concurrent code staying acyclic)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.analysis import PKG_ROOT, RULES, lockrec, run
+from seaweedfs_tpu.analysis import graph as graph_mod
+from seaweedfs_tpu.utils import config
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "weedlint_fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def rules_at(findings, path_suffix=None):
+    return {
+        (f.rule, f.line)
+        for f in findings
+        if path_suffix is None or f.path.endswith(path_suffix)
+    }
+
+
+# -- planted violations: every family must FIRE -------------------------------
+
+
+def test_lock_order_cycle_fixture_fires():
+    findings = run(paths=[fixture("lock_cycle.py")])
+    cycles = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(cycles) >= 2, findings  # both edges of the a<->b cycle
+    assert any("lock_a" in f.message and "lock_b" in f.message for f in cycles)
+
+
+def test_lock_order_clean_when_consistent(tmp_path):
+    src = (
+        "import threading\n"
+        "a = threading.Lock()\nb = threading.Lock()\n"
+        "def one():\n    with a:\n        with b:\n            pass\n"
+        "def two():\n    with a:\n        with b:\n            pass\n"
+    )
+    p = tmp_path / "consistent.py"
+    p.write_text(src)
+    findings = run(paths=[str(p)])
+    assert not [f for f in findings if f.rule == "lock-order-cycle"]
+
+
+def test_unlocked_global_write_fixture_fires():
+    findings = run(paths=[fixture("unlocked_global.py")])
+    hits = [f for f in findings if f.rule == "unlocked-global-write"]
+    # the two unlocked writes in _refresh + the bound-method one in Worker
+    assert len(hits) == 3, findings
+    assert {f.line for f in hits} == {14, 15, 38}, hits
+
+
+def test_donation_fixture_fires():
+    findings = run(paths=[fixture("donation_bad.py")])
+    sync = [f for f in findings if f.rule == "jit-host-sync"]
+    donated = [f for f in findings if f.rule == "donated-buffer-read"]
+    assert len(sync) == 3, findings  # np.asarray, print, block_until_ready
+    assert len(donated) == 1, findings
+    assert donated[0].line == 20  # staging.sum() after donation
+    # run_rebound's re-binding must NOT be flagged (its reads are >= 24)
+    assert all(f.line < 24 for f in donated)
+
+
+def test_env_fixture_fires():
+    findings = run(paths=[fixture("env_raw.py")])
+    raw = [f for f in findings if f.rule == "env-raw-read"]
+    unreg = [f for f in findings if f.rule == "env-unregistered"]
+    assert len(raw) == 3, findings  # .get, getenv, subscript read
+    assert len(unreg) == 1 and "WEEDTPU_NO_SUCH_KNOB" in unreg[0].message
+    # writes and whole-env passthrough stay clean
+    assert all(f.line <= 11 for f in raw), raw
+
+
+def test_resource_fixture_fires():
+    findings = run(paths=[fixture("resource_bad.py")])
+    opens = [f for f in findings if f.rule == "open-no-ctx"]
+    tmps = [f for f in findings if f.rule == "tmpfile-no-unlink"]
+    assert len(opens) == 1 and opens[0].line == 10, findings
+    assert len(tmps) == 1 and tmps[0].line == 15, findings
+
+
+def test_wire_drift_fixture_fires():
+    pkg = fixture("wiredrift_pkg")
+    findings = run(
+        paths=[os.path.join(pkg, "cluster", "server.py")], root=pkg
+    )
+    drift = [f for f in findings if f.rule == "wire-drift"]
+    msgs = " | ".join(f.message for f in drift)
+    assert "requester" in msgs, findings
+    assert "extra" in msgs, findings
+    # the legitimate req["volume_id"] read (line 11) stays clean
+    assert not any(f.line == 11 for f in drift), drift
+
+
+def test_parse_proto_oneof_fields_belong_to_message():
+    from seaweedfs_tpu.analysis.wire_drift import parse_proto
+
+    messages, _, methods = parse_proto(
+        fixture(os.path.join("wiredrift_pkg", "pb", "contracts.proto"))
+    )
+    # oneof members are fields OF THE MESSAGE (a oneof in contracts.proto
+    # must not produce phantom desc-drift findings)
+    assert messages["DoThingResponse"] == {"ok", "detail", "code"}
+    assert messages["DoThingRequest"] == {"volume_id", "collection"}
+    assert methods["StreamThing"][0][2] is True  # stream response parsed
+
+
+# -- suppression semantics ----------------------------------------------------
+
+
+def test_suppression_with_reason_suppresses():
+    findings = run(paths=[fixture("suppressed.py")])
+    opens = rules_at(findings, "suppressed.py")
+    # properly_suppressed (line 6) must NOT appear
+    assert ("open-no-ctx", 6) not in opens
+    # missing reason: the open is suppressed but the pragma is flagged
+    assert ("open-no-ctx", 11) not in opens
+    assert ("bad-suppression", 11) in opens
+    # unknown rule: pragma flagged AND the finding survives
+    assert ("bad-suppression", 16) in opens
+    assert ("open-no-ctx", 16) in opens
+
+
+def test_unused_suppression_flagged_in_strict_only():
+    loose = run(paths=[fixture("suppressed.py")], strict=False)
+    assert not [f for f in loose if f.rule == "unused-suppression"]
+    strict = run(paths=[fixture("suppressed.py")], strict=True)
+    unused = [f for f in strict if f.rule == "unused-suppression"]
+    assert len(unused) == 1 and unused[0].line == 20, strict
+
+
+# -- the real tree is the clean-tree assertion (and the CI gate) --------------
+
+
+def test_weedlint_strict_clean_tree_subprocess():
+    """THE tier-1 gate: `python -m seaweedfs_tpu.analysis --strict` exits 0
+    on the tree, within the <30 s runtime budget."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--strict"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=ROOT,
+    )
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, f"weedlint strict failed:\n{proc.stdout}\n{proc.stderr}"
+    assert wall < 30.0, f"weedlint took {wall:.1f}s — over the 30 s tier-1 budget"
+
+
+def test_weedlint_changed_only_mode():
+    proc = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--strict", "--changed-only"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+
+
+def test_weedlint_exits_nonzero_on_findings():
+    proc = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", fixture("resource_bad.py")],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 1
+    assert "open-no-ctx" in proc.stdout
+
+
+def test_every_rule_documented():
+    # every rule a checker can emit is in the catalog the CLI prints and
+    # BASELINE.md documents
+    emitted = set()
+    for name in os.listdir(FIXTURES):
+        if name.endswith(".py"):
+            emitted |= {f.rule for f in run(paths=[fixture(name)], strict=True)}
+    assert emitted <= set(RULES)
+
+
+# -- env registry -------------------------------------------------------------
+
+
+def test_env_registry_types_and_clamps(monkeypatch):
+    monkeypatch.delenv("WEEDTPU_PIPELINE_DEPTH", raising=False)
+    assert config.env("WEEDTPU_PIPELINE_DEPTH") == 2
+    monkeypatch.setenv("WEEDTPU_PIPELINE_DEPTH", "0")
+    assert config.env("WEEDTPU_PIPELINE_DEPTH") == 1  # clamped
+    monkeypatch.setenv("WEEDTPU_WIRE", "PROTO")
+    assert config.env("WEEDTPU_WIRE") == "proto"
+    monkeypatch.setenv("WEEDTPU_WIRE", "nonsense")
+    assert config.env("WEEDTPU_WIRE") == "json"
+    monkeypatch.setenv("WEEDTPU_LOCK_OBSERVE", "yes")
+    assert config.env("WEEDTPU_LOCK_OBSERVE") is True
+    with pytest.raises(KeyError):
+        config.env("WEEDTPU_NOT_A_KNOB")
+
+
+def test_every_weedtpu_literal_in_package_is_registered():
+    """No WEEDTPU_* name may exist in package source without a registry
+    entry — the completeness side of the env-registry family."""
+    import re
+
+    names = set()
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                    names |= set(re.findall(r"WEEDTPU_[A-Z][A-Z0-9_]*", f.read()))
+    missing = names - set(config.ENV_REGISTRY)
+    assert not missing, f"unregistered WEEDTPU_* names in package: {sorted(missing)}"
+
+
+def test_readme_env_table_is_generated_and_current():
+    readme = os.path.join(ROOT, "README.md")
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    assert "<!-- weedlint:env-table:begin -->" in text
+    table = config.env_table_markdown()
+    assert table in text, (
+        "README env table is stale — run "
+        "`python -m seaweedfs_tpu.analysis --write-env-table`"
+    )
+    for name in config.ENV_REGISTRY:
+        assert f"`{name}`" in table
+
+
+# -- dynamic lock-order recorder ----------------------------------------------
+
+
+def test_recorder_detects_synthetic_deadlock():
+    import _thread
+
+    rec = lockrec.LockOrderRecorder()
+    # raw _thread locks, NOT threading.Lock(): under WEEDTPU_LOCK_OBSERVE
+    # the session's global recorder wraps threading.Lock too, and this
+    # test's deliberately-conflicting orders must not plant a cycle in
+    # the session-wide graph the conftest gate asserts on
+    a = lockrec._ObservedLock(_thread.allocate_lock(), "siteA", rec)
+    b = lockrec._ObservedLock(_thread.allocate_lock(), "siteB", rec)
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    # run sequentially on two threads: no actual deadlock, but the orders
+    # conflict — exactly what the recorder must catch BEFORE the unlucky
+    # interleaving ships
+    for fn in (order_ab, order_ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    cycles = rec.cycles()
+    assert cycles == [["siteA", "siteB"]], rec.edges()
+    assert "CYCLE" in rec.report()
+
+
+def test_recorder_acyclic_on_consistent_order():
+    import _thread
+
+    rec = lockrec.LockOrderRecorder()
+    a = lockrec._ObservedLock(_thread.allocate_lock(), "siteA", rec)
+    b = lockrec._ObservedLock(_thread.allocate_lock(), "siteB", rec)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert rec.cycles() == []
+    assert rec.edges() == {("siteA", "siteB"): 3}
+
+
+def test_recorder_reentrant_rlock_no_self_edge():
+    rec = lockrec.LockOrderRecorder()
+    r = lockrec._ObservedLock(threading.RLock(), "siteR", rec)
+    with r:
+        with r:  # reentrant: orders nothing new
+            pass
+    assert rec.edges() == {}
+    assert rec.cycles() == []
+
+
+def test_recorder_condition_compat():
+    """Observed locks must stay usable under threading.Condition (both
+    Lock and RLock flavors — the package wraps conditions around both)."""
+    rec = lockrec.LockOrderRecorder()
+    for factory in (threading.Lock, threading.RLock):
+        lock = lockrec._ObservedLock(factory(), f"site-{factory.__name__}", rec)
+        cond = threading.Condition(lock)
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            hits.append(1)
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+
+
+def test_recorder_observes_real_degraded_read(tmp_path):
+    """Instrumented-lock mode on REAL code: install the recorder, exercise
+    EcVolume's concurrent degraded-read ladder (suspect lock + fetch-pool
+    lock + stats under load), and assert the observed package graph is
+    acyclic — the in-process version of the tier-1 session gate."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec import stripe
+    from seaweedfs_tpu.ec.ec_volume import EcVolume
+    from seaweedfs_tpu.ops.rs_codec import Encoder
+
+    rng = np.random.default_rng(5)
+    base = str(tmp_path / "v1")
+    data = rng.integers(0, 256, size=64 * 10 * 3, dtype=np.uint8).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage import types
+
+    idx_mod.write_entries([(1, types.offset_to_bytes(8), 100)], base + ".idx")
+    enc = Encoder(10, 4, backend="numpy")
+    stripe.write_ec_files(base, large_block_size=256, small_block_size=64,
+                          buffer_size=64, encoder=enc)
+    stripe.write_sorted_file_from_idx(base)
+
+    # under WEEDTPU_LOCK_OBSERVE the session already installed the global
+    # recorder: reuse it and DON'T uninstall (that would silently strip
+    # instrumentation from the rest of the session)
+    pre_installed = lockrec.active_recorder() is not None
+    rec = lockrec.install()
+    baseline = set(rec.edges())
+    try:
+        with EcVolume(base, encoder=enc, large_block_size=256,
+                      small_block_size=64, warm_on_mount=False,
+                      remote_reader=lambda s, o, n: None) as ev:
+            for s in (0, 3, 7):
+                ev.drop_local_shard(s)
+            threads = [
+                threading.Thread(target=ev.read_needle_blob, args=(1,))
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    finally:
+        if not pre_installed:
+            lockrec.uninstall()
+    assert rec.cycles(only_containing="seaweedfs_tpu") == []
+    # the run must have actually observed SOMETHING (the gate is not
+    # vacuous): new edges appeared during the degraded reads
+    assert set(rec.edges()) - baseline or rec.edges()
+
+
+def test_recorder_dump_roundtrip(tmp_path):
+    rec = lockrec.LockOrderRecorder()
+    rec.on_acquire("A")
+    rec.on_acquire("B")
+    rec.on_release("B")
+    rec.on_release("A")
+    out = tmp_path / "graph.json"
+    rec.dump(str(out))
+    payload = json.loads(out.read_text())
+    assert payload["edges"] == [{"from": "A", "to": "B", "count": 1}]
+    assert payload["cycles"] == []
+
+
+def test_graph_cycle_detection():
+    edges = graph_mod.edges_from_pairs([("a", "b"), ("b", "c"), ("c", "a"), ("c", "d")])
+    assert graph_mod.cyclic_components(edges) == [["a", "b", "c"]]
+    assert graph_mod.cyclic_components({"x": {"x"}}) == [["x"]]
+    assert graph_mod.cyclic_components({"x": {"y"}}) == []

@@ -1,0 +1,27 @@
+"""Planted violations for the donation-safety family. Never imported;
+parsed only (jax is not actually loaded)."""
+
+import jax
+import numpy as np
+
+
+def _impl(m, data):
+    host = np.asarray(data)  # BAD: host materialization inside jit
+    print("dispatching")  # BAD: host I/O inside jit
+    data.block_until_ready()  # BAD: device sync inside jit
+    return host
+
+
+_donated = jax.jit(_impl, donate_argnums=(1,))
+
+
+def run(m, staging):
+    out = _donated(m, staging)
+    checksum = staging.sum()  # BAD: staging was donated — buffer is XLA's
+    return out, checksum
+
+
+def run_rebound(m, staging):
+    out = _donated(m, staging)
+    staging = out + 1  # re-bind revives the name
+    return staging  # fine: reads the new binding

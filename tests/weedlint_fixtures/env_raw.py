@@ -1,0 +1,15 @@
+"""Planted violations for the env-registry family. Never imported;
+parsed only."""
+
+import os
+
+from seaweedfs_tpu.utils import config
+
+DEPTH = int(os.environ.get("WEEDTPU_PIPELINE_DEPTH", "2"))  # BAD: raw .get
+WHO = os.getenv("WEEDTPU_WHO", "")  # BAD: raw getenv
+RAW = os.environ["WEEDTPU_RAW"]  # BAD: raw subscript read
+TYPO = config.env("WEEDTPU_NO_SUCH_KNOB")  # BAD: not in ENV_REGISTRY
+
+OK = config.env("WEEDTPU_PIPELINE_DEPTH")  # fine: registered read
+os.environ["WEEDTPU_SET_FOR_SUBPROCESS"] = "1"  # fine: write is plumbing
+CHILD_ENV = dict(os.environ)  # fine: whole-env passthrough

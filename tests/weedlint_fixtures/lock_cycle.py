@@ -1,0 +1,27 @@
+"""Planted violation: two paths acquire the same pair of locks in
+opposite orders — the lock-order-cycle checker must flag both edges.
+Never imported; parsed by tests/test_weedlint.py."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def path_one():
+    with lock_a:
+        with lock_b:  # edge lock_a -> lock_b
+            pass
+
+
+def path_two():
+    with lock_b:
+        with lock_a:  # edge lock_b -> lock_a: CYCLE
+            pass
+
+
+def multi_item():
+    # `with a, b:` orders left-to-right — consistent with path_one, adds
+    # no new cycle beyond the planted one
+    with lock_a, lock_b:
+        pass

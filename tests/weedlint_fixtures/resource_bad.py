@@ -1,0 +1,36 @@
+"""Planted violations for the resource-safety family. Never imported;
+parsed only."""
+
+import os
+import tempfile
+from contextlib import ExitStack
+
+
+def leaky(path):
+    f = open(path)  # BAD: no with
+    return f.read()
+
+
+def littered():
+    t = tempfile.NamedTemporaryFile(delete=False)  # BAD: no unlink anywhere
+    t.write(b"x")
+    return t.name
+
+
+def fine_with(path):
+    with open(path) as f:  # fine
+        return f.read()
+
+
+def fine_stack(paths):
+    with ExitStack() as stack:
+        files = [stack.enter_context(open(p)) for p in paths]  # fine
+        return [f.read() for f in files]
+
+
+def fine_consumed():
+    t = tempfile.NamedTemporaryFile(delete=False)  # fine: unlinked below
+    try:
+        t.write(b"x")
+    finally:
+        os.unlink(t.name)

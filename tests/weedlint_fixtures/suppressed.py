@@ -1,0 +1,21 @@
+"""Suppression-semantics fixture. Never imported; parsed only."""
+
+
+def properly_suppressed(path):
+    # weedlint: ignore[open-no-ctx] fixture: handle ownership is intentional here
+    f = open(path)
+    return f
+
+
+def suppressed_without_reason(path):
+    f = open(path)  # weedlint: ignore[open-no-ctx]
+    return f
+
+
+def unknown_rule(path):
+    f = open(path)  # weedlint: ignore[not-a-rule] typo'd rule must not silence
+    return f
+
+
+# weedlint: ignore[tmpfile-no-unlink] nothing here ever fires this rule
+UNUSED = 1

@@ -1,0 +1,38 @@
+"""Planted violation: module-level mutable state written from an
+executor callback without the lock. Never imported; parsed only."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_cache: dict = {}
+_counters = {}
+_cache_lock = threading.Lock()
+_pool = ThreadPoolExecutor(2)
+
+
+def _refresh(key):
+    _cache[key] = 1  # BAD: no lock held
+    _counters.pop(key, None)  # BAD: mutator call without lock
+    with _cache_lock:
+        _cache["ok"] = 2  # fine: under the lock
+
+
+def _thread_body():
+    with _cache_lock:
+        _counters["ticks"] = 0  # fine
+
+
+def kick():
+    _pool.submit(_refresh, "a")
+    threading.Thread(target=_thread_body).start()
+    _cache["main"] = 3  # fine: kick() is not a registered callback
+
+
+class Worker:
+    """Bound-method callbacks (the package's dominant shape) count too."""
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        _counters["loop"] = 1  # BAD: bound-method callback, no lock

@@ -1,0 +1,13 @@
+"""Planted wire-drift violations: the handler reads a request field the
+schema does not have and returns a response key it does not have.
+Never imported; parsed only."""
+
+
+class Server:
+    def _build(self, svc):
+        svc.add("DoThing", self._rpc_do_thing)
+
+    def _rpc_do_thing(self, req, ctx):
+        vid = req["volume_id"]  # fine: in DoThingRequest
+        who = req["requester"]  # BAD: not a DoThingRequest field
+        return {"ok": True, "extra": who}  # "extra" BAD: not in DoThingResponse
