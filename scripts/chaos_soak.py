@@ -17,10 +17,17 @@ per-holder cap + suspicion window on the degraded-read ladder exists
 for) and SIGCONT a few seconds later. No process ever restarts, so any
 stall in the read path is the ladder's fault, not a reboot's.
 
+`--latency` additionally records every verification read in the SLO
+recorder (seaweedfs_tpu/ec/slo.py) and folds p50/p99 per class (reads
+against the EC'd volume vs plain replicated volumes) into the SOAK
+artifact — a soak run then doubles as SLO evidence alongside weedload's
+open-loop artifact (closed-loop here: these reads retry and pace
+themselves, so treat the quantiles as a floor, not the user-facing tail).
+
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
-      python scripts/chaos_soak.py [--seconds 300] [--wedge]
-Writes artifacts/SOAK_r06.json and exits nonzero on any lost byte.
+      python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency]
+Writes artifacts/SOAK_r07.json and exits nonzero on any lost byte.
 """
 
 from __future__ import annotations
@@ -109,13 +116,17 @@ def main() -> int:
     if "--seconds" in sys.argv:
         seconds = int(sys.argv[sys.argv.index("--seconds") + 1])
     wedge_mode = "--wedge" in sys.argv
+    latency_mode = "--latency" in sys.argv
     rng = random.Random(7)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     from seaweedfs_tpu.cluster.client import MasterClient
     from seaweedfs_tpu.cluster.master import MasterServer
     from seaweedfs_tpu import rpc as _rpc
+    from seaweedfs_tpu.ec import slo
     from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    lat_rec = slo.LatencyRecorder() if latency_mode else None
 
     report: dict = {
         "when": time.strftime("%FT%TZ", time.gmtime()),
@@ -172,7 +183,18 @@ def main() -> int:
                     got = None
                     for attempt in range(12 if final else 3):
                         try:
+                            t0 = time.monotonic()
                             got = client.read(fid)
+                            if lat_rec is not None:
+                                klass = (
+                                    "ec"
+                                    if int(fid.split(",", 1)[0])
+                                    == report.get("ec_encoded_vid")
+                                    else "replicated"
+                                )
+                                lat_rec.observe(
+                                    "soak", klass, time.monotonic() - t0
+                                )
                             break
                         except Exception:
                             report["read_failures_transient"] += 1
@@ -332,9 +354,14 @@ def main() -> int:
             master.stop()
 
     report["files"] = len(blobs)
+    if lat_rec is not None:
+        # closed-loop quantiles per read class: SLO evidence riding along
+        # with every soak run (weedload's open-loop artifact is the
+        # user-facing number; this one is the floor under retries)
+        report["latency"] = lat_rec.phases().get("soak", {})
     report["ok"] = not report["lost"]
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, "SOAK_r06.json"), "w", encoding="utf-8") as f:
+    with open(os.path.join(ART, "SOAK_r07.json"), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["ok"] else 1

@@ -1,0 +1,626 @@
+"""weedload: open-loop SLO load harness for degraded EC reads.
+
+Grown out of chaos_soak.py's real-cluster driver: a live master + volume
+servers, zipfian keys over the master HTTP front, a CONFIGURABLE
+degraded fraction (data shards of the EC'd volume dropped cluster-wide,
+so their needles reconstruct on every read), and mid-run chaos (SIGKILL
+restarts and SIGSTOP wedges of shard holders). Unlike the soak, the
+generator is OPEN-LOOP: arrivals fire on a Poisson schedule at the
+target rate whether or not earlier requests returned, and each latency
+is measured from the request's SCHEDULED arrival — a stalled server
+shows up as queueing delay in the tail, exactly like it would for real
+users, instead of silently throttling the offered load (the
+closed-loop "coordinated omission" failure mode).
+
+Every preloaded needle is classified up front by the stripe math
+(.ecx index + interval locate): a read is `degraded` when any of its
+intervals lands on a dropped shard (it MUST reconstruct), `ec_intact`
+when it lives on the EC volume's surviving shards, `healthy` when it
+lives on a plain replicated volume. The stated SLO compares degraded
+p99 < FACTOR x healthy p99 over the whole run.
+
+Shards 5-9 are spread to TWO extra holders so degraded fan-outs cross
+the network and hedged fetches have a second holder to race.
+
+Usage (real run; writes artifacts/SLO_r01.json):
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
+      python scripts/weedload.py --seconds 120 --rps 40 --chaos
+Smoke (tier-1; in-process servers, <=20 s, schema + zero-loss gate):
+  python scripts/weedload.py --smoke --out /tmp/SLO_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+
+#: counters scraped from every node's /metrics at run end — the server-side
+#: evidence that hedging/coalescing/admission actually engaged
+SCRAPED_COUNTERS = (
+    "weedtpu_hedge_fired_total",
+    "weedtpu_hedge_won_total",
+    "weedtpu_coalesced_reads_total",
+    "weedtpu_rebuild_admission_waits_total",
+    "weedtpu_degraded_read_seconds_count",
+    "weedtpu_degraded_read_errors_total",
+)
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seconds", type=float, default=120.0,
+                   help="measured load time (split steady/chaos)")
+    p.add_argument("--rps", type=float, default=40.0, help="offered arrival rate")
+    p.add_argument("--objects", type=int, default=160, help="preloaded objects")
+    p.add_argument("--zipf", type=float, default=1.1, help="zipf skew s")
+    p.add_argument("--concurrency", type=int, default=64,
+                   help="client worker threads (open-loop: queueing counts)")
+    p.add_argument("--client-timeout", type=float, default=2.0,
+                   help="per-location HTTP timeout: a wedged replica costs "
+                        "this much before the client fails over, for healthy "
+                        "and degraded traffic alike (30 s would let one "
+                        "SIGSTOP dominate every class's tail)")
+    p.add_argument("--dropped-shards", type=int, nargs="*", default=[0, 1],
+                   help="data shards deleted cluster-wide (degraded fraction)")
+    p.add_argument("--ec-large-block", type=int, default=1 << 20,
+                   help="EC large-block size for the converted volume: "
+                        "small relative to the volume so needles stripe "
+                        "across shards (the production 1 GB default would "
+                        "put a bench-sized volume entirely on shard 0)")
+    p.add_argument("--ec-small-block", type=int, default=16 << 10)
+    p.add_argument("--chaos", action="store_true",
+                   help="second phase with kills + SIGSTOP wedges")
+    p.add_argument("--rebuild-storm", action="store_true",
+                   help="launch concurrent remote rebuilds mid-chaos so "
+                        "bulk slab streams contend with foreground reads "
+                        "through the admission gate (servers start with "
+                        "WEEDTPU_REBUILD_MAX_INFLIGHT=4 unless overridden)")
+    p.add_argument("--wedge-seconds", type=float, default=12.0,
+                   help="SIGSTOP duration (must outlast the 10 s per-holder "
+                        "transport timeout for the suspicion path to fire)")
+    p.add_argument("--slo-factor", type=float, default=5.0)
+    p.add_argument("--out", default=None,
+                   help="artifact path; defaults to artifacts/SLO_r01.json "
+                        "for real runs and a /tmp path for --smoke (a "
+                        "casual smoke must never overwrite the committed "
+                        "real-run evidence)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny in-process cluster, <=20 s, schema gate")
+    p.add_argument("--require-slo", action="store_true",
+                   help="exit 2 when the SLO verdict is not ok")
+    p.add_argument("--seed", type=int, default=7)
+    return p.parse_args(argv)
+
+
+def classify_needles(base: str, dropped: set[int]) -> tuple[set[int], set[int]]:
+    """(degraded_ids, all_ids) for the EC volume at `base`: a needle is
+    degraded when ANY of its record intervals maps to a dropped shard —
+    the same locate math the serving path runs, executed offline on the
+    committed .ecx/.eci, so the classification is exact, not sampled."""
+    from seaweedfs_tpu.ec import locate as locate_mod
+    from seaweedfs_tpu.ec import stripe
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage import types
+
+    info = stripe.read_ec_info(base)
+    assert info is not None, f"{base}.eci missing — cannot classify"
+    large, small = int(info["large_block_size"]), int(info["small_block_size"])
+    dat_size = int(info["dat_size"])
+    with open(base + ".ecx", "rb") as f:
+        entries = idx_mod.index_entries_array(f.read())
+    degraded, everyone = set(), set()
+    for i in range(len(entries)):
+        key = int(entries[i]["key"])
+        size = int(entries[i]["size"])
+        if types.is_deleted(size):
+            continue
+        everyone.add(key)
+        off = types.offset_to_actual(int(entries[i]["offset"]))
+        whole = types.actual_size(size, 3)
+        ivs = locate_mod.locate_data(large, small, dat_size, off, whole)
+        if any(iv.to_shard_id_and_offset(large, small)[0] in dropped for iv in ivs):
+            degraded.add(key)
+    return degraded, everyone
+
+
+def zipf_cdf(n: int, s: float) -> list[float]:
+    weights = [1.0 / (rank ** s) for rank in range(1, n + 1)]
+    total = sum(weights)
+    cdf, acc = [], 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    return cdf
+
+
+def pick_zipf(rng: random.Random, keys: list, cdf: list[float]):
+    import bisect
+
+    return keys[min(bisect.bisect_left(cdf, rng.random()), len(keys) - 1)]
+
+
+class CounterScraper:
+    """Accumulates the servers' /metrics counters ACROSS process
+    generations: a killed-and-restarted node comes back with zeroed
+    counters, so the chaos loop scrapes each victim right before the
+    kill and the run end scrapes everyone — every generation is counted
+    exactly once and a restart can no longer erase the evidence that
+    hedging/coalescing/admission engaged."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {name: 0.0 for name in SCRAPED_COUNTERS}
+
+    def scrape(self, http_port: int) -> None:
+        url = f"http://127.0.0.1:{http_port}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                text = r.read().decode()
+        except Exception:  # noqa: BLE001 — a dead node scrapes as zero
+            return
+        for line in text.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            name_part, _, value = line.rpartition(" ")
+            bare = name_part.split("{", 1)[0]
+            if bare in self.totals:
+                try:
+                    self.totals[bare] += float(value)
+                except ValueError:
+                    continue
+
+
+def ec_encode_and_spread(
+    rpc_mod, VOLUME_SERVICE, nodes, vid: int, dropped: list[int],
+    large_block: int, small_block: int,
+) -> str:
+    """EC-encode `vid` on its owner, spread shards 5-9 to two other
+    holders (hedging needs a second holder to race), drop `dropped`
+    cluster-wide, and return the owner's base path (for classification).
+    `nodes` entries expose .grpc (port) and .dir — true for both the
+    subprocess Node and the in-process shim."""
+    owner = None
+    for n in nodes:
+        try:
+            with rpc_mod.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                st = c.call(VOLUME_SERVICE, "VolumeStatus", {"volume_id": vid})
+            if st.get("kind") == "normal":
+                owner = n
+                break
+        except Exception:  # noqa: BLE001 — not the owner
+            continue
+    assert owner is not None, f"no node owns volume {vid}"
+    with rpc_mod.RpcClient(f"127.0.0.1:{owner.grpc}") as c:
+        c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+        c.call(
+            VOLUME_SERVICE, "VolumeEcShardsGenerate",
+            {
+                "volume_id": vid,
+                "large_block_size": large_block,
+                "small_block_size": small_block,
+            },
+            timeout=300,
+        )
+        c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+    # the normal volume must vanish from EVERY holder, replicas included:
+    # with replication 001 a surviving replica would keep serving these
+    # needles as a plain volume and the "degraded" class would silently
+    # measure replica reads whenever the master lists the replica first
+    for n in nodes:
+        try:
+            with rpc_mod.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                c.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
+        except Exception:  # noqa: BLE001 — node never held a replica
+            continue
+    # survivable 2-resident placement: owner keeps the non-spread shards,
+    # both peers take 5-9, the second peer additionally mirrors the rest —
+    # every surviving shard then has TWO holders, so one killed/wedged
+    # node never makes the stripe unreadable (and every hedged fetch has
+    # a second holder to race)
+    spread = [s for s in (5, 6, 7, 8, 9) if s not in dropped]
+    rest = [s for s in range(14) if s not in dropped and s not in spread]
+    others = [n for n in nodes if n is not owner][:2]
+    for peer, shard_sets in ((others[0], [spread]), (others[1], [spread, rest])):
+        with rpc_mod.RpcClient(f"127.0.0.1:{peer.grpc}") as c:
+            for shard_ids in shard_sets:
+                c.call(
+                    VOLUME_SERVICE, "VolumeEcShardsCopy",
+                    {
+                        "volume_id": vid,
+                        "shard_ids": shard_ids,
+                        "source_data_node": f"127.0.0.1:{owner.grpc}",
+                        "copy_ecx_file": True,
+                    },
+                    timeout=120,
+                )
+            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+    with rpc_mod.RpcClient(f"127.0.0.1:{owner.grpc}") as c:
+        c.call(
+            VOLUME_SERVICE, "VolumeEcShardsDelete",
+            {"volume_id": vid, "shard_ids": sorted(set(spread) | set(dropped))},
+        )
+    return os.path.join(owner.dir, str(vid))
+
+
+class _InprocNode:
+    """chaos_soak.Node-shaped shim around an in-process VolumeServer so
+    the smoke path reuses the exact encode/spread/load machinery (no
+    subprocess spawn in tier-1's 20 s budget). Wedges/kills are no-ops:
+    you cannot SIGSTOP your own test process."""
+
+    def __init__(self, i: int, dirpath: str, master_addr: str):
+        from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+        self.i = i
+        self.dir = dirpath
+        self.vs = VolumeServer(
+            [dirpath], master_addr, heartbeat_interval=0.5, max_volume_count=30
+        )
+        self.vs.start()
+        self.grpc = self.vs.grpc_port
+        self.http = self.vs.port
+        self.wedged = False
+
+    @property
+    def alive(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        self.vs.stop()
+
+
+def run_load(
+    args, client, rec, lost, keys, cdf, klass_of, phases: list[tuple[str, float]],
+    chaos_fn=None,
+):
+    """Open-loop Poisson arrivals over `phases` ([(name, seconds), ...]):
+    latency is measured from each request's SCHEDULED time, so server
+    stalls surface as tail latency instead of reduced offered load."""
+    rng = random.Random(args.seed + 1)
+    pool = ThreadPoolExecutor(max_workers=args.concurrency)
+    issued = 0
+
+    def one(fid: str, want: bytes, sched: float, phase: str) -> None:
+        klass = klass_of(fid)
+        try:
+            got = client.read(fid)
+        except Exception:  # noqa: BLE001 — open loop records, never retries
+            rec.error(phase, klass)
+            return
+        lat = time.monotonic() - sched
+        if got != want:
+            lost.append({"fid": fid, "why": "BYTES DIFFER (live read)"})
+            rec.error(phase, klass)
+        else:
+            rec.observe(phase, klass, lat)
+
+    try:
+        for phase, seconds in phases:
+            stop_chaos = threading.Event()
+            chaos_thread = None
+            if chaos_fn is not None and phase == "chaos":
+                chaos_thread = threading.Thread(
+                    target=chaos_fn, args=(stop_chaos,), daemon=True
+                )
+                chaos_thread.start()
+            t_end = time.monotonic() + seconds
+            next_t = time.monotonic()
+            while True:
+                now = time.monotonic()
+                if now >= t_end:
+                    break
+                if now < next_t:
+                    time.sleep(min(next_t - now, 0.02))
+                    continue
+                fid = pick_zipf(rng, keys, cdf)
+                pool.submit(one, fid, client_blobs[fid], next_t, phase)
+                issued += 1
+                next_t += rng.expovariate(args.rps)
+            stop_chaos.set()
+            if chaos_thread is not None:
+                chaos_thread.join(timeout=args.wedge_seconds + 10)
+    finally:
+        pool.shutdown(wait=True)
+    return issued
+
+
+client_blobs: dict[str, bytes] = {}  # fid -> expected bytes (module-level
+# so the worker closure in run_load stays picklable-simple)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rng = random.Random(args.seed)
+
+    from seaweedfs_tpu import rpc as rpc_mod
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.ec import slo
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+    from seaweedfs_tpu.storage.file_id import FileId
+    from seaweedfs_tpu.utils import config
+
+    if args.smoke:
+        args.seconds = min(args.seconds, 4.0)
+        args.objects = min(args.objects, 30)
+        args.rps = min(args.rps, 30.0)
+        args.chaos = False
+    if args.out is None:
+        args.out = (
+            os.path.join(tempfile.gettempdir(), "SLO_smoke.json")
+            if args.smoke
+            else os.path.join(ART, "SLO_r01.json")
+        )
+
+    if args.rebuild_storm:
+        # must land BEFORE the server processes start (they read it once
+        # at init); a tight gate makes the storm actually queue
+        os.environ.setdefault("WEEDTPU_REBUILD_MAX_INFLIGHT", "4")
+
+    rec = slo.LatencyRecorder()
+    lost: list[dict] = []
+    chaos_report = {"mode": "kill+wedge" if args.chaos else "none",
+                    "kills": 0, "wedges": 0}
+
+    with tempfile.TemporaryDirectory() as td:
+        master = MasterServer(port=0, reap_interval=3600)
+        master.start()
+        nodes = []
+        client = None
+        try:
+            if args.smoke:
+                for i in range(3):
+                    d = os.path.join(td, f"n{i}")
+                    os.makedirs(d)
+                    nodes.append(_InprocNode(i, d, master.address))
+            else:
+                from chaos_soak import Node
+
+                for i in range(3):
+                    d = os.path.join(td, f"n{i}")
+                    os.makedirs(d)
+                    n = Node(i, d, master.address)
+                    n.start()
+                    nodes.append(n)
+            client = MasterClient(master.address, http_timeout=args.client_timeout)
+            deadline0 = time.monotonic() + 60
+            while time.monotonic() < deadline0 and len(master.topology.nodes) < 3:
+                time.sleep(0.3)
+            assert len(master.topology.nodes) == 3, "cluster did not form"
+
+            # -- preload batch 1: the objects that will live on the EC'd
+            # volume (written first so they share one volume) --------------
+            client_blobs.clear()
+
+            def write_some(count: int) -> None:
+                for _ in range(count):
+                    size = rng.randrange(500, 40_000)
+                    payload = rng.getrandbits(8 * size).to_bytes(size, "little")
+                    a = client.assign(replication="001")
+                    client.upload(a.fid, payload)
+                    client_blobs[a.fid] = payload
+
+            n_ec = max(10, args.objects // 2)
+            write_some(n_ec)
+
+            # -- EC the busiest volume, spread + drop shards --------------
+            by_vid: dict[int, int] = {}
+            for fid in client_blobs:
+                by_vid[int(fid.split(",", 1)[0])] = (
+                    by_vid.get(int(fid.split(",", 1)[0]), 0) + 1
+                )
+            ec_vid = max(by_vid, key=lambda v: by_vid[v])
+            dropped = set(args.dropped_shards)
+            base = ec_encode_and_spread(
+                rpc_mod, VOLUME_SERVICE, nodes, ec_vid, sorted(dropped),
+                args.ec_large_block, args.ec_small_block,
+            )
+            degraded_ids, _ = classify_needles(base, dropped)
+
+            # -- preload batch 2: the EC'd volume left the writable set, so
+            # these land on freshly-grown replicated volumes = the healthy
+            # comparison class ---------------------------------------------
+            write_some(args.objects - n_ec)
+
+            def klass_of(fid: str) -> str:
+                f = FileId.parse(fid)
+                if f.volume_id != ec_vid:
+                    return "healthy"
+                return "degraded" if f.key in degraded_ids else "ec_intact"
+
+            by_klass = {"healthy": 0, "degraded": 0, "ec_intact": 0}
+            for fid in client_blobs:
+                by_klass[klass_of(fid)] += 1
+
+            # -- warmup: one unrecorded pass over the EC volume's needles
+            # so the steady phase measures steady state, not the first
+            # read's decode-matrix build + XLA bucket compilation ----------
+            for fid in client_blobs:
+                if klass_of(fid) != "healthy":
+                    try:
+                        client.read(fid)
+                    except Exception:  # noqa: BLE001 — warmup best-effort
+                        pass
+
+            # -- open-loop load -------------------------------------------
+            keys = sorted(client_blobs)
+            rng.shuffle(keys)
+            cdf = zipf_cdf(len(keys), args.zipf)
+            if args.chaos:
+                phases = [("steady", args.seconds / 2), ("chaos", args.seconds / 2)]
+            else:
+                phases = [("steady", args.seconds)]
+
+            scraper = CounterScraper()
+
+            storm_threads: list[threading.Thread] = []
+            if args.rebuild_storm:
+                # concurrent remote rebuilds of the dropped shards at the
+                # two non-owner holders, launched INTO the steady phase:
+                # their survivor slab pulls ride the token-gated rebuild
+                # lane while foreground reads keep flowing (the rebuilt
+                # files stay unmounted, so the degraded classification is
+                # untouched; launching them under kills would just race
+                # the sole holder of the unspread shards)
+                chaos_report["rebuilds"] = []
+
+                def one_rebuild(node) -> None:
+                    try:
+                        with rpc_mod.RpcClient(f"127.0.0.1:{node.grpc}") as c:
+                            resp = c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsRebuild",
+                                {"volume_id": ec_vid, "remote": True},
+                                timeout=240,
+                            )
+                            # the storm measures the rebuild LANE, not the
+                            # repair result: scrub the rebuilt files so a
+                            # later chaos restart cannot rescan them into
+                            # service and quietly un-degrade the volume
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsDelete",
+                                {
+                                    "volume_id": ec_vid,
+                                    "shard_ids": resp.get("rebuilt_shard_ids", []),
+                                },
+                            )
+                        chaos_report["rebuilds"].append({
+                            "target": node.i,
+                            "rebuilt": resp.get("rebuilt_shard_ids", []),
+                        })
+                    except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                        chaos_report["rebuilds"].append(
+                            {"target": node.i, "error": str(e)[:160]}
+                        )
+
+                for n in nodes:
+                    if not base.startswith(n.dir):
+                        t = threading.Thread(
+                            target=one_rebuild, args=(n,), daemon=True
+                        )
+                        t.start()
+                        storm_threads.append(t)
+
+            def chaos_fn(stop: threading.Event) -> None:
+                crng = random.Random(args.seed + 2)
+                while not stop.is_set():
+                    victims = [n for n in nodes if n.alive and not n.wedged]
+                    if len(victims) > 1:
+                        victim = crng.choice(victims)
+                        if crng.random() < 0.6:
+                            victim.wedge()
+                            chaos_report["wedges"] += 1
+                            stop.wait(args.wedge_seconds)
+                            victim.unwedge()
+                        else:
+                            # harvest the dying generation's counters first
+                            scraper.scrape(victim.http)
+                            victim.kill(hard=True)
+                            chaos_report["kills"] += 1
+                            stop.wait(3.0)
+                            victim.start()
+                            stop.wait(2.0)
+                    stop.wait(crng.uniform(1.0, 3.0))
+
+            issued = run_load(
+                args, client, rec, lost, keys, cdf, klass_of, phases,
+                chaos_fn=chaos_fn if args.chaos else None,
+            )
+            for t in storm_threads:
+                t.join(timeout=10)
+
+            # -- heal + final zero-loss verification ----------------------
+            for n in nodes:
+                if not args.smoke:
+                    n.unwedge()
+                    if not n.alive:
+                        n.start()
+            if args.chaos:
+                time.sleep(6.0)
+            for fid, want in client_blobs.items():
+                got = None
+                for _ in range(12):
+                    try:
+                        got = client.read(fid)
+                        break
+                    except Exception:  # noqa: BLE001 — post-chaos settle
+                        time.sleep(1.0)
+                if got is None:
+                    lost.append({"fid": fid, "why": "unreadable at end"})
+                elif got != want:
+                    lost.append({"fid": fid, "why": "BYTES DIFFER"})
+
+            # in-process smoke nodes SHARE the module-global stats
+            # registry — scraping all three would triple-count; one node's
+            # /metrics already holds the whole process's counters
+            for n in (nodes[:1] if args.smoke else nodes):
+                scraper.scrape(n.http)
+            counters = scraper.totals
+        finally:
+            if client is not None:
+                client.close()
+            for n in nodes:
+                try:
+                    if args.smoke:
+                        n.stop()
+                    else:
+                        n.unwedge()
+                        n.kill(hard=False)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            master.stop()
+
+    report = slo.assemble_report(
+        rec,
+        workload={
+            "open_loop": True,
+            "arrivals": "poisson",
+            "rps": args.rps,
+            "seconds": args.seconds,
+            "issued": issued,
+            "zipf_s": args.zipf,
+            "objects": args.objects,
+            "objects_by_class": by_klass,
+            "dropped_shards": sorted(dropped),
+            "ec_volume": ec_vid,
+            "concurrency": args.concurrency,
+            "front": "master-http",
+            "servers": "in-process" if args.smoke else "subprocess",
+        },
+        chaos=chaos_report,
+        knobs={
+            name: config.env(name)
+            for name in (
+                "WEEDTPU_HEDGE_READS", "WEEDTPU_HEDGE_DELAY_MS",
+                "WEEDTPU_COALESCE_READS", "WEEDTPU_REBUILD_MAX_INFLIGHT",
+                "WEEDTPU_REBUILD_YIELD_MS", "WEEDTPU_LOOKUP_RETRIES",
+            )
+        },
+        counters=counters,
+        lost=lost,
+        slo_factor=args.slo_factor,
+    )
+    slo.write_report(args.out, report)
+    print(json.dumps(report, indent=1))
+    if report["lost"]:
+        return 1
+    if args.require_slo and not report["slo"]["ok"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,7 @@ import base64
 import http.server
 import json
 import os
+import random
 import shutil
 import socketserver
 import threading
@@ -34,7 +35,12 @@ from seaweedfs_tpu import rpc, stats
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.security import Guard
 from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
-from seaweedfs_tpu.ec.ec_volume import EcVolume, NeedleDeleted, NeedleNotFound
+from seaweedfs_tpu.ec.ec_volume import (
+    EcDegradedReadError,
+    EcVolume,
+    NeedleDeleted,
+    NeedleNotFound,
+)
 from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE, Heartbeat
 from seaweedfs_tpu.storage.file_id import FileId
 from seaweedfs_tpu.storage.needle import Needle
@@ -53,6 +59,10 @@ EC_SLAB_READ_TIMEOUT = 120.0
 _SLAB_CHUNK = 4 * 1024 * 1024  # bound on one CRC-framed slab-stream chunk
 #: parallel survivor-fetch threads for a distributed rebuild (RTT-bound)
 EC_REBUILD_FETCH_WORKERS = 16
+#: longest a slab stream may WAIT for a rebuild-lane token before being
+#: refused outright — an unbounded blocking acquire would pin this gRPC
+#: worker and re-create the very starvation the gate exists to prevent
+EC_SLAB_ADMISSION_WAIT = 15.0
 
 
 def _first_multipart_file(body: bytes, ctype: str):
@@ -158,6 +168,14 @@ class VolumeServer:
         # pre-invalidation) result into the cache
         self._shard_locs_gen: dict[int, int] = {}
         self.ec_lookup_ttl = ec_lookup_ttl
+        # admission control for the rebuild lane: a storm of bulk
+        # VolumeEcShardSlabRead streams (several concurrent rebuilds
+        # targeting this holder) would otherwise occupy every RPC worker
+        # and starve foreground interval reads. Tokens are taken for the
+        # LIFE of a slab stream; waiters queue and are counted.
+        self._rebuild_gate = threading.BoundedSemaphore(
+            config.env("WEEDTPU_REBUILD_MAX_INFLIGHT")
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -320,7 +338,36 @@ class VolumeServer:
                 ev.wait(timeout=30.0)
                 continue  # re-check the cache; become leader if still cold
             try:
-                resp = self._master_query("LookupEcVolume", {"volume_id": vid})
+                # bounded retry with decorrelated jitter: ONE transient
+                # master hiccup must not fail the leader AND every waiter
+                # of the burst (each would retry the loop, elect a new
+                # leader, and hammer the recovering master in lockstep).
+                # Only TRANSIENT failures retry — an application-level
+                # fault from a healthy master is final on first answer,
+                # and re-asking would just hold the single-flight
+                # leadership while every waiter queues behind a sleep.
+                retries = int(config.env("WEEDTPU_LOOKUP_RETRIES"))
+                delay = 0.05
+                for attempt in range(retries + 1):
+                    try:
+                        resp = self._master_query(
+                            "LookupEcVolume", {"volume_id": vid}
+                        )
+                        break
+                    except grpc.RpcError as e:
+                        if attempt >= retries or e.code() not in (
+                            grpc.StatusCode.UNAVAILABLE,
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                        ):
+                            raise
+                        delay = min(1.0, random.uniform(0.05, delay * 3.0))
+                        time.sleep(delay)
+                    except Exception:  # noqa: BLE001 — transport-level
+                        # (ConnectionError & co. from a dying channel)
+                        if attempt >= retries:
+                            raise
+                        delay = min(1.0, random.uniform(0.05, delay * 3.0))
+                        time.sleep(delay)
                 locs: dict[int, list[str]] = {}
                 for entry in resp.get("shard_id_locations", []):
                     addrs = [
@@ -461,7 +508,47 @@ class VolumeServer:
                     return cached[0]
             return None
 
+        def holders_for(shard_id: int) -> list[str]:
+            """Known holder addrs behind `shard_id`, LOCAL-STATE-ONLY like
+            peer_for (the hedge decision runs mid-read and must never add
+            a master round-trip): serving cache first (fresher after an
+            invalidation), then this reader's last successful lookup."""
+            with self._shard_locs_lock:
+                hit = self._shard_locs.get(vid)
+            if hit is not None and hit[1].get(shard_id):
+                return list(hit[1][shard_id])
+            return list(last_locs.get(shard_id, ()))
+
+        def via(addr: str, shard_id: int, offset: int, size: int) -> Optional[bytes]:
+            """One single-holder interval read — the hedge backup path:
+            same transport, timeout, and live-attempt bookkeeping as the
+            ladder, but pinned at `addr` so the backup provably lands on a
+            DIFFERENT holder than the primary it is racing."""
+            token = object()
+            attempts[token] = (shard_id, addr, time.monotonic())
+            try:
+                chunks = self._peer_pool.get(addr).stream(
+                    VOLUME_SERVICE,
+                    "VolumeEcShardRead",
+                    {
+                        "volume_id": vid,
+                        "shard_id": shard_id,
+                        "offset": offset,
+                        "size": size,
+                    },
+                    timeout=EC_SHARD_READ_TIMEOUT,
+                )
+                buf = b"".join(chunks)
+                return buf if len(buf) == size else None
+            except Exception:  # noqa: BLE001 — a failed backup is a miss
+                self._peer_pool.invalidate(addr)
+                return None
+            finally:
+                attempts.pop(token, None)
+
         read.peer_for = peer_for
+        read.holders_for = holders_for
+        read.via = via
         return read
 
     def _open_ec_volume(self, vid: int) -> Optional[EcVolume]:
@@ -1216,32 +1303,57 @@ class VolumeServer:
         and a PRIVATE file handle so a long stream never seek-races the
         serving handles interval reads use. EOF ends the stream short;
         the client zero-fills, mirroring local read_padded_into."""
-        delay_ms = config.env("WEEDTPU_BENCH_RPC_DELAY_MS")
-        if delay_ms:
-            # bench-only RTT model, same rationale as VolumeEcShardRead:
-            # one sleep per bulk window (the per-request latency a real
-            # network charges), GIL-released so client-side overlap shows
-            time.sleep(delay_ms / 1e3)
-        vid = int(req["volume_id"])
-        shard_id = int(req["shard_id"])
-        offset = int(req["offset"])
-        size = int(req["size"])
-        chunk_size = min(max(64 * 1024, int(req.get("chunk_size") or _SLAB_CHUNK)), 8 << 20)
-        ev = self.store.get_ec_volume(vid)
-        if ev is None:
-            raise rpc.NotFoundFault(f"ec volume {vid} not mounted")
-        if shard_id not in ev._shard_files:
-            raise rpc.NotFoundFault(f"shard {shard_id} of volume {vid} not local")
-        path = stripe.shard_file_name(ev.base, shard_id)
-        with open(path, "rb") as f:
-            f.seek(offset)
-            remaining = size
-            while remaining > 0:
-                buf = f.read(min(chunk_size, remaining))
-                if not buf:
-                    break  # EOF: short stream, client zero-fills
-                yield rpc.crc_frame(buf)
-                remaining -= len(buf)
+        # admission control: slab streams ride a token-gated lane
+        # (WEEDTPU_REBUILD_MAX_INFLIGHT) so a rebuild storm queues here
+        # instead of saturating the RPC worker pool foreground interval
+        # reads (VolumeEcShardRead) share. Tokens are held for the life
+        # of the stream; a non-immediate grant is a counted wait, and the
+        # wait itself is BOUNDED — past it the stream is refused
+        # (RESOURCE_EXHAUSTED, retryable: the rebuilder's slab source
+        # fails over) rather than pinning this worker thread too.
+        if not self._rebuild_gate.acquire(blocking=False):
+            stats.RebuildAdmissionWaits.inc()
+            if not self._rebuild_gate.acquire(timeout=EC_SLAB_ADMISSION_WAIT):
+                raise rpc.RpcFault(
+                    "rebuild slab-read lane saturated "
+                    f"(WEEDTPU_REBUILD_MAX_INFLIGHT="
+                    f"{config.env('WEEDTPU_REBUILD_MAX_INFLIGHT')}); retry",
+                    code=grpc.StatusCode.RESOURCE_EXHAUSTED,
+                )
+        try:
+            delay_ms = config.env("WEEDTPU_BENCH_RPC_DELAY_MS")
+            if delay_ms:
+                # bench-only RTT model, same rationale as VolumeEcShardRead:
+                # one sleep per bulk window (the per-request latency a real
+                # network charges), GIL-released so client-side overlap shows
+                time.sleep(delay_ms / 1e3)
+            vid = int(req["volume_id"])
+            shard_id = int(req["shard_id"])
+            offset = int(req["offset"])
+            size = int(req["size"])
+            chunk_size = min(max(64 * 1024, int(req.get("chunk_size") or _SLAB_CHUNK)), 8 << 20)
+            yield_s = config.env("WEEDTPU_REBUILD_YIELD_MS") / 1e3
+            ev = self.store.get_ec_volume(vid)
+            if ev is None:
+                raise rpc.NotFoundFault(f"ec volume {vid} not mounted")
+            if shard_id not in ev._shard_files:
+                raise rpc.NotFoundFault(f"shard {shard_id} of volume {vid} not local")
+            path = stripe.shard_file_name(ev.base, shard_id)
+            with open(path, "rb") as f:
+                f.seek(offset)
+                remaining = size
+                while remaining > 0:
+                    buf = f.read(min(chunk_size, remaining))
+                    if not buf:
+                        break  # EOF: short stream, client zero-fills
+                    yield rpc.crc_frame(buf)
+                    remaining -= len(buf)
+                    if yield_s > 0 and remaining > 0:
+                        # cooperative yield between chunks: cede the GIL/
+                        # disk to foreground reads under contention
+                        time.sleep(yield_s)
+        finally:
+            self._rebuild_gate.release()
 
     def _rpc_ec_blob_delete(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])
@@ -1323,16 +1435,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         body: bytes,
         content_type: str = "application/octet-stream",
         head: bool = False,
+        headers: Optional[dict] = None,
     ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         if not head:  # HEAD: headers only, or keep-alive streams desync
             self.wfile.write(body)
 
-    def _reply_json(self, code: int, obj: dict, head: bool = False) -> None:
-        self._reply(code, json.dumps(obj).encode(), "application/json", head=head)
+    def _reply_json(
+        self,
+        code: int,
+        obj: dict,
+        head: bool = False,
+        headers: Optional[dict] = None,
+    ) -> None:
+        self._reply(
+            code, json.dumps(obj).encode(), "application/json", head=head,
+            headers=headers,
+        )
 
     def _serve_get(self, head: bool) -> None:
         if urllib.parse.urlparse(self.path).path == "/metrics":
@@ -1415,6 +1539,24 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return
         except PermissionError:
             self._reply_json(403, {"error": "cookie mismatch"}, head=head)
+            return
+        except EcDegradedReadError as e:
+            # a degraded read that could not be served NOW is overload/
+            # partial-failure, not a server bug: 503 + Retry-After (typed
+            # per failure class — suspicion-window length for no-viable-
+            # holders, prompt for a deadline cut) so clients back off
+            # instead of hammering a stripe mid-repair
+            self._reply_json(
+                503,
+                {
+                    "error": str(e),
+                    "class": type(e).__name__,
+                    "attempted": [str(a) for a in e.attempted],
+                    "suspected": [str(s) for s in e.suspected],
+                },
+                head=head,
+                headers={"Retry-After": str(max(1, round(e.retry_after)))},
+            )
             return
         except IOError as e:
             self._reply_json(500, {"error": str(e)}, head=head)

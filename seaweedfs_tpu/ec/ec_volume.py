@@ -37,6 +37,7 @@ from seaweedfs_tpu.ec.constants import (
 from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.utils import config
 
 # remote_reader(shard_id, offset, size) -> bytes | None
 RemoteReader = Callable[[int, int, int], Optional[bytes]]
@@ -48,6 +49,64 @@ class NeedleNotFound(KeyError):
 
 class NeedleDeleted(Exception):
     pass
+
+
+class EcDegradedReadError(IOError):
+    """A degraded read could not be served. Typed (instead of a bare
+    IOError/None bubble) so the volume server can answer 503 with a
+    Retry-After hint and operators can count failure classes apart.
+    Carries WHO was attempted and what the suspicion registry thought at
+    failure time — the difference between "the cluster lost the stripe"
+    and "one wedged peer is poisoning the ladder"."""
+
+    #: seconds a client should back off before retrying; subclasses pick
+    #: a default matched to their failure mode, callers may override
+    retry_after: float = 1.0
+
+    def __init__(
+        self,
+        msg: str,
+        shard_id: Optional[int] = None,
+        attempted: tuple = (),
+        suspected: tuple = (),
+        retry_after: Optional[float] = None,
+    ):
+        super().__init__(msg)
+        self.shard_id = shard_id
+        #: holder keys (peer addrs when the reader names peers, else
+        #: (volume, shard) tuples) the read actually tried
+        self.attempted = list(attempted)
+        #: holder keys sitting in a suspicion window when the read failed
+        self.suspected = list(suspected)
+        if retry_after is not None:
+            self.retry_after = retry_after
+
+
+class EcNoViableHolders(EcDegradedReadError):
+    """Too few survivors reachable and no attempt still pending: every
+    candidate answered a miss, erred, or sat suspected. Retrying sooner
+    than the suspicion backoff mostly re-fails, hence the longer hint."""
+
+    retry_after = 5.0
+
+
+class EcDegradedReadTimeout(EcDegradedReadError):
+    """The overall recover deadline expired with fetches still in flight —
+    survivors exist but answered too slowly; a prompt retry may win."""
+
+    retry_after = 1.0
+
+
+class _CoalesceSlot:
+    """One in-flight degraded decode: the leader publishes its result (or
+    error) here and sets the event; waiters read it instead of decoding."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
 
 
 class EcVolume:
@@ -103,6 +162,11 @@ class EcVolume:
         self._suspicion = suspicion if suspicion is not None else suspicion_mod.GLOBAL
         self._fetch_pool: Optional[ThreadPoolExecutor] = None
         self._fetch_pool_lock = threading.Lock()
+        # single-flight coalescing of concurrent degraded decodes of the
+        # SAME (shard, offset, size): key -> _CoalesceSlot. The lock is
+        # leaf-level (never held across another acquisition or any I/O).
+        self._coalesce: dict[tuple[int, int, int], "_CoalesceSlot"] = {}
+        self._coalesce_lock = threading.Lock()
         # recorded stripe geometry (.eci) wins over constructor defaults —
         # opening shards with the wrong geometry would mis-map every interval
         info = stripe.read_ec_info(base_file_name)
@@ -350,6 +414,12 @@ class EcVolume:
             ):
                 self._mark_holder_suspect(shard_id)
             return None
+        if started:
+            # completed answers feed the per-peer latency EWMA the hedge
+            # delay derives from; misses/wedges never do (see suspicion)
+            self._suspicion.observe_latency(
+                self._holder_key(shard_id), _time.monotonic() - started[0]
+            )
         return np.frombuffer(raw, dtype=np.uint8).copy()
 
     def _read_present(self, shard_id: int, offset: int, size: int) -> Optional[np.ndarray]:
@@ -370,12 +440,57 @@ class EcVolume:
 
     def _recover_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
         """recoverOneRemoteEcShardInterval: read the same interval from every
-        other shard and reconstruct the wanted one."""
+        other shard and reconstruct the wanted one. Concurrent recovers of
+        the SAME interval are single-flight coalesced (WEEDTPU_COALESCE_READS):
+        a hot needle on a lost shard costs one survivor fan-out + decode,
+        with every waiter handed a byte-identical copy."""
         t0 = _time.monotonic()
         try:
-            return self._recover_interval_inner(shard_id, offset, size)
+            if not config.env("WEEDTPU_COALESCE_READS"):
+                return self._recover_interval_inner(shard_id, offset, size)
+            return self._recover_interval_coalesced(shard_id, offset, size)
         finally:
-            stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
+            # DegradedReadSeconds is the CLIENT-facing latency (waiters
+            # included); EcReconstructSeconds counts actual decodes and is
+            # observed in _recover_interval_inner, else N coalesced waiters
+            # would inflate the reconstruct histogram N-fold
+            stats.DegradedReadSeconds.observe(_time.monotonic() - t0)
+
+    def _recover_interval_coalesced(
+        self, shard_id: int, offset: int, size: int
+    ) -> np.ndarray:
+        key = (shard_id, offset, size)
+        with self._coalesce_lock:
+            slot = self._coalesce.get(key)
+            leader = slot is None
+            if leader:
+                slot = self._coalesce[key] = _CoalesceSlot()
+        if not leader:
+            stats.CoalescedReads.inc()
+            # generous bound: the leader's decode is itself bounded by the
+            # fetch deadline + one holder cap; a vanished leader (killed
+            # thread) must not strand waiters forever
+            budget = self.recover_fetch_deadline + self.recover_holder_timeout + 5.0
+            if slot.event.wait(timeout=budget):
+                if slot.error is not None:
+                    raise slot.error
+                assert slot.result is not None
+                return slot.result.copy()
+            return self._recover_interval_inner(shard_id, offset, size)
+        try:
+            out = self._recover_interval_inner(shard_id, offset, size)
+            slot.result = out
+            return out
+        except BaseException as e:
+            slot.error = e
+            raise
+        finally:
+            # unpublish BEFORE waking waiters: a brand-new reader arriving
+            # after the event must elect a fresh leader, never read a slot
+            # that is mid-teardown
+            with self._coalesce_lock:
+                self._coalesce.pop(key, None)
+            slot.event.set()
 
     def _fetch_executor(self) -> ThreadPoolExecutor:
         with self._fetch_pool_lock:
@@ -387,9 +502,13 @@ class EcVolume:
             return self._fetch_pool
 
     def _recover_interval_inner(self, shard_id: int, offset: int, size: int) -> np.ndarray:
-        shards = self._gather_survivors(shard_id, offset, size)
-        rec = self.encoder.reconstruct(shards, wanted=[shard_id])
-        return rec[shard_id]
+        t0 = _time.monotonic()
+        try:
+            shards = self._gather_survivors(shard_id, offset, size)
+            rec = self.encoder.reconstruct(shards, wanted=[shard_id])
+            return rec[shard_id]
+        finally:
+            stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
 
     def _gather_survivors(
         self, shard_id: int, offset: int, size: int
@@ -408,6 +527,8 @@ class EcVolume:
                 shards[s] = buf
                 have += 1
         need = DATA_SHARDS_COUNT - have
+        attempted: tuple = ()
+        deadline_expired = False
         if need > 0 and self.remote_reader is not None:
             # Fan out to ALL remaining survivors at once and take the first
             # `need` arrivals — the reference reads the same interval from
@@ -435,13 +556,28 @@ class EcVolume:
             # not merely slow — marking it suspect. The OVERALL read is
             # still bounded by `recover_fetch_deadline`, unchanged.
             started: dict[int, float] = {}
+            attempted = tuple(self._holder_key(s) for s in candidates)
 
             def _attempt(s: int):
                 started[s] = _time.monotonic()
                 return self.remote_reader(s, offset, size)
 
             futs = {pool.submit(_attempt, s): s for s in candidates}
+            primaries = {sid: fut for fut, sid in futs.items()}
             pending = set(futs)
+            # hedging (WEEDTPU_HEDGE_READS): once a primary fetch has RUN
+            # past the peer's EWMA-derived tail, launch ONE backup against
+            # a different holder; first success wins, the loser is
+            # cancelled/drained, and both results must be byte-identical.
+            hedge_on = bool(config.env("WEEDTPU_HEDGE_READS"))
+            hedge_started: dict[int, float] = {}
+            # sid -> backup future, or None when a submit attempt found no
+            # second holder (memoized: retrying every loop tick would spin
+            # the wait budget down to 5 ms for the rest of the read)
+            hedges: dict[int, object] = {}
+            hedge_targets: dict[int, Optional[str]] = {}
+            hedge_futs: set = set()
+            winners: dict[int, bytes] = {}
             deadline = _time.monotonic() + self.recover_fetch_deadline
             cap = self.recover_holder_timeout
             try:
@@ -449,51 +585,129 @@ class EcVolume:
                     now = _time.monotonic()
                     for fut in list(pending):
                         sid = futs[fut]
-                        t0s = started.get(sid)
-                        if t0s is not None and now - t0s >= cap and not fut.done():
+                        is_hedge = fut in hedge_futs
+                        t0s = (hedge_started if is_hedge else started).get(sid)
+                        if t0s is None or fut.done():
+                            continue
+                        if now - t0s >= cap:
                             # running past the per-holder cap: wedged.
                             # Suspect it, remember the blocked thread, and
                             # stop waiting on it (the read may still
-                            # complete from the other survivors).
+                            # complete from the other survivors). A wedged
+                            # BACKUP blames the alternate holder it was
+                            # pinned at — never the primary's key (which
+                            # names a different, possibly healthy peer).
                             pending.discard(fut)
-                            self._mark_holder_suspect(sid)
-                            self._track_wedged(sid, fut)
+                            if is_hedge:
+                                self._suspect_hedge_target(
+                                    hedge_targets.get(sid), fut
+                                )
+                            else:
+                                self._mark_holder_suspect(sid)
+                                self._track_wedged(sid, fut)
                             stripe._abandon_future(fut)
+                        elif (
+                            hedge_on
+                            and not is_hedge
+                            and sid not in hedges
+                            and now - t0s >= self._hedge_delay(sid)
+                        ):
+                            # memoize the outcome either way: None means
+                            # "no second holder", and must not be retried
+                            # (and re-pay peer lookups) every loop tick
+                            hedges[sid] = self._submit_hedge(
+                                pool, sid, offset, size,
+                                hedge_started, hedge_targets,
+                            )
+                            backup = hedges[sid]
+                            if backup is not None:
+                                hedge_futs.add(backup)
+                                futs[backup] = sid
+                                pending.add(backup)
                     if not pending:
                         break
                     budget = deadline - now
                     if budget <= 0:
+                        deadline_expired = True
                         break
-                    next_cap = min(
-                        (started[futs[f]] + cap - now
-                         for f in pending if futs[f] in started),
-                        default=None,
-                    )
-                    if next_cap is not None:
-                        budget = min(budget, max(next_cap, 0.005))
+                    # wake at the earliest per-holder cap OR pending hedge
+                    # fire time, whichever comes first
+                    wake: list[float] = []
+                    for f in pending:
+                        sid = futs[f]
+                        is_hedge = f in hedge_futs
+                        t0s = (hedge_started if is_hedge else started).get(sid)
+                        if t0s is None:
+                            continue
+                        wake.append(t0s + cap - now)
+                        if hedge_on and not is_hedge and sid not in hedges:
+                            wake.append(t0s + self._hedge_delay(sid) - now)
+                    if wake:
+                        budget = min(budget, max(min(wake), 0.005))
                     done, pending = wait(
                         pending, timeout=budget, return_when=FIRST_COMPLETED
                     )
                     for fut in done:
+                        sid = futs[fut]
+                        is_hedge = fut in hedge_futs
                         try:
                             raw = fut.result()
                         except Exception:  # noqa: BLE001 — a failed peer is a miss
                             raw = None
+                        t0s = (hedge_started if is_hedge else started).get(sid)
+                        now2 = _time.monotonic()
                         if raw is not None and len(raw) == size:
-                            shards[futs[fut]] = np.frombuffer(raw, dtype=np.uint8).copy()
+                            if t0s is not None and not is_hedge:
+                                # primaries only: a hedge's fast answer is
+                                # the OTHER holder's latency and would drag
+                                # the slow peer's estimate down
+                                self._suspicion.observe_latency(
+                                    self._holder_key(sid), now2 - t0s
+                                )
+                            want = winners.get(sid)
+                            if want is not None:
+                                # the hedged pair's LOSER also answered:
+                                # first-success already won, but the bytes
+                                # must agree — a divergence is survivor
+                                # corruption, not a race to tolerate
+                                if bytes(raw) != want:
+                                    stats.DegradedReadErrors.labels(
+                                        "HedgeMismatch"
+                                    ).inc()
+                                    raise IOError(
+                                        f"shard {sid}: hedged fetch returned "
+                                        "bytes differing from the primary's"
+                                    )
+                                continue
+                            winners[sid] = bytes(raw)
+                            shards[sid] = np.frombuffer(
+                                raw, dtype=np.uint8
+                            ).copy()
                             have += 1
+                            if is_hedge:
+                                stats.HedgeWon.inc()
+                            other = (
+                                primaries.get(sid) if is_hedge else hedges.get(sid)
+                            )
+                            if other is not None and other in pending:
+                                pending.discard(other)
+                                self._settle_hedge_loser(other, winners[sid])
                         else:
                             # slow NOTHING = internally-timed-out wedge
                             # (see _remote_fetch_capped); fast None is a
-                            # plain miss and never suspects
-                            sid = futs[fut]
-                            t0s = started.get(sid)
+                            # plain miss and never suspects. Same blame
+                            # rule as the cap: a slow-missing BACKUP names
+                            # its own alternate holder, not the primary.
                             if (
                                 t0s is not None
-                                and _time.monotonic() - t0s
-                                >= self.recover_suspect_after
+                                and now2 - t0s >= self.recover_suspect_after
                             ):
-                                self._mark_holder_suspect(sid)
+                                if is_hedge:
+                                    self._suspect_hedge_target(
+                                        hedge_targets.get(sid), None
+                                    )
+                                else:
+                                    self._mark_holder_suspect(sid)
             finally:
                 # EVERY exit (normal, deadline, or an exception raised
                 # mid-loop) cancels what never started and drains what did:
@@ -503,10 +717,122 @@ class EcVolume:
                 for fut in pending:
                     stripe._abandon_future(fut)
         if have < DATA_SHARDS_COUNT:
-            raise IOError(
-                f"shard {shard_id}: only {have} surviving shards reachable, need {DATA_SHARDS_COUNT}"
+            suspected = tuple(
+                self._holder_key(s)
+                for s in range(TOTAL_SHARDS_COUNT)
+                if s != shard_id and self._holder_suspected(s)
+            )
+            cls = EcDegradedReadTimeout if deadline_expired else EcNoViableHolders
+            stats.DegradedReadErrors.labels(cls.__name__).inc()
+            raise cls(
+                f"shard {shard_id}: only {have} surviving shards reachable, "
+                f"need {DATA_SHARDS_COUNT}"
+                + (" (recover deadline expired)" if deadline_expired else ""),
+                shard_id=shard_id,
+                attempted=attempted,
+                suspected=suspected,
             )
         return shards
+
+    def _hedge_delay(self, shard_id: int) -> float:
+        """Seconds a survivor fetch may run before its backup launches.
+        WEEDTPU_HEDGE_DELAY_MS pins it; otherwise the per-peer latency
+        EWMA (mean + 4*dev, a live high-quantile tracker) decides, with a
+        cold-start default of half the slow-miss threshold. Never later
+        than half the per-holder cap — past that the wedge machinery owns
+        the fetch, not the hedge."""
+        fixed = float(config.env("WEEDTPU_HEDGE_DELAY_MS"))
+        if fixed > 0:
+            return fixed / 1e3
+        d = self._suspicion.hedge_delay(self._holder_key(shard_id))
+        if d is None:
+            d = max(0.05, self.recover_suspect_after / 2.0)
+        return min(d, self.recover_holder_timeout / 2.0)
+
+    def _submit_hedge(
+        self, pool, shard_id: int, offset: int, size: int,
+        hedge_started: dict[int, float],
+        hedge_targets: dict[int, Optional[str]],
+    ):
+        """Launch the backup fetch for one survivor. Readers that expose
+        holder addressing (`via` + `holders_for`, the volume server's
+        closures) are steered at a DIFFERENT holder than the one the
+        primary is inside; a reader without addressing re-runs its own
+        holder ladder. None when there is no second holder to try.
+
+        The backup rides the same bounded fetch pool as the primaries, so
+        under heavy wedging it can queue before it runs — HedgeFired is
+        therefore counted (and the per-holder cap armed) from the worker's
+        ACTUAL start, never at submit."""
+        reader = self.remote_reader
+        if reader is None:
+            return None
+        via = getattr(reader, "via", None)
+        holders_for = getattr(reader, "holders_for", None)
+        target = None
+        if via is not None and holders_for is not None:
+            primary = None
+            peer_for = getattr(reader, "peer_for", None)
+            if peer_for is not None:
+                try:
+                    primary = peer_for(shard_id)
+                except Exception:  # noqa: BLE001 — identity is best-effort
+                    primary = None
+            try:
+                holders = list(holders_for(shard_id) or ())
+            except Exception:  # noqa: BLE001 — no holder list, no hedge
+                return None
+            # skip holders already inside a suspicion window: pinning the
+            # ONE backup at a known-wedged peer would spend the hedge on
+            # exactly the holder it exists to route around
+            alts = [
+                a for a in holders
+                if a != primary and not self._suspicion.suspected(("peer", a))
+            ]
+            if not alts:
+                return None
+            target = alts[0]
+        hedge_targets[shard_id] = target
+
+        def _backup():
+            hedge_started[shard_id] = _time.monotonic()
+            stats.HedgeFired.inc()
+            if target is not None:
+                return via(target, shard_id, offset, size)
+            return reader(shard_id, offset, size)
+
+        return pool.submit(_backup)
+
+    def _suspect_hedge_target(self, target: Optional[str], fut) -> None:
+        """Suspicion for a wedged/slow-missing BACKUP fetch: the blame key
+        is the alternate holder the backup was pinned at (the peer-scoped
+        key the registry shares process-wide). A backup without addressing
+        (generic reader re-run) names no one — better unsuspected than the
+        primary's key mis-marked for a different peer's wedge."""
+        if not target:
+            return
+        key = ("peer", target)
+        self._suspicion.mark(key, self.recover_holder_backoff)
+        if fut is not None:
+            self._suspicion.track_wedged(key, fut)
+
+    def _settle_hedge_loser(self, fut, want: bytes) -> None:
+        """First-success-wins settlement: cancel the loser if it never
+        started; if running, drain it in the background and verify its
+        late result byte-identical to the winner's (a mismatch is counted
+        as HedgeMismatch — the read already returned the winner)."""
+        if fut.cancel():
+            return
+
+        def _check(f):
+            try:
+                raw = f.result()
+            except Exception:  # noqa: BLE001 — loser erred; winner served
+                return
+            if raw is not None and len(raw) == len(want) and bytes(raw) != want:
+                stats.DegradedReadErrors.labels("HedgeMismatch").inc()
+
+        fut.add_done_callback(_check)
 
     def _recover_intervals_batch(
         self, shard_id: int, items: list[tuple[int, int]]
@@ -554,7 +880,9 @@ class EcVolume:
                     results[i] = np.ascontiguousarray(out[bi, 0, : items[i][1]])
             return results
         finally:
-            stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
+            dt = _time.monotonic() - t0
+            stats.EcReconstructSeconds.observe(dt)
+            stats.DegradedReadSeconds.observe(dt)
 
     def read_intervals(self, intervals: list[locate_mod.Interval]) -> bytes:
         """Read every interval, batching the ones that need reconstruction:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from typing import Optional
 
 
 class HolderSuspicion:
@@ -34,10 +35,26 @@ class HolderSuspicion:
     a reader — suspected past any backoff expiry, so a second pool thread
     is never stacked onto the same wedged peer)."""
 
+    #: EWMA gains, Jacobson/Karels (the TCP RTO estimator): the mean moves
+    #: at 1/8 per sample, the deviation at 1/4 — smooth enough to ignore
+    #: one outlier, live enough to follow a peer that turns slow
+    _LAT_ALPHA = 0.125
+    _LAT_BETA = 0.25
+    #: hedge delay ~ mean + 4*dev: for near-normal latency that tracks
+    #: beyond p99, so a hedge fires on genuine stragglers, not jitter
+    _LAT_K = 4.0
+    #: below this many samples the estimate is noise, not evidence
+    _LAT_MIN_SAMPLES = 3
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._until: dict[tuple, float] = {}
         self._wedged: dict[tuple, object] = {}
+        # per-key fetch-latency estimator: (ewma, ewdev, samples). Fed by
+        # every COMPLETED remote fetch on the degraded ladder; read by the
+        # hedging logic to decide when a running fetch has outlived the
+        # peer's own tail and deserves a backup against another holder.
+        self._lat: dict[tuple, tuple[float, float, int]] = {}
 
     def suspected(self, key: tuple) -> bool:
         with self._lock:
@@ -76,6 +93,48 @@ class HolderSuspicion:
 
         fut.add_done_callback(_clear)
 
+    # -- per-peer fetch latency (feeds the hedge delay) ----------------------
+
+    def observe_latency(self, key: tuple, seconds: float) -> None:
+        """Feed one completed remote-fetch duration into `key`'s estimator.
+        Failures and abandoned (capped) attempts must NOT be fed: the
+        estimator models the peer answering, and a wedge is the suspicion
+        window's job, not a data point on the latency curve."""
+        if seconds < 0:
+            return
+        with self._lock:
+            prev = self._lat.get(key)
+            if prev is None:
+                # first sample: seed the deviation at half the mean, the
+                # classic RTO bootstrap, so one sample never yields a
+                # zero-width (hair-trigger) hedge delay
+                self._lat[key] = (seconds, seconds / 2.0, 1)
+                return
+            ewma, ewdev, n = prev
+            err = seconds - ewma
+            ewma += self._LAT_ALPHA * err
+            ewdev += self._LAT_BETA * (abs(err) - ewdev)
+            self._lat[key] = (ewma, ewdev, n + 1)
+
+    def latency_estimate(self, key: tuple) -> Optional[tuple[float, float, int]]:
+        """(ewma_seconds, ewdev_seconds, samples) or None when unknown."""
+        with self._lock:
+            return self._lat.get(key)
+
+    def hedge_delay(
+        self, key: tuple, floor: float = 0.002, ceiling: float = 30.0
+    ) -> Optional[float]:
+        """EWMA-derived delay before a backup fetch against another holder:
+        mean + K*dev (a live high-quantile tracker). None until the key has
+        `_LAT_MIN_SAMPLES` completed fetches — hedging on no evidence would
+        just double every cold volume's fan-out."""
+        with self._lock:
+            est = self._lat.get(key)
+        if est is None or est[2] < self._LAT_MIN_SAMPLES:
+            return None
+        ewma, ewdev, _ = est
+        return min(ceiling, max(floor, ewma + self._LAT_K * ewdev))
+
     def forget_volume(self, base: str) -> None:
         """Drop the (volume, shard)-scoped fallback keys for one volume —
         called from EcVolume.close() so an unmount/remount cycle starts
@@ -84,7 +143,7 @@ class HolderSuspicion:
         they describe the peer process, not this volume, and are bounded
         by the backoff window either way."""
         with self._lock:
-            for d in (self._until, self._wedged):
+            for d in (self._until, self._wedged, self._lat):
                 for k in [
                     k for k in d
                     if k[0] == "volume-shard" and len(k) > 1 and k[1] == base
@@ -97,6 +156,7 @@ class HolderSuspicion:
         with self._lock:
             self._until.clear()
             self._wedged.clear()
+            self._lat.clear()
 
 
 #: the process-wide default every EcVolume shares unless handed its own

@@ -263,6 +263,36 @@ EcRebuildRemoteBytes = REGISTRY.counter(
     "weedtpu_ec_rebuild_remote_bytes_total",
     "survivor bytes fetched from peer holders by distributed rebuilds",
 )
+DegradedReadSeconds = REGISTRY.histogram(
+    "weedtpu_degraded_read_seconds",
+    "end-to-end latency of degraded (reconstructing) interval reads — the "
+    "availability face of repair; weedload's SLO artifact tracks its p99",
+)
+HedgeFired = REGISTRY.counter(
+    "weedtpu_hedge_fired_total",
+    "backup shard fetches launched after the per-peer hedge delay",
+)
+HedgeWon = REGISTRY.counter(
+    "weedtpu_hedge_won_total",
+    "hedged fetches whose BACKUP answered first (the primary was slow or "
+    "wedged; the hedge converted a tail-latency read into a normal one)",
+)
+CoalescedReads = REGISTRY.counter(
+    "weedtpu_coalesced_reads_total",
+    "degraded decodes absorbed by single-flight coalescing (waiters served "
+    "from the leader's reconstruction instead of decoding again)",
+)
+RebuildAdmissionWaits = REGISTRY.counter(
+    "weedtpu_rebuild_admission_waits_total",
+    "rebuild slab-read streams that had to WAIT for an admission token "
+    "(the gate held a rebuild storm off the foreground read lane)",
+)
+DegradedReadErrors = REGISTRY.counter(
+    "weedtpu_degraded_read_errors_total",
+    "degraded reads failed, by typed error class (EcNoViableHolders, "
+    "EcDegradedReadTimeout, HedgeMismatch)",
+    ("class",),
+)
 EcBackendSelected = REGISTRY.gauge(
     "weedtpu_ec_backend_selected",
     "codec backend chosen by new_encoder (1 = currently selected; source "

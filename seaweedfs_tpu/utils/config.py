@@ -279,6 +279,46 @@ register_env(
     "Optional path: the instrumented-lock run dumps the observed "
     "acquisition-order graph here as JSON (edges + acquisition sites).",
 )
+register_env(
+    "WEEDTPU_HEDGE_READS", bool, True,
+    "Hedged degraded-read shard fetches: once a survivor fetch has run "
+    "past the per-peer EWMA-derived hedge delay, launch ONE backup fetch "
+    "against a different holder; first success wins, the loser is "
+    "cancelled/drained, and results are asserted byte-identical.",
+)
+register_env(
+    "WEEDTPU_HEDGE_DELAY_MS", float, 0.0,
+    "Fixed hedge delay in ms for degraded-read shard fetches; 0 (default) "
+    "derives the delay per peer from the live latency EWMA + deviation "
+    "tracked in the suspicion registry (TCP-RTO-style).",
+)
+register_env(
+    "WEEDTPU_COALESCE_READS", bool, True,
+    "Single-flight coalescing of concurrent degraded decodes of the SAME "
+    "(shard, interval): one leader reconstructs, waiters get byte-"
+    "identical copies — a hot lost shard costs one decode, not N.",
+)
+register_env(
+    "WEEDTPU_REBUILD_MAX_INFLIGHT", int, 8,
+    "Token gate on concurrent VolumeEcShardSlabRead rebuild streams per "
+    "volume server (clamped to >= 1). A rebuild storm queues behind the "
+    "gate instead of saturating the RPC worker pool and starving "
+    "foreground interval reads.",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_REBUILD_YIELD_MS", float, 0.0,
+    "Cooperative yield (ms) a rebuild slab stream sleeps between chunks, "
+    "ceding the GIL/IO to foreground reads under contention. 0 = off.",
+)
+register_env(
+    "WEEDTPU_LOOKUP_RETRIES", int, 2,
+    "Bounded retries (with decorrelated jitter) of the single-flight "
+    "master shard-location lookup leader before it fails its waiters — "
+    "one transient master hiccup no longer fails a whole burst of "
+    "degraded reads (clamped to >= 0).",
+    parse=_clamped_int(0),
+)
 
 
 def env_table_markdown() -> str:

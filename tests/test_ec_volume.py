@@ -8,6 +8,7 @@ import shutil
 import numpy as np
 import pytest
 
+from seaweedfs_tpu import stats
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT
 from seaweedfs_tpu.ec.ec_volume import EcVolume, NeedleDeleted, NeedleNotFound
@@ -626,6 +627,341 @@ def test_suspicion_registry_prunes_expired_keys():
     assert ("peer", "b:2") not in reg._until
     assert reg.suspected(("peer", "c:3"))
     assert list(reg._until) == [("peer", "c:3")]
+
+
+# -- hedged fetches, coalescing, typed errors (PR 6) --------------------------
+
+
+def _exact_survivor_set(base, tmp_path, missing=(0,), absent_remote=(7, 8, 9)):
+    """Move shards 0-9 remote, delete the remote copies of `missing` (the
+    read targets, lost everywhere) and `absent_remote` — leaving EXACTLY
+    DATA_SHARDS survivors, so reconstruction needs every one of them and
+    a single slow holder sits on the critical path (a richer survivor set
+    would just route around it and hide the hedge)."""
+    remote_dir = tmp_path / "remote"
+    remote_dir.mkdir()
+    for s in range(10):
+        shutil.move(stripe.shard_file_name(base, s), remote_dir / f"v7.ec{s:02d}")
+    for s in list(missing) + list(absent_remote):
+        os.remove(remote_dir / f"v7.ec{s:02d}")
+
+    def fetch_bytes(shard_id, offset, size):
+        p = remote_dir / f"v7.ec{shard_id:02d}"
+        if not p.exists():
+            return None
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    return remote_dir, fetch_bytes
+
+
+def _needles_on_shard(ev, records, shard, avoiding=()):
+    """Needle ids with >=1 interval on `shard` and none on `avoiding`
+    (keeps a deliberately-slow survivor off the DIRECT read ladder so the
+    test measures the recover fan-out, not the direct rung)."""
+    out = []
+    for nid in records:
+        sids = {
+            iv.to_shard_id_and_offset(LARGE, SMALL)[0]
+            for iv in ev.locate_needle(nid)[2]
+        }
+        if shard in sids and not (sids & set(avoiding)):
+            out.append(nid)
+    return out
+
+
+def test_hedge_delay_derived_from_latency_ewma():
+    """The pure half of 'hedge fires at the EWMA-derived delay': with
+    injected observations the delay is an exact deterministic function
+    (Jacobson/Karels mean+4*dev), and below the sample floor there is no
+    delay at all (no hedging on no evidence)."""
+    from seaweedfs_tpu.ec import suspicion
+
+    reg = suspicion.HolderSuspicion()
+    key = ("peer", "10.0.0.1:1")
+    assert reg.hedge_delay(key) is None
+    obs = [0.10, 0.12, 0.08, 0.11]
+    for s in obs:
+        reg.observe_latency(key, s)
+    ewma, dev = obs[0], obs[0] / 2.0
+    for s in obs[1:]:
+        err = s - ewma
+        ewma += suspicion.HolderSuspicion._LAT_ALPHA * err
+        dev += suspicion.HolderSuspicion._LAT_BETA * (abs(err) - dev)
+    expect = min(30.0, max(0.002, ewma + suspicion.HolderSuspicion._LAT_K * dev))
+    assert reg.hedge_delay(key) == pytest.approx(expect, rel=1e-9)
+    # below the sample floor: no evidence, no hedge
+    reg2 = suspicion.HolderSuspicion()
+    reg2.observe_latency(key, 0.1)
+    reg2.observe_latency(key, 0.1)
+    assert reg2.hedge_delay(key) is None
+    # failures must not be fed as samples
+    reg2.observe_latency(key, -1.0)
+    assert reg2.latency_estimate(key)[2] == 2
+
+
+def test_hedge_delay_env_override_and_clamp(volume, monkeypatch):
+    base, _ = volume
+    with open_vol(base, warm_on_mount=False, recover_holder_timeout=2.0) as ev:
+        monkeypatch.setenv("WEEDTPU_HEDGE_DELAY_MS", "123")
+        assert ev._hedge_delay(0) == pytest.approx(0.123)
+        monkeypatch.delenv("WEEDTPU_HEDGE_DELAY_MS")
+        # cold start: half the slow-miss threshold, never past cap/2
+        expect = min(max(0.05, ev.recover_suspect_after / 2.0), 1.0)
+        assert ev._hedge_delay(0) == pytest.approx(expect)
+
+
+def test_hedged_fetch_first_success_wins_loser_drained(volume, tmp_path, monkeypatch):
+    """A wedged survivor on the critical path: the backup fetch launches
+    at the configured delay against the OTHER holder, wins, and the read
+    completes far under the wedge — the loser is drained in the
+    background and its (byte-identical) late answer raises no mismatch."""
+    import threading
+    import time
+
+    base, records = volume
+    _, fetch_bytes = _exact_survivor_set(base, tmp_path)
+    slow_gate = threading.Event()
+    via_calls = []
+
+    def remote(shard_id, offset, size):
+        if shard_id == 3:
+            slow_gate.wait(10.0)  # wedged primary holder of shard 3
+        return fetch_bytes(shard_id, offset, size)
+
+    def via(addr, shard_id, offset, size):
+        via_calls.append((addr, shard_id, time.monotonic()))
+        return fetch_bytes(shard_id, offset, size)
+
+    remote.via = via
+    remote.holders_for = lambda sid: ["peerA:1", "peerB:2"]
+    remote.peer_for = lambda sid: "peerA:1"
+
+    monkeypatch.setenv("WEEDTPU_HEDGE_DELAY_MS", "100")
+    fired0, won0 = stats.HedgeFired.value, stats.HedgeWon.value
+    mism0 = stats.DegradedReadErrors.labels("HedgeMismatch").value
+    try:
+        with open_vol(
+            base,
+            remote_reader=remote,
+            warm_on_mount=False,
+            recover_fetch_parallelism=16,
+            recover_fetch_deadline=10.0,
+            recover_holder_timeout=8.0,
+        ) as ev:
+            nids = _needles_on_shard(ev, records, 0, avoiding=(3,))
+            assert nids, "fixture should place an interval on shard 0 off shard 3"
+            t0 = time.monotonic()
+            got = ev.read_needle_blob(nids[0])
+            dt = time.monotonic() - t0
+            rec = records[nids[0]][2]
+            assert got[: len(rec)] == rec
+            assert dt < 2.0, f"read waited on the wedged primary ({dt:.2f}s)"
+            assert stats.HedgeFired.value - fired0 >= 1
+            assert stats.HedgeWon.value - won0 >= 1
+            hedge3 = [c for c in via_calls if c[1] == 3]
+            assert hedge3 and hedge3[0][0] == "peerB:2", (
+                "backup must land on the OTHER holder"
+            )
+            # the hedge fired AT the configured delay (the wait loop wakes
+            # exactly then; slack covers scheduler jitter only)
+            assert 0.09 <= hedge3[0][2] - t0 <= 0.6
+    finally:
+        slow_gate.set()
+    time.sleep(0.3)  # loser drains byte-identical: no mismatch counted
+    assert stats.DegradedReadErrors.labels("HedgeMismatch").value == mism0
+
+
+def test_wedged_holder_ladder_improves_with_hedging(volume, tmp_path, monkeypatch):
+    """The p50/p99 ladder with a slow survivor on the critical path: with
+    hedging OFF every reconstruct eats the slow holder's full latency;
+    ON, the backup caps it near the hedge delay — byte-identical either
+    way."""
+    import time
+
+    from seaweedfs_tpu.ec import suspicion
+
+    base, records = volume
+    _, fetch_bytes = _exact_survivor_set(base, tmp_path)
+    SLOW = 0.7
+
+    def mk_reader():
+        def remote(shard_id, offset, size):
+            if shard_id == 3:
+                time.sleep(SLOW)  # slow holder (internal failover shape)
+            return fetch_bytes(shard_id, offset, size)
+
+        remote.via = lambda addr, sid, off, n: fetch_bytes(sid, off, n)
+        remote.holders_for = lambda sid: ["peerA:1", "peerB:2"]
+        remote.peer_for = lambda sid: "peerA:1"
+        return remote
+
+    def run(hedge_on: bool) -> list[float]:
+        monkeypatch.setenv("WEEDTPU_HEDGE_READS", "1" if hedge_on else "0")
+        monkeypatch.setenv("WEEDTPU_HEDGE_DELAY_MS", "60")
+        lats = []
+        with open_vol(
+            base,
+            remote_reader=mk_reader(),
+            warm_on_mount=False,
+            recover_fetch_parallelism=16,
+            recover_fetch_deadline=10.0,
+            recover_holder_timeout=30.0,
+            suspicion=suspicion.HolderSuspicion(),  # fresh: no cross-arm state
+        ) as ev:
+            nids = _needles_on_shard(ev, records, 0, avoiding=(3,))
+            assert nids
+            for _ in range(2):
+                for nid in nids:
+                    t0 = time.monotonic()
+                    got = ev.read_needle_blob(nid)
+                    lats.append(time.monotonic() - t0)
+                    rec = records[nid][2]
+                    assert got[: len(rec)] == rec
+        lats.sort()
+        return lats
+
+    off = run(False)
+    on = run(True)
+    p99 = lambda l: l[min(len(l) - 1, int(len(l) * 0.99))]  # noqa: E731
+    assert p99(off) >= SLOW * 0.9, "slow survivor was not on the path"
+    assert p99(on) < SLOW * 0.6, (
+        f"hedging did not cut the tail: p99 on={p99(on):.3f} off={p99(off):.3f}"
+    )
+    assert on[len(on) // 2] <= off[len(off) // 2] + 0.05
+
+
+def test_coalesced_degraded_decodes_single_flight(volume, tmp_path, monkeypatch):
+    """N concurrent degraded reads of the SAME interval: one survivor
+    fan-out + decode total (the leader's), every waiter byte-identical,
+    and the coalesced counter accounts for the absorbed decodes. With the
+    knob off, every reader decodes for itself again."""
+    import threading
+
+    base, records = volume
+    with open(stripe.shard_file_name(base, 0), "rb") as f:
+        golden0 = f.read()
+    remote_dir = tmp_path / "remote"
+    remote_dir.mkdir()
+    for s in range(10):
+        shutil.move(stripe.shard_file_name(base, s), remote_dir / f"v7.ec{s:02d}")
+    os.remove(remote_dir / "v7.ec00")
+
+    def remote(shard_id, offset, size):
+        import time
+
+        time.sleep(0.08)  # widen the coalesce window deterministically
+        p = remote_dir / f"v7.ec{shard_id:02d}"
+        if not p.exists():
+            return None
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    with open_vol(
+        base, remote_reader=remote, warm_on_mount=False,
+        recover_fetch_parallelism=32,
+    ) as ev:
+        decodes = []
+        real_reconstruct = ev.encoder.reconstruct
+
+        def counting(shards, wanted=None, **kw):
+            decodes.append(1)
+            return real_reconstruct(shards, wanted=wanted, **kw)
+
+        monkeypatch.setattr(ev.encoder, "reconstruct", counting)
+
+        def storm(n: int) -> list[bytes]:
+            results: list[bytes] = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(n)
+
+            def one():
+                barrier.wait()
+                out = ev._recover_interval(0, 0, 64).tobytes()
+                with lock:
+                    results.append(out)
+
+            threads = [threading.Thread(target=one) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(20)
+            return results
+
+        coal0 = stats.CoalescedReads.value
+        results = storm(6)
+        assert len(results) == 6
+        assert all(r == golden0[:64] for r in results), "waiter bytes differ"
+        assert len(decodes) <= 2, f"{len(decodes)} decodes for one hot interval"
+        assert stats.CoalescedReads.value - coal0 >= 4
+
+        # knob off: everyone decodes for themselves
+        monkeypatch.setenv("WEEDTPU_COALESCE_READS", "0")
+        decodes.clear()
+        results = storm(4)
+        assert all(r == golden0[:64] for r in results)
+        assert len(decodes) == 4, "coalescing off must decode per reader"
+
+
+def test_no_viable_holders_typed_error_carries_context(volume):
+    from seaweedfs_tpu.ec.ec_volume import EcDegradedReadError, EcNoViableHolders
+
+    base, records = volume
+    for s in range(5):
+        os.remove(stripe.shard_file_name(base, s))
+    calls = []
+
+    def reader(shard_id, offset, size):
+        calls.append(shard_id)
+        return None  # fast miss everywhere
+
+    errs0 = stats.DegradedReadErrors.labels("EcNoViableHolders").value
+    with open_vol(base, remote_reader=reader, warm_on_mount=False) as ev:
+        nids = _needles_on_shard(ev, records, 0)
+        with pytest.raises(EcNoViableHolders) as ei:
+            ev.read_needle_blob(nids[0] if nids else 3)
+    e = ei.value
+    assert isinstance(e, (IOError, EcDegradedReadError))
+    assert "surviving" in str(e)
+    assert e.shard_id in range(5)
+    assert e.attempted, "attempted holder keys must ride the error"
+    assert isinstance(e.suspected, list)
+    assert e.retry_after >= 1.0
+    assert calls, "remote candidates should have been attempted"
+    assert stats.DegradedReadErrors.labels("EcNoViableHolders").value > errs0
+
+
+def test_degraded_timeout_typed_error(volume):
+    import threading
+
+    from seaweedfs_tpu.ec.ec_volume import EcDegradedReadTimeout
+
+    base, records = volume
+    for s in range(5):
+        os.remove(stripe.shard_file_name(base, s))
+    release = threading.Event()
+
+    def hang(shard_id, offset, size):
+        release.wait(5.0)
+        return None
+
+    errs0 = stats.DegradedReadErrors.labels("EcDegradedReadTimeout").value
+    try:
+        with open_vol(
+            base, remote_reader=hang, warm_on_mount=False,
+            recover_fetch_deadline=0.4,
+        ) as ev:
+            nids = _needles_on_shard(ev, records, 0)
+            with pytest.raises(EcDegradedReadTimeout) as ei:
+                ev.read_needle_blob(nids[0] if nids else 3)
+    finally:
+        release.set()
+    assert "deadline expired" in str(ei.value)
+    assert "surviving" in str(ei.value)
+    assert ei.value.attempted
+    assert stats.DegradedReadErrors.labels("EcDegradedReadTimeout").value > errs0
 
 
 def test_unmount_forgets_volume_scoped_suspicion(volume):
