@@ -969,6 +969,11 @@ def _measure_rebuild_remote(
                     {
                         "volume_id": vid,
                         "remote": True,
+                        # this section measures the SLAB overlap pipeline:
+                        # its baselines above model full-slab fetches, so
+                        # trace projections must not silently shrink the
+                        # transfer (the trace comparison is ec_rebuild_trace)
+                        "trace_mode": "off",
                         # SAME window geometry as the baselines above: the
                         # comparison must count identical modeled RTTs, or
                         # "overlap" would partly measure window-size choice
@@ -1001,6 +1006,216 @@ def _measure_rebuild_remote(
             os.environ["WEEDTPU_BENCH_RPC_DELAY_MS"] = prev_delay
         target.stop()
         peer.stop()
+        master.stop()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# stage 2f: trace-repair rebuild — wire bytes and wall vs full slabs (child)
+# ---------------------------------------------------------------------------
+
+
+def mode_rebuild_trace() -> None:
+    """Repair-bandwidth headline: the SAME single-shard distributed rebuild
+    run in trace mode (holders ship GF-projected rows for their survivor
+    groups) and in slab mode (full survivor slabs), reporting the
+    wire-bytes ratio — the number the repair literature prices — plus
+    wall clocks under the modeled-RTT network."""
+    import tempfile
+
+    import jax  # noqa: F401
+
+    from seaweedfs_tpu.utils.devices import honor_platform_env
+
+    honor_platform_env()
+    with tempfile.TemporaryDirectory() as td:
+        _emit(_measure_rebuild_trace(td))
+
+
+def _measure_rebuild_trace(
+    td: str,
+    dat_bytes: int = 48 << 20,
+    large: int = 4 << 20,
+    small: int = 1 << 20,
+    buffer_size: int = 128 << 10,
+    max_batch_bytes: int = 4 << 20,
+    prefetch_batches: int = 4,
+    lost_shard: int = 3,
+    delay_ms: float | None = None,
+    encoder=None,
+) -> dict:
+    """Master + rebuild target + TWO peer holders: peer A holds shards 0-6
+    (minus the lost one), peer B holds 7-13, the target holds nothing. One
+    data shard is lost cluster-wide and the target rebuilds it twice over
+    the RPC path — `trace_mode=on` then `trace_mode=off` — with identical
+    window geometry. Wire bytes come from BOTH the EcRebuildResponse
+    accounting and the weedtpu_ec_repair_network_bytes_total counter
+    (in-process servers share the registry, so the counter deltas are the
+    same numbers a scrape would show); rebuilt bytes are verified against
+    golden both times. Trace mode's wire cost is holder-groups x repaired
+    bytes — with survivors on 2 holders that is ~0.2x the 10 full slabs
+    the slab path moves, and the acceptance gate is <= 0.6."""
+    import shutil
+
+    import numpy as np
+
+    from seaweedfs_tpu import rpc, stats
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.ec import stripe
+    from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    vid = 11
+    out: dict = {
+        "dat_mib": dat_bytes >> 20,
+        "lost_shard": lost_shard,
+        "protocol": (
+            "same single-shard distributed rebuild, trace vs slab sources, "
+            "identical window geometry and modeled RTT; wire_ratio = trace "
+            "bytes-on-wire / slab bytes-on-wire (holder groups x repaired "
+            "bytes vs 10 full survivor slabs); both runs byte-verified "
+            "against golden"
+        ),
+    }
+    prev_delay = os.environ.get("WEEDTPU_BENCH_RPC_DELAY_MS")
+
+    def set_delay(ms: float) -> None:
+        if ms > 0:
+            os.environ["WEEDTPU_BENCH_RPC_DELAY_MS"] = str(ms)
+        else:
+            os.environ.pop("WEEDTPU_BENCH_RPC_DELAY_MS", None)
+
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    dirs = [os.path.join(td, n) for n in ("target", "peer_a", "peer_b")]
+    for d in dirs:
+        os.makedirs(d)
+    set_delay(0)  # no delay during setup
+    target = VolumeServer(
+        [dirs[0]], master.address, heartbeat_interval=0.3, encoder=encoder
+    )
+    peer_a = VolumeServer([dirs[1]], master.address, heartbeat_interval=0.3)
+    peer_b = VolumeServer([dirs[2]], master.address, heartbeat_interval=0.3)
+    servers = [target, peer_a, peer_b]
+    for vs in servers:
+        vs.start()
+    try:
+        # -- build on peer A, spread survivors, lose one data shard --------
+        base_a = os.path.join(dirs[1], str(vid))
+        rng = np.random.default_rng(29)
+        with open(base_a + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, dat_bytes, dtype=np.uint8).tobytes())
+        with open(base_a + ".idx", "wb"):
+            pass
+        stripe.write_ec_files(
+            base_a,
+            large_block_size=large,
+            small_block_size=small,
+            encoder=target.store.encoder,
+        )
+        stripe.write_sorted_file_from_idx(base_a)
+        with open(stripe.shard_file_name(base_a, lost_shard), "rb") as f:
+            golden = f.read()
+        shard_size = os.path.getsize(stripe.shard_file_name(base_a, 0))
+        os.unlink(stripe.shard_file_name(base_a, lost_shard))
+        os.unlink(base_a + ".dat")
+        base_b = os.path.join(dirs[2], str(vid))
+        moved = [s for s in range(7, 14)]
+        for s in moved:
+            os.replace(
+                stripe.shard_file_name(base_a, s), stripe.shard_file_name(base_b, s)
+            )
+        for ext in (".ecx", ".eci"):
+            shutil.copy(base_a + ext, base_b + ext)
+        for vs in (peer_a, peer_b):
+            with rpc.RpcClient(vs.grpc_address) as c:
+                c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if len(master.topology.lookup_ec_shards(vid)) >= 13:
+                break
+            time.sleep(0.05)
+        assert len(master.topology.lookup_ec_shards(vid)) >= DATA_SHARDS_COUNT
+
+        out["shard_mib"] = round(shard_size / (1 << 20), 3)
+        out["slab_baseline_bytes"] = DATA_SHARDS_COUNT * shard_size
+        if delay_ms is None:
+            # the same network-comparable-to-compute regime as the
+            # rebuild_remote bench, sized off the data footprint: one
+            # modeled RTT per bulk window request
+            delay_ms = 2.0
+        out["rpc_delay_ms"] = round(delay_ms, 2)
+        base_target = target._base_path_for(vid)
+
+        def run_once(trace_mode: str) -> tuple[dict, float, bool]:
+            p = stripe.shard_file_name(base_target, lost_shard)
+            if os.path.exists(p):
+                os.unlink(p)  # a rerun must regenerate, not no-op
+            t0 = time.perf_counter()
+            with rpc.RpcClient(target.grpc_address) as tc:
+                resp = tc.call(
+                    VOLUME_SERVICE,
+                    "VolumeEcShardsRebuild",
+                    {
+                        "volume_id": vid,
+                        "remote": True,
+                        "trace_mode": trace_mode,
+                        "buffer_size": buffer_size,
+                        "max_batch_bytes": max_batch_bytes,
+                        "prefetch_batches": prefetch_batches,
+                    },
+                    timeout=600,
+                )
+            wall = time.perf_counter() - t0
+            with open(p, "rb") as f:
+                match = f.read() == golden
+            return resp, wall, match
+
+        set_delay(delay_ms)
+        results: dict[str, dict] = {}
+        for mode_name in ("trace", "slab"):
+            counter = stats.EcRepairNetworkBytes.labels(mode_name)
+            before = counter.value
+            wall = float("inf")
+            for _ in range(2):  # best-of-2 against vCPU steal spikes
+                resp, w, match = run_once("on" if mode_name == "trace" else "off")
+                wall = min(wall, w)
+            results[mode_name] = {
+                "wall_s": round(wall, 3),
+                "wire_bytes": int(resp.get("wire_bytes") or 0),
+                "counter_bytes_2_runs": int(counter.value - before),
+                "mode_reported": resp.get("mode"),
+                "match": bool(match),
+                "rebuilt_shard_ids": resp.get("rebuilt_shard_ids"),
+            }
+            if mode_name == "trace":
+                results[mode_name]["groups"] = resp.get("trace_groups")
+                results[mode_name]["fallback"] = resp.get("trace_fallback")
+        out["trace"] = results["trace"]
+        out["slab"] = results["slab"]
+        slab_wire = results["slab"]["wire_bytes"]
+        out["wire_ratio"] = (
+            round(results["trace"]["wire_bytes"] / slab_wire, 4) if slab_wire else None
+        )
+        out["wall_ratio"] = round(
+            results["trace"]["wall_s"] / results["slab"]["wall_s"], 3
+        )
+        out["ok"] = bool(
+            results["trace"]["match"]
+            and results["slab"]["match"]
+            and results["trace"]["mode_reported"] == "trace"
+            and results["slab"]["mode_reported"] == "slab"
+            and results["trace"]["rebuilt_shard_ids"] == [lost_shard]
+            and out["wire_ratio"] is not None
+            and out["wire_ratio"] <= 0.6
+        )
+    finally:
+        set_delay(0)
+        if prev_delay is not None:
+            os.environ["WEEDTPU_BENCH_RPC_DELAY_MS"] = prev_delay
+        for vs in servers:
+            vs.stop()
         master.stop()
     return out
 
@@ -1262,6 +1477,17 @@ def main() -> None:
     else:
         result["ec_rebuild_remote_error"] = rr_err
 
+    # stage 2f: trace-repair rebuild — wire-bytes ratio vs full slabs
+    rt, rt_err = _run_child(
+        "rebuild_trace",
+        timeout=min(300, max(30, int(deadline - time.monotonic()))),
+        extra_env={"JAX_PLATFORMS": "cpu"},
+    )
+    if rt:
+        result["ec_rebuild_trace"] = rt
+    else:
+        result["ec_rebuild_trace_error"] = rt_err
+
     # stage 2d: dp-scaling sweep over the virtual 8-device CPU mesh
     if deadline - time.monotonic() > 30:
         dp, dp_err = _run_child(
@@ -1418,6 +1644,8 @@ if __name__ == "__main__":
         mode_remote()
     elif mode == "rebuild_remote":
         mode_rebuild_remote()
+    elif mode == "rebuild_trace":
+        mode_rebuild_trace()
     elif mode == "dp":
         mode_dp()
     elif mode == "device":

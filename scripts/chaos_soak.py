@@ -24,10 +24,19 @@ artifact — a soak run then doubles as SLO evidence alongside weedload's
 open-loop artifact (closed-loop here: these reads retry and pace
 themselves, so treat the quantiles as a floor, not the user-facing tail).
 
+Kill mode also runs a TRACE-REPAIR scenario mid-soak: the EC volume's
+shards are replicated onto a second holder, one shard is dropped on
+every replica, and a third node rebuilds it with trace_mode=on while
+the primary holder is SIGKILLed mid-rebuild — the projection fetch must
+fall back to full-slab sources (which fail over to the surviving
+replica) inside the SAME rebuild call, with zero lost bytes. Kill-mode
+nodes run with a small WEEDTPU_BENCH_RPC_DELAY_MS so the rebuild spans
+enough wall time for the kill to land mid-stream.
+
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency]
-Writes artifacts/SOAK_r07.json and exits nonzero on any lost byte.
+Writes artifacts/SOAK_r08.json and exits nonzero on any lost byte.
 """
 
 from __future__ import annotations
@@ -119,6 +128,14 @@ def main() -> int:
     latency_mode = "--latency" in sys.argv
     rng = random.Random(7)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    modeled_delay_ms = 0.0
+    if not wedge_mode:
+        # stretch rebuild windows so the trace scenario's mid-rebuild kill
+        # lands mid-stream, not after a loopback-instant rebuild (wedge
+        # mode keeps its r07 timing: the ladder under test there is
+        # latency-sensitive)
+        os.environ.setdefault("WEEDTPU_BENCH_RPC_DELAY_MS", "25")
+        modeled_delay_ms = float(os.environ["WEEDTPU_BENCH_RPC_DELAY_MS"])
 
     from seaweedfs_tpu.cluster.client import MasterClient
     from seaweedfs_tpu.cluster.master import MasterServer
@@ -132,6 +149,12 @@ def main() -> int:
         "when": time.strftime("%FT%TZ", time.gmtime()),
         "seconds": seconds,
         "mode": "wedge" if wedge_mode else "kill",
+        # kill-mode nodes run with this per-RPC server-side sleep on shard/
+        # slab reads (the trace scenario needs rebuilds to span wall time);
+        # latency quantiles below therefore include it on any degraded read
+        # that fetched remote shards — do not compare them against wedge-
+        # mode (delay-free) soaks
+        "modeled_rpc_delay_ms": modeled_delay_ms,
         "kills": 0,
         "wedges": 0,
         "writes": 0,
@@ -291,9 +314,146 @@ def main() -> int:
                     # below still verify zero loss either way
                     report["remote_rebuild"] = {"vid": vid, "error": str(e)[:200]}
 
+            def try_trace_rebuild() -> bool:
+                """Trace-repair chaos scenario: replicate the EC volume's
+                shards onto a SECOND holder, drop one shard on every
+                replica, and rebuild it with trace_mode=on on a third
+                node while the primary holder is SIGKILLed mid-rebuild.
+                The projection group dies with the holder; the rebuild
+                must fall back to full-slab sources inside the same call
+                (slabs fail over to the surviving replica) and the final
+                read pass must still verify every byte."""
+                import threading as _threading
+
+                vid = report.get("ec_encoded_vid")
+                if vid is None or wedge_mode:
+                    return True  # nothing to do in this mode: stop retrying
+                holder, shard_ids = None, []
+                for n in nodes:
+                    if not n.alive:
+                        continue
+                    try:
+                        with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                            st = c.call(VOLUME_SERVICE, "VolumeStatus", {"volume_id": vid})
+                        if st.get("kind") == "ec" and len(st.get("shard_ids", [])) > len(shard_ids):
+                            holder, shard_ids = n, list(st["shard_ids"])
+                    except Exception:  # noqa: BLE001 — node has no view of vid
+                        continue
+                others = [n for n in nodes if n is not holder and n.alive]
+                if holder is None or len(others) < 2 or len(shard_ids) < 11:
+                    return False  # a kill raced the setup: retry next round
+
+                def node_answers(n, timeout=30.0) -> bool:
+                    """A restarted node's process is alive well before its
+                    RPC surface is (python + jax startup): wait until it
+                    actually answers, or the scenario would blame a boot
+                    race instead of testing the mid-rebuild kill."""
+                    deadline = time.monotonic() + timeout
+                    while time.monotonic() < deadline:
+                        try:
+                            with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                                c.call(
+                                    VOLUME_SERVICE, "VolumeStatus",
+                                    {"volume_id": vid}, timeout=5,
+                                )
+                            return True
+                        except Exception as e:  # noqa: BLE001
+                            if "not found" in str(e).lower():
+                                return True  # answered: just has no view of vid
+                            time.sleep(0.5)
+                    return False
+
+                if not all(node_answers(n) for n in others):
+                    return False
+                replica, target = others[0], others[1]
+                drop = next(s for s in sorted(shard_ids, reverse=True) if s != 13)
+                outcome: dict = {"vid": vid, "holder_killed": holder.i, "dropped": drop}
+                try:
+                    with _rpc.RpcClient(f"127.0.0.1:{replica.grpc}") as c:
+                        c.call(
+                            VOLUME_SERVICE, "VolumeEcShardsCopy",
+                            {
+                                "volume_id": vid,
+                                "shard_ids": shard_ids,
+                                "source_data_node": f"127.0.0.1:{holder.grpc}",
+                            },
+                            timeout=120,
+                        )
+                        c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+                    for n in (holder, replica):
+                        with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsDelete",
+                                {"volume_id": vid, "shard_ids": [drop]},
+                            )
+
+                    def run_rebuild() -> None:
+                        try:
+                            with _rpc.RpcClient(f"127.0.0.1:{target.grpc}") as c:
+                                resp = c.call(
+                                    VOLUME_SERVICE, "VolumeEcShardsRebuild",
+                                    {
+                                        "volume_id": vid,
+                                        "remote": True,
+                                        "trace_mode": "on",
+                                        # small windows: many delay-modeled
+                                        # round-trips for the kill to land in
+                                        "buffer_size": 16384,
+                                        "max_batch_bytes": 163840,
+                                    },
+                                    timeout=300,
+                                )
+                                outcome.update(
+                                    mode=resp.get("mode"),
+                                    trace_fallback=resp.get("trace_fallback"),
+                                    wire_bytes=resp.get("wire_bytes"),
+                                    rebuilt=resp.get("rebuilt_shard_ids"),
+                                    failed_over=resp.get("failed_over"),
+                                )
+                                if resp.get("rebuilt_shard_ids"):
+                                    c.call(
+                                        VOLUME_SERVICE, "VolumeEcShardsMount",
+                                        {"volume_id": vid,
+                                         "shard_ids": resp["rebuilt_shard_ids"]},
+                                    )
+                        except Exception as e:  # noqa: BLE001 — recorded below
+                            outcome["error"] = str(e)[:200]
+
+                    # kill the node the trace planner will group on: both
+                    # replica holders fully cover the chosen survivors, and
+                    # the planner breaks that tie by LARGEST grpc address —
+                    # so killing that node guarantees the kill hits the
+                    # holder actually serving the projection stream
+                    kill_victim = max(
+                        (holder, replica), key=lambda n: f"127.0.0.1:{n.grpc}"
+                    )
+                    outcome["holder_killed"] = kill_victim.i
+                    th = _threading.Thread(target=run_rebuild, daemon=True)
+                    th.start()
+                    time.sleep(0.2)  # let the trace stream get inflight
+                    kill_victim.kill(hard=True)
+                    report["kills"] += 1
+                    th.join(timeout=320)
+                except Exception as e:  # noqa: BLE001 — scenario setup raced a kill
+                    outcome["setup_error"] = str(e)[:200]
+                finally:
+                    for n in (holder, replica):
+                        if not n.alive:
+                            n.start()
+                            time.sleep(2.0)
+                report["trace_rebuild"] = outcome
+                return True
+
             t_end = time.monotonic() + seconds
             rebuild_tried = False
+            trace_tried = False
             while time.monotonic() < t_end:
+                if not trace_tried and rebuild_tried:
+                    # run at loop TOP: every node restarted at the bottom
+                    # of the previous round, so the scenario has the two
+                    # live non-holder nodes it needs (the scenario brings
+                    # its own mid-rebuild kill)
+                    trace_tried = try_trace_rebuild()
                 victim = rng.choice(nodes)
                 if wedge_mode:
                     # wedge rather than kill: the victim stays alive but
@@ -361,7 +521,7 @@ def main() -> int:
         report["latency"] = lat_rec.phases().get("soak", {})
     report["ok"] = not report["lost"]
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, "SOAK_r07.json"), "w", encoding="utf-8") as f:
+    with open(os.path.join(ART, "SOAK_r08.json"), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["ok"] else 1

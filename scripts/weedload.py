@@ -57,6 +57,7 @@ SCRAPED_COUNTERS = (
     "weedtpu_rebuild_admission_waits_total",
     "weedtpu_degraded_read_seconds_count",
     "weedtpu_degraded_read_errors_total",
+    "weedtpu_ec_repair_network_bytes_total",
 )
 
 
@@ -481,9 +482,17 @@ def main(argv=None) -> int:
                 def one_rebuild(node) -> None:
                     try:
                         with rpc_mod.RpcClient(f"127.0.0.1:{node.grpc}") as c:
+                            # trace auto: projections when every holder
+                            # speaks them, full slabs otherwise — the storm
+                            # now also measures the repair-bandwidth path
+                            # under load, and records which mode served
                             resp = c.call(
                                 VOLUME_SERVICE, "VolumeEcShardsRebuild",
-                                {"volume_id": ec_vid, "remote": True},
+                                {
+                                    "volume_id": ec_vid,
+                                    "remote": True,
+                                    "trace_mode": "auto",
+                                },
                                 timeout=240,
                             )
                             # the storm measures the rebuild LANE, not the
@@ -500,6 +509,9 @@ def main(argv=None) -> int:
                         chaos_report["rebuilds"].append({
                             "target": node.i,
                             "rebuilt": resp.get("rebuilt_shard_ids", []),
+                            "mode": resp.get("mode"),
+                            "wire_bytes": resp.get("wire_bytes"),
+                            "trace_fallback": resp.get("trace_fallback") or None,
                         })
                     except Exception as e:  # noqa: BLE001 — recorded, not fatal
                         chaos_report["rebuilds"].append(

@@ -25,6 +25,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from concurrent import futures
+from contextlib import ExitStack
 from typing import Optional
 
 import time
@@ -176,6 +177,11 @@ class VolumeServer:
         self._rebuild_gate = threading.BoundedSemaphore(
             config.env("WEEDTPU_REBUILD_MAX_INFLIGHT")
         )
+        # trace-repair stance, latched per server instance so tests can
+        # model mixed-version clusters (an "off" peer neither advertises
+        # nor serves the projection read — the capability-negotiation
+        # fallback path): on | off | auto
+        self._trace_repair = config.env("WEEDTPU_TRACE_REPAIR")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -791,6 +797,11 @@ class VolumeServer:
                 # preflight must see a truncated shard hiding behind a
                 # healthy sibling on the same holder
                 "shard_file_sizes": per_shard,
+                # trace-repair planners only group shards onto holders
+                # that advertise the projection read
+                "capabilities": (
+                    ["slab_projection"] if self._trace_repair != "off" else []
+                ),
             }
         raise rpc.NotFoundFault(f"volume {vid} not found")
 
@@ -1040,7 +1051,93 @@ class VolumeServer:
                 )
             holders = sorted({a for addrs in locs.values() for a in addrs})
             self._ensure_ec_index_files(vid, collection, base, holders)
-            shard_size = self._resolve_shard_size(vid, base, local, holders)
+            shard_size, holder_caps = self._resolve_shard_size(
+                vid, base, local, holders
+            )
+            tuning = {}
+            if int(req.get("buffer_size") or 0) > 0:
+                tuning["buffer_size"] = int(req["buffer_size"])
+            if int(req.get("max_batch_bytes") or 0) > 0:
+                tuning["max_batch_bytes"] = int(req["max_batch_bytes"])
+            if int(req.get("prefetch_batches") or 0) > 0:
+                tuning["prefetch_batches"] = int(req["prefetch_batches"])
+            chosen = present[:DATA_SHARDS_COUNT]
+            remote_needed = [s for s in chosen if s not in local]
+            resp = {
+                "local_survivors": sorted(local & set(chosen)),
+                "remote_survivors": remote_needed,
+            }
+            mode_req = str(req.get("trace_mode") or "").strip().lower()
+            trace_mode = (
+                mode_req if mode_req in ("on", "off", "auto") else self._trace_repair
+            )
+            trace_fallback = ""
+            trace_wasted = 0  # bytes an aborted trace attempt already moved
+            if trace_mode != "off" and remote_needed:
+                # trace-repair first: every holder ships |missing| projected
+                # rows for its whole survivor group instead of full slabs.
+                # ANY failure (incapable peer, stale holder map, mid-rebuild
+                # kill, torn stream) lands on the full-slab path below —
+                # trace is a bandwidth optimization, never an availability
+                # trade. `on` attempts projections wherever holders are
+                # capable; `auto` additionally declines when the plan would
+                # not actually move fewer bytes than the slabs it replaces
+                # (fully-spread placements with several missing shards).
+                groups, labels, plan_reason = self._plan_trace_groups(
+                    vid, base, chosen, missing, locs, holder_caps, local
+                )
+                if groups is not None and trace_mode == "auto":
+                    remote_groups = sum(1 for g in groups if g.holder != "local")
+                    if remote_groups * len(missing) >= len(remote_needed):
+                        for g in groups:
+                            g.close()
+                        groups, labels = None, []
+                        plan_reason = (
+                            f"no bandwidth win: {remote_groups} holder "
+                            f"groups x {len(missing)} missing rows >= "
+                            f"{len(remote_needed)} survivor slabs"
+                        )
+                if groups is None:
+                    trace_fallback = plan_reason
+                else:
+                    try:
+                        try:
+                            rebuilt = stripe.rebuild_ec_files_from_projections(
+                                base,
+                                groups,
+                                shard_size,
+                                missing,
+                                encoder=self.store.encoder,
+                                **tuning,
+                            )
+                            wire = sum(g.bytes_fetched for g in groups)
+                        finally:
+                            for g in groups:
+                                g.close()
+                        stats.EcRepairNetworkBytes.labels("trace").inc(wire)
+                        stats.EcRebuildRemoteBytes.inc(wire)
+                        resp.update(
+                            rebuilt_shard_ids=rebuilt,
+                            wire_bytes=wire,
+                            mode="trace",
+                            trace_groups=labels,
+                            failed_over=[],
+                            trace_fallback="",
+                        )
+                        return resp
+                    except Exception as e:  # noqa: BLE001 — fall back to slabs
+                        trace_fallback = f"{type(e).__name__}: {e}"[:200]
+                        # the aborted attempt's bytes DID cross the network:
+                        # count them, or scraped trace-vs-slab comparisons
+                        # would flatter trace exactly when fallbacks happen
+                        trace_wasted = sum(g.bytes_fetched for g in groups)
+                        if trace_wasted:
+                            stats.EcRepairNetworkBytes.labels("trace").inc(
+                                trace_wasted
+                            )
+                            stats.EcRebuildRemoteBytes.inc(trace_wasted)
+            # full-slab path: the capability/chaos fallback and the
+            # trace_mode=off shape — striped RemoteSlabSource per survivor.
             # fetch workers are RTT/IO-bound (they sleep on peer streams),
             # so size the pool above the survivor count: with prefetch
             # running `prefetch_batches` windows ahead, a tight pool would
@@ -1061,13 +1158,6 @@ class VolumeServer:
                         vid, [s for s in present if s not in local], executor
                     )
                 )
-                tuning = {}
-                if int(req.get("buffer_size") or 0) > 0:
-                    tuning["buffer_size"] = int(req["buffer_size"])
-                if int(req.get("max_batch_bytes") or 0) > 0:
-                    tuning["max_batch_bytes"] = int(req["max_batch_bytes"])
-                if int(req.get("prefetch_batches") or 0) > 0:
-                    tuning["prefetch_batches"] = int(req["prefetch_batches"])
                 rebuilt = stripe.rebuild_ec_files_from_sources(
                     base,
                     sources,
@@ -1076,27 +1166,174 @@ class VolumeServer:
                     missing=missing,
                     **tuning,
                 )
+                wire = sum(
+                    src.bytes_fetched
+                    for src in sources.values()
+                    if isinstance(src, stripe.RemoteSlabSource)
+                )
             finally:
                 for src in sources.values():
                     src.close()
                 executor.shutdown(wait=False, cancel_futures=True)
-            stats.EcRebuildRemoteBytes.inc(
-                shard_size * sum(1 for s in present[:DATA_SHARDS_COUNT] if s not in local)
-            )
+            if wire:
+                stats.EcRepairNetworkBytes.labels("slab").inc(wire)
+                stats.EcRebuildRemoteBytes.inc(wire)
             failed_over = [
                 f"{src.shard_id}:{addr}"
                 for src in sources.values()
                 if isinstance(src, stripe.RemoteSlabSource)
                 for addr in src.failovers
             ]
-            return {
-                "rebuilt_shard_ids": rebuilt,
-                "local_survivors": sorted(local & set(present[:DATA_SHARDS_COUNT])),
-                "remote_survivors": [
-                    s for s in present[:DATA_SHARDS_COUNT] if s not in local
-                ],
-                "failed_over": failed_over,
-            }
+            resp.update(
+                rebuilt_shard_ids=rebuilt,
+                failed_over=failed_over,
+                # total bytes THIS rebuild moved, aborted trace attempt
+                # included — wire_bytes is a network-cost number, not a
+                # successful-path number
+                wire_bytes=wire + trace_wasted,
+                mode="slab" if remote_needed else "local",
+                trace_groups=[],
+                trace_fallback=trace_fallback,
+            )
+            return resp
+
+    def _plan_trace_groups(
+        self,
+        vid: int,
+        base: str,
+        chosen: list[int],
+        missing: list[int],
+        locs: dict[int, list[str]],
+        holder_caps: dict[str, set],
+        local: set[int],
+    ):
+        """Group the chosen survivors onto projection-capable holders:
+        -> (groups, labels, "") on success, (None, [], reason) when trace
+        repair cannot be planned (capability negotiation's fallback).
+
+        Greedy minimum-holder cover: each round assigns the holder that
+        covers the most still-unassigned remote survivors (ties broken by
+        address for determinism) — fewer groups = fewer projected-row
+        streams = fewer moved bytes, since the wire cost is
+        groups x |missing| x shard bytes. The target's own survivors form
+        a zero-wire local group running the SAME projection math."""
+        remote_needed = [s for s in chosen if s not in local]
+        coverable: dict[str, set[int]] = {}
+        for s in remote_needed:
+            for addr in locs.get(s, ()):
+                if "slab_projection" in holder_caps.get(addr, ()):
+                    coverable.setdefault(addr, set()).add(s)
+        uncovered = set(remote_needed) - {
+            s for sids in coverable.values() for s in sids
+        }
+        if uncovered:
+            return None, [], (
+                f"survivors {sorted(uncovered)} have no projection-capable "
+                "holder"
+            )
+        plan = self.store.encoder.repair_projection_plan(chosen, missing)
+        rows = len(missing)
+        assign: dict[str, list[int]] = {}
+        remaining = set(remote_needed)
+        while remaining:
+            addr = max(
+                coverable,
+                key=lambda a: (len(coverable[a] & remaining), a),
+            )
+            got = sorted(coverable[addr] & remaining)
+            if not got:  # unreachable given the cover check above
+                return None, [], "trace planner could not cover survivors"
+            assign[addr] = got
+            remaining -= set(got)
+        groups: list[stripe.SlabSource] = []
+        labels: list[str] = []
+        try:
+            local_chosen = sorted(local & set(chosen))
+            if local_chosen:
+                import numpy as np
+
+                groups.append(
+                    stripe.LocalProjectionSource(
+                        [stripe.shard_file_name(base, s) for s in local_chosen],
+                        np.stack([plan[s] for s in local_chosen], axis=1),
+                        self.store.encoder,
+                    )
+                )
+                labels.append("local=" + "+".join(str(s) for s in local_chosen))
+            for addr in sorted(assign):
+                sids = assign[addr]
+                terms = [
+                    {
+                        "shard_id": s,
+                        "coeffs": base64.b64encode(plan[s].tobytes()).decode(),
+                    }
+                    for s in sids
+                ]
+                groups.append(
+                    stripe.TraceSlabSource(
+                        addr,
+                        sids,
+                        rows,
+                        self._projection_fetcher(addr, vid, terms, rows),
+                    )
+                )
+                labels.append(f"{addr}=" + "+".join(str(s) for s in sids))
+        except Exception as e:  # noqa: BLE001 — a bad group must not leak the rest
+            for g in groups:
+                g.close()
+            return None, [], f"trace group setup failed: {e}"
+        return groups, labels, ""
+
+    def _projection_fetcher(self, addr: str, vid: int, terms: list, rows: int):
+        """Transport closure for one holder group: the projection mode of
+        the CRC-checked slab RPC. Short return on EOF (the source
+        zero-fills); any fault propagates so the rebuild falls back to
+        full slabs rather than failing over inside the group (the group's
+        shards live on exactly this holder)."""
+
+        def fetch(offset: int, size: int) -> bytes:
+            import numpy as np
+
+            frames = self._peer_pool.get(addr).stream(
+                VOLUME_SERVICE,
+                "VolumeEcShardSlabRead",
+                {
+                    "volume_id": vid,
+                    "offset": offset,
+                    "size": size,
+                    "projection": terms,
+                    "projection_rows": rows,
+                },
+                timeout=EC_SLAB_READ_TIMEOUT,
+            )
+            # each frame is its own row-major (rows, cols_i) block —
+            # restitch column-wise so the caller sees one row-major
+            # (rows, sum cols_i) window
+            blocks = []
+            got = 0
+            for frame in frames:
+                chunk = rpc.crc_unframe(frame)
+                got += len(chunk)
+                if got > size * rows:
+                    raise IOError(
+                        f"projection group@{addr}: stream over-answered "
+                        f"({got} > {size * rows})"
+                    )
+                if len(chunk) % rows:
+                    raise IOError(
+                        f"projection group@{addr}: frame of {len(chunk)} "
+                        f"bytes is not {rows} rows"
+                    )
+                blocks.append(
+                    np.frombuffer(chunk, dtype=np.uint8).reshape(rows, -1)
+                )
+            if not blocks:
+                return b""
+            if len(blocks) == 1:
+                return blocks[0].tobytes()
+            return np.concatenate(blocks, axis=1).tobytes()
+
+        return fetch
 
     def _ensure_ec_index_files(
         self, vid: int, collection: str, base: str, holders: list[str]
@@ -1145,8 +1382,11 @@ class VolumeServer:
         otherwise zero-fill past its EOF exactly like a legitimate tail
         and decode into silently-wrong shards (the .eci CRC gate only
         fires after the whole volume has streamed, and only when CRCs
-        were recorded)."""
+        were recorded). Returns (shard_size, capabilities-by-holder) —
+        the same status round-trip feeds the trace-repair planner, so
+        capability negotiation costs zero extra RPCs."""
         sizes: dict[str, int] = {}
+        caps: dict[str, set[str]] = {}
         for s in local:
             sizes[f"local:.ec{s:02d}"] = os.path.getsize(
                 stripe.shard_file_name(base, s)
@@ -1159,6 +1399,7 @@ class VolumeServer:
                 )
                 if st.get("kind") != "ec":
                     continue
+                caps[addr] = set(st.get("capabilities") or ())
                 per_shard = st.get("shard_file_sizes") or {}
                 if per_shard:
                     for k, v in per_shard.items():
@@ -1179,7 +1420,7 @@ class VolumeServer:
                 "— truncated shard?",
                 code=grpc.StatusCode.FAILED_PRECONDITION,
             )
-        return next(iter(sizes.values()))
+        return next(iter(sizes.values())), caps
 
     def _remote_slab_sources(
         self, vid: int, shard_ids: list[int], executor
@@ -1328,7 +1569,10 @@ class VolumeServer:
                 # network charges), GIL-released so client-side overlap shows
                 time.sleep(delay_ms / 1e3)
             vid = int(req["volume_id"])
-            shard_id = int(req["shard_id"])
+            # projection requests carry terms instead of a shard_id; a
+            # PLAIN slab read with no shard_id must still fault loudly
+            # (silently serving shard 0 would decode wrong survivor data)
+            shard_id = 0 if req.get("projection") else int(req["shard_id"])
             offset = int(req["offset"])
             size = int(req["size"])
             chunk_size = min(max(64 * 1024, int(req.get("chunk_size") or _SLAB_CHUNK)), 8 << 20)
@@ -1336,6 +1580,11 @@ class VolumeServer:
             ev = self.store.get_ec_volume(vid)
             if ev is None:
                 raise rpc.NotFoundFault(f"ec volume {vid} not mounted")
+            if req.get("projection"):
+                yield from self._slab_projection_stream(
+                    ev, req, offset, size, chunk_size, yield_s
+                )
+                return
             if shard_id not in ev._shard_files:
                 raise rpc.NotFoundFault(f"shard {shard_id} of volume {vid} not local")
             path = stripe.shard_file_name(ev.base, shard_id)
@@ -1354,6 +1603,78 @@ class VolumeServer:
                         time.sleep(yield_s)
         finally:
             self._rebuild_gate.release()
+
+    def _slab_projection_stream(
+        self, ev, req: dict, offset: int, size: int, chunk_size: int, yield_s: float
+    ):
+        """Trace-repair half of VolumeEcShardSlabRead: stream the GF(2^8)
+        partial sum of the requested LOCAL shards through the supplied
+        decode coefficients — `rows` projected rows per byte column,
+        row-major per chunk, CRC-framed like a plain slab. Moves
+        rows x window bytes for the whole holder group instead of one
+        full slab per survivor; EOF ends the stream short (all shards of
+        a volume share one length) and the client zero-fills.
+
+        The projection itself is the codec's bit-plane GF(2)/GF(2^8)
+        matmul (Encoder.project), so the survivor side reuses exactly the
+        verified decode math rather than a second GF implementation."""
+        import numpy as np
+
+        if self._trace_repair == "off":
+            raise rpc.RpcFault(
+                "slab projection reads disabled (WEEDTPU_TRACE_REPAIR=off)",
+                code=grpc.StatusCode.UNIMPLEMENTED,
+            )
+        rows = int(req.get("projection_rows") or 0)
+        terms = req["projection"]
+        if rows <= 0 or rows > TOTAL_SHARDS_COUNT:
+            raise rpc.RpcFault(f"bad projection_rows {rows}")
+        sids: list[int] = []
+        coeff_cols: list[bytes] = []
+        for term in terms:
+            sid = int(term["shard_id"])
+            raw = term["coeffs"]
+            coeffs = raw if isinstance(raw, (bytes, bytearray)) else base64.b64decode(raw)
+            if len(coeffs) != rows:
+                raise rpc.RpcFault(
+                    f"projection term for shard {sid} carries {len(coeffs)} "
+                    f"coefficients, want {rows}"
+                )
+            if sid in sids:
+                raise rpc.RpcFault(f"duplicate projection term for shard {sid}")
+            sids.append(sid)
+            coeff_cols.append(bytes(coeffs))
+        missing_local = [s for s in sids if s not in ev._shard_files]
+        if missing_local:
+            # the planner grouped against a stale holder map: refuse the
+            # whole group so the rebuilder re-plans (or falls back) rather
+            # than silently projecting a partial sum
+            raise rpc.NotFoundFault(
+                f"projection shards {missing_local} of volume "
+                f"{int(req['volume_id'])} not local"
+            )
+        coeffs = np.frombuffer(b"".join(coeff_cols), dtype=np.uint8).reshape(
+            len(sids), rows
+        ).T.copy()  # (rows, n_terms)
+        paths = [stripe.shard_file_name(ev.base, s) for s in sids]
+        actual = max(0, min(size, min(os.path.getsize(p) for p in paths) - offset))
+        if actual == 0:
+            return  # whole window past EOF: empty stream, client zero-fills
+        cols_per_chunk = max(64 * 1024 // rows, chunk_size // rows)
+        enc = self.store.encoder
+        with ExitStack() as stack:
+            files = [stack.enter_context(open(p, "rb")) for p in paths]
+            sent = 0
+            while sent < actual:
+                cols = min(cols_per_chunk, actual - sent)
+                block = np.empty((len(sids), cols), dtype=np.uint8)
+                for i, f in enumerate(files):
+                    stripe.read_padded_into(f, offset + sent, block[i])
+                projected = enc.project(coeffs, block)
+                yield rpc.crc_frame(projected.tobytes())
+                sent += cols
+                if yield_s > 0 and sent < actual:
+                    time.sleep(yield_s)
 
     def _rpc_ec_blob_delete(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])

@@ -68,6 +68,11 @@ DEFAULT_PREFETCH_BATCHES = config.env("WEEDTPU_REBUILD_PREFETCH_BATCHES")
 #: not a single serial stream.
 DEFAULT_SLAB_STRIPE_BYTES = 4 * 1024 * 1024
 
+#: concurrent sub-range fetches per remote source (slab or trace): the
+#: striping fan-out that spreads one shard's windows across its replica
+#: holders instead of pinning the first-sorted one.
+DEFAULT_SLAB_FANOUT = config.env("WEEDTPU_SLAB_FANOUT")
+
 
 def to_ext(shard_id: int) -> str:
     return f".ec{shard_id:02d}"
@@ -457,7 +462,14 @@ class RemoteSlabSource(SlabSource):
     one-shot `refresh_holders()` re-lookup when all known holders are
     dead) WITHOUT disturbing other inflight ranges — the batch pipeline
     never restarts. Dead holders are recorded in `self.failovers` for
-    observability. Raises IOError when no holder can serve a range."""
+    observability. Raises IOError when no holder can serve a range.
+
+    Multi-holder striping (the PR-3-named follow-up): up to `fanout`
+    stripes run concurrently and each picks the live holder with the
+    FEWEST inflight fetches (ties broken by per-stripe rotation), so a
+    replicated shard's windows aggregate bandwidth across all its
+    holders — and when one holder dies the load rebalances onto the
+    rest instead of serializing behind a static modulo assignment."""
 
     def __init__(
         self,
@@ -468,9 +480,14 @@ class RemoteSlabSource(SlabSource):
         stripe_bytes: int = DEFAULT_SLAB_STRIPE_BYTES,
         refresh_holders: Optional[Callable[[], Sequence[str]]] = None,
         fetch_deadline: float = 120.0,
+        fanout: Optional[int] = None,
     ):
         self.shard_id = shard_id
         self.failovers: list[str] = []
+        #: payload bytes this source pulled over the network (the
+        #: repair-bandwidth accounting input: moved-bytes, not
+        #: repaired-bytes)
+        self.bytes_fetched = 0
         self._holders = [str(h) for h in holders]
         self._dead: set[str] = set()
         self._fetch = fetch
@@ -483,9 +500,12 @@ class RemoteSlabSource(SlabSource):
         self._stripe = max(64 * 1024, int(stripe_bytes))
         self._deadline = fetch_deadline
         self._lock = threading.Lock()
+        self._fanout = DEFAULT_SLAB_FANOUT if fanout is None else max(1, int(fanout))
+        #: holder -> fetches currently running against it (striping load)
+        self._inflight: dict[str, int] = {}
         self._own_executor = executor is None
         self._ex = executor or ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix=f"slab-fetch-{shard_id}"
+            max_workers=self._fanout, thread_name_prefix=f"slab-fetch-{shard_id}"
         )
         #: offset -> (length, [(rel_offset, size, Future[bytes]), ...])
         self._pending: dict[int, tuple[int, list]] = {}
@@ -507,6 +527,17 @@ class RemoteSlabSource(SlabSource):
                 self._dead.discard(str(h))
             return [h for h in self._holders if h not in self._dead]
 
+    def _pick_holder(self, live: list[str], offset: int) -> str:
+        """Least-inflight live holder; per-stripe rotation breaks ties so
+        an idle source still spreads consecutive windows across replicas
+        instead of always re-picking the first-sorted holder."""
+        with self._lock:
+            rot = (offset // self._stripe) % len(live)
+            order = live[rot:] + live[:rot]
+            addr = min(order, key=lambda h: self._inflight.get(h, 0))
+            self._inflight[addr] = self._inflight.get(addr, 0) + 1
+            return addr
+
     def _fetch_range(self, offset: int, size: int) -> bytes:
         while True:
             live = self._live_holders()
@@ -515,19 +546,19 @@ class RemoteSlabSource(SlabSource):
                     f"shard {self.shard_id}: no reachable holder for "
                     f"[{offset}, {offset + size}) — tried {self._holders}"
                 )
-            # rotate the starting holder per stripe so replicated shard
-            # placements split the slab traffic across their holders
-            # instead of hammering the first-sorted one; failover still
-            # walks the remaining live set
-            addr = live[(offset // self._stripe) % len(live)]
+            addr = self._pick_holder(live, offset)
             try:
                 data = self._fetch(addr, offset, size)
             except Exception:  # noqa: BLE001 — holder down: fail over
                 with self._lock:
+                    self._inflight[addr] = max(0, self._inflight.get(addr, 1) - 1)
                     if addr not in self._dead:
                         self._dead.add(addr)
                         self.failovers.append(addr)
                 continue
+            with self._lock:
+                self._inflight[addr] = max(0, self._inflight.get(addr, 1) - 1)
+                self.bytes_fetched += len(data)
             if len(data) > size:
                 raise IOError(
                     f"shard {self.shard_id}: holder {addr} over-answered "
@@ -580,6 +611,273 @@ class RemoteSlabSource(SlabSource):
         self._pending.clear()
         if self._own_executor:
             self._ex.shutdown(wait=False, cancel_futures=True)
+
+
+# -- trace-repair projection sources -----------------------------------------
+#
+# The repair-bandwidth lever (PAPERS.md: "Practical Considerations in
+# Repairing Reed-Solomon Codes", regenerating-code helpers): a holder of
+# several survivor shards ships the GF(2^8) PROJECTION of its local group
+# through the decode matrix — `rows = len(missing)` projected rows per
+# holder — instead of one full slab per survivor. XORing the holders'
+# projections IS the fused decode (GF addition is XOR and matrix products
+# split column-wise), so the rebuilt bytes are identical to the slab path
+# while the wire moves holders x repaired-bytes, not survivors x shard-bytes.
+
+
+class TraceSlabSource(SlabSource):
+    """One holder group's repair-projection supplier.
+
+    `fetch(offset, size) -> bytes` is the transport, already bound to the
+    holder and its projection terms by the cluster layer (the projection
+    mode of the CRC-framed VolumeEcShardSlabRead RPC); it returns the
+    ROW-MAJOR (rows, actual) projected block for the window, where
+    `actual = min(size, shard_len - offset)` — short on EOF exactly like
+    a slab, and the client zero-fills (projections of zero columns are
+    zero). Windows are split into `chunk_bytes` sub-ranges fetched in
+    parallel (projection is per-byte-column, so sub-ranges concatenate
+    exactly).
+
+    NO in-source failover: the group's shards live on THIS holder, so a
+    failed fetch propagates and the caller falls back to full-slab
+    sources (capability negotiation and chaos both land there)."""
+
+    def __init__(
+        self,
+        holder: str,
+        shard_ids: Sequence[int],
+        rows: int,
+        fetch: Callable[[int, int], bytes],
+        executor: Optional[ThreadPoolExecutor] = None,
+        chunk_bytes: Optional[int] = None,
+        fanout: Optional[int] = None,
+    ):
+        if rows <= 0:
+            raise ValueError("projection rows must be positive")
+        self.holder = str(holder)
+        self.shard_ids = [int(s) for s in shard_ids]
+        self.rows = int(rows)
+        self.bytes_fetched = 0
+        self._fetch = fetch
+        self._chunk = max(
+            64 * 1024,
+            int(config.env("WEEDTPU_TRACE_CHUNK") if chunk_bytes is None else chunk_bytes),
+        )
+        self._lock = threading.Lock()
+        self._own_executor = executor is None
+        workers = DEFAULT_SLAB_FANOUT if fanout is None else max(1, int(fanout))
+        self._ex = executor or ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=f"trace-fetch-{self.holder}"
+        )
+        #: window offset -> (per-shard length, [(rel, size, Future), ...])
+        self._pending: dict[int, tuple[int, list]] = {}
+
+    def _fetch_counted(self, offset: int, size: int) -> bytes:
+        data = self._fetch(offset, size)
+        if len(data) % self.rows:
+            raise IOError(
+                f"trace group {self.holder}: projected stream length "
+                f"{len(data)} is not a multiple of {self.rows} rows"
+            )
+        if len(data) > size * self.rows:
+            raise IOError(
+                f"trace group {self.holder}: over-answered "
+                f"({len(data)} > {size * self.rows} bytes)"
+            )
+        with self._lock:
+            self.bytes_fetched += len(data)
+        return data
+
+    def prefetch(self, offset: int, length: int) -> None:
+        if length <= 0 or offset in self._pending:
+            return
+        futs = []
+        for off in range(offset, offset + length, self._chunk):
+            n = min(self._chunk, offset + length - off)
+            futs.append(
+                (off - offset, n, self._ex.submit(self._fetch_counted, off, n))
+            )
+        self._pending[offset] = (length, futs)
+
+    def read_into(self, offset: int, out: np.ndarray) -> None:
+        """Fill a flat (rows * width,) staging view with the window's
+        projected block: row-major (rows, width), EOF zero-filled."""
+        if out.size % self.rows:
+            raise ValueError(
+                f"staging view of {out.size} bytes is not {self.rows} rows"
+            )
+        width = out.size // self.rows
+        entry = self._pending.pop(offset, None)
+        if entry is not None and entry[0] != width:
+            for _, _, fut in entry[1]:  # stale window shape: refetch
+                _abandon_future(fut)
+            entry = None
+        if entry is None:
+            self.prefetch(offset, width)
+            entry = self._pending.pop(offset)
+        _, futs = entry
+        out2d = out.reshape(self.rows, width)
+        try:
+            for rel, n, fut in futs:
+                data = fut.result()
+                sub = len(data) // self.rows
+                if sub:
+                    out2d[:, rel : rel + sub] = np.frombuffer(
+                        data, dtype=np.uint8
+                    ).reshape(self.rows, sub)
+                if sub < n:  # EOF inside the window: zero-fill, like local
+                    out2d[:, rel + sub : rel + n] = 0
+        except BaseException:
+            for _, _, fut in futs:
+                _abandon_future(fut)
+            raise
+
+    def close(self) -> None:
+        for _, futs in self._pending.values():
+            for _, _, fut in futs:
+                _abandon_future(fut)
+        self._pending.clear()
+        if self._own_executor:
+            self._ex.shutdown(wait=False, cancel_futures=True)
+
+
+class LocalProjectionSource(SlabSource):
+    """The rebuild target's own survivors as one projection group: reads
+    the local shard windows and projects them through the group's decode
+    coefficients with the SAME math the remote holders run server-side —
+    so local and remote groups are interchangeable rows of the trace
+    combine, and local survivors cost zero wire bytes."""
+
+    def __init__(self, paths: Sequence[str], coeffs: np.ndarray, encoder):
+        coeffs = np.asarray(coeffs, dtype=np.uint8)
+        if coeffs.ndim != 2 or coeffs.shape[1] != len(paths):
+            raise ValueError(
+                f"want (rows, {len(paths)}) coeffs, got {coeffs.shape}"
+            )
+        self.holder = "local"
+        self.rows = coeffs.shape[0]
+        self.bytes_fetched = 0  # never leaves the machine
+        self._coeffs = coeffs
+        self._enc = encoder
+        # weedlint: ignore[open-no-ctx] handles owned by the source, closed in close()
+        self._files = [open(p, "rb") for p in paths]
+
+    def read_into(self, offset: int, out: np.ndarray) -> None:
+        if out.size % self.rows:
+            raise ValueError(
+                f"staging view of {out.size} bytes is not {self.rows} rows"
+            )
+        width = out.size // self.rows
+        stack = np.empty((len(self._files), width), dtype=np.uint8)
+        for i, f in enumerate(self._files):
+            read_padded_into(f, offset, stack[i])
+        out.reshape(self.rows, width)[:] = self._enc.project(self._coeffs, stack)
+
+    def close(self) -> None:
+        for f in self._files:
+            f.close()
+
+
+def rebuild_ec_files_from_projections(
+    base_file_name: str,
+    groups: Sequence[SlabSource],
+    shard_size: int,
+    missing: Sequence[int],
+    encoder: Optional[Encoder] = None,
+    buffer_size: int = 4 * 1024 * 1024,
+    max_batch_bytes: int = 64 * 1024 * 1024,
+    pipeline_depth: Optional[int] = None,
+    prefetch_batches: Optional[int] = None,
+) -> list[int]:
+    """The trace-combine rebuild pipeline: every batch reads one
+    (rows x width) projected block per holder group and reconstructs the
+    missing shards with ONE fused combine dispatch — the XOR of the
+    groups' partial projections, expressed as an all-ones GF(2^8) matrix
+    applied to the (groups, rows*width) staging stack, so it rides the
+    same async-dispatch/donation/staging-ring machinery as the slab
+    pipeline. Output is byte-identical to `rebuild_ec_files_serial` on
+    the same survivor set (the projection coefficients ARE the fused
+    decode matrix, split column-wise across holders); CRC32 is folded in
+    as bytes stream out and checked against the .eci record; any failure
+    drains inflight device work and unlinks the partial outputs."""
+    enc = encoder or new_encoder()
+    missing = sorted(int(s) for s in missing)
+    if not missing:
+        return []
+    if not groups:
+        raise ValueError("trace rebuild needs at least one projection group")
+    rows = len(missing)
+    for g in groups:
+        if getattr(g, "rows", None) != rows:
+            raise ValueError(
+                f"group {getattr(g, 'holder', g)!r} projects "
+                f"{getattr(g, 'rows', None)} rows, want {rows}"
+            )
+    depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
+    ahead = (
+        DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
+    )
+    chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
+    span = chunks_per_batch * buffer_size
+    combine = np.ones((1, len(groups)), dtype=np.uint8)  # GF sum == XOR
+    ring = _StagingRing(depth + 1, (len(groups), rows * span))
+    crcs = {s: 0 for s in missing}
+    batches = []
+    off = 0
+    while off < shard_size:
+        valid = min(span, shard_size - off)
+        batches.append((off, valid, -(-valid // buffer_size) * buffer_size))
+        off += span
+    try:
+        with ExitStack() as stack:
+            outs = {
+                s: stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
+                for s in missing
+            }
+            inflight: deque = deque()  # FIFO of (combined_handle, valid, width)
+
+            def drain_one() -> None:
+                lazy, valid, width = inflight.popleft()
+                out = np.asarray(lazy).reshape(rows, width)  # sync point
+                for k, s in enumerate(missing):
+                    row = np.ascontiguousarray(out[k, :valid])
+                    outs[s].write(row)
+                    crcs[s] = zlib.crc32(row, crcs[s])
+
+            def issue_prefetch(bi: int) -> None:
+                if bi < len(batches):
+                    o, _, wd = batches[bi]
+                    for g in groups:
+                        g.prefetch(o, wd)
+
+            try:
+                for j in range(min(ahead, len(batches))):
+                    issue_prefetch(j)
+                for bi, (off, valid, width) in enumerate(batches):
+                    issue_prefetch(bi + ahead)  # network runs ahead of reads
+                    while len(inflight) >= depth:
+                        drain_one()
+                    staging = ring.take()
+                    for i, g in enumerate(groups):
+                        g.read_into(off, staging[i, : rows * width])
+                    combined = enc.project_lazy(
+                        combine, staging[:, : rows * width], donate=True
+                    )  # async
+                    inflight.append((combined, valid, width))
+                while inflight:
+                    drain_one()
+            except BaseException:
+                _discard_inflight(inflight)
+                raise
+        _verify_rebuilt_crcs(base_file_name, crcs)
+    except BaseException:
+        for s in missing:
+            try:
+                os.unlink(shard_file_name(base_file_name, s))
+            except OSError:
+                pass
+        raise
+    return missing
 
 
 def rebuild_ec_files_from_sources(

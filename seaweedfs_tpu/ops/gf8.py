@@ -223,6 +223,40 @@ def gf_const_to_bits(c: int) -> np.ndarray:
     return a
 
 
+def gf_project(coeffs: np.ndarray, stack: np.ndarray) -> np.ndarray:
+    """Repair projection, host golden path: apply an (R, C) GF(2^8)
+    coefficient matrix to a (C, N) survivor-byte stack -> (R, N).
+
+    This is the survivor-side half of trace repair: a holder of C local
+    survivor shards ships the R projected rows (R = number of shards
+    being rebuilt) instead of C full slabs. Thin, named alias of
+    `gf_mat_vec` so call sites read as repair math, not linear algebra."""
+    return gf_mat_vec(coeffs, stack)
+
+
+def gf_project_bits(coeffs: np.ndarray, stack: np.ndarray) -> np.ndarray:
+    """`gf_project` through the GF(2)/GF(2^8) subfield lift: unpack the
+    stack to little-endian bit-planes, multiply by the (8R, 8C) binary
+    block matrix from `gf_matrix_to_bits`, reduce mod 2, repack.
+
+    Byte-identical to `gf_project` by construction — it is the same
+    GF(2)-linear map the MXU matmul path runs (SURVEY.md §7.2), kept here
+    in numpy so the volume server's projection handler and the device
+    kernels share one verified formulation."""
+    coeffs = np.asarray(coeffs, dtype=np.uint8)
+    stack = np.asarray(stack, dtype=np.uint8)
+    r_n, c_n = coeffs.shape
+    if stack.shape[0] != c_n:
+        raise ValueError(f"stack rows {stack.shape[0]} != coeff cols {c_n}")
+    b = gf_matrix_to_bits(coeffs)  # (8R, 8C) over GF(2)
+    # (C, N) bytes -> (8C, N) little-endian bit-planes
+    bits = np.unpackbits(stack, axis=0, bitorder="little").reshape(8 * c_n, -1)
+    out_bits = (b.astype(np.uint32) @ bits.astype(np.uint32)) & 1
+    return np.packbits(
+        out_bits.astype(np.uint8).reshape(8 * r_n, -1), axis=0, bitorder="little"
+    ).reshape(r_n, -1)
+
+
 def gf_matrix_to_bits(m: np.ndarray) -> np.ndarray:
     """Lift an (R, C) GF(2^8) matrix to its (R*8, C*8) GF(2) block matrix.
 

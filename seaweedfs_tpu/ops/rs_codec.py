@@ -304,6 +304,58 @@ class Encoder:
             self.matrix_kind, self.data_shards, self.parity_shards, survivors, wanted
         )
 
+    def repair_projection_plan(
+        self, survivors: Sequence[int], wanted: Sequence[int]
+    ) -> dict[int, np.ndarray]:
+        """Per-survivor coefficient columns of the fused decode matrix:
+        shard id -> (len(wanted),) uint8 coefficients. The trace-repair
+        wire plan: a holder of local survivor set L ships the projection
+          row w = XOR_{s in L} plan[s][w] * shard_s
+        and XORing the holders' projections reproduces the decode matrix
+        applied to the full survivor stack EXACTLY (GF addition is XOR,
+        and matrix-vector products split column-wise), so trace rebuilds
+        are byte-identical to slab rebuilds on the same survivor set."""
+        m = self.reconstruction_matrix(survivors, wanted)
+        return {
+            int(s): np.ascontiguousarray(m[:, i])
+            for i, s in enumerate(survivors)
+        }
+
+    def project(self, coeffs: np.ndarray, stack: np.ndarray) -> np.ndarray:
+        """Survivor-side repair projection: apply an arbitrary (R, C)
+        GF(2^8) coefficient matrix to a (C, N) local-survivor stack
+        -> (R, N) host ndarray, through this encoder's backend (the same
+        bit-plane matmul the encode/decode paths run — gf8.gf_project is
+        the numpy golden it is tested byte-exact against). C is the
+        holder's LOCAL shard count, not data_shards."""
+        coeffs = np.asarray(coeffs, dtype=np.uint8)
+        stack = np.asarray(stack, dtype=np.uint8)
+        if coeffs.ndim != 2 or stack.ndim != 2:
+            raise ValueError(
+                f"want (R, C) coeffs and (C, N) stack, got {coeffs.shape} "
+                f"and {stack.shape}"
+            )
+        if coeffs.shape[1] != stack.shape[0]:
+            raise ValueError(
+                f"coeff cols {coeffs.shape[1]} != stack rows {stack.shape[0]}"
+            )
+        return np.asarray(self._apply_lazy(coeffs, stack))
+
+    def project_lazy(self, coeffs: np.ndarray, stack: np.ndarray, donate: bool = False):
+        """`project` without forcing the result to the host — the trace
+        rebuild pipeline's combine step (XOR of holder projections IS a
+        GF matmul by an all-ones row) rides the same async-dispatch
+        contract as encode_parity_lazy/reconstruct_lazy; np.asarray() on
+        the result is the synchronization point."""
+        coeffs = np.asarray(coeffs, dtype=np.uint8)
+        stack = np.asarray(stack, dtype=np.uint8)
+        if coeffs.ndim != 2 or stack.ndim != 2 or coeffs.shape[1] != stack.shape[0]:
+            raise ValueError(
+                f"want (R, C) coeffs and (C, N) stack, got {coeffs.shape} "
+                f"and {stack.shape}"
+            )
+        return self._apply_lazy(coeffs, stack, donate=donate)
+
     def reconstruct_lazy(
         self,
         stack: np.ndarray,

@@ -399,7 +399,10 @@ def _ec_collections(env: CommandEnv) -> dict[int, str]:
 
 
 def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    fl = parse_flags(args, collection="", remote=False)
+    fl = parse_flags(args, collection="", remote=False, trace="auto")
+    trace_mode = str(fl.trace).strip().lower()
+    if trace_mode not in ("on", "off", "auto"):
+        raise ShellError(f"-trace must be on|off|auto, got {fl.trace!r}")
     env.confirm_locked()
     nodes = env.topology_nodes()
     colls = _ec_collections(env)
@@ -426,13 +429,19 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
         addr = grpc_addr(rebuilder)
         if fl.remote:
             # distributed path: NO bulk survivor pre-copy. The rebuilder
-            # streams the slabs it lacks from peer holders while decoding
-            # (VolumeEcShardSlabRead pipeline), writes + CRC-verifies the
-            # missing .ecNN files, and mounts only those.
+            # streams survivor input from peer holders while decoding —
+            # trace-repair projections when the holders speak them
+            # (-trace auto/on), full slabs otherwise — writes +
+            # CRC-verifies the missing .ecNN files, and mounts only those.
             resp = env.vs_call(
                 addr,
                 "VolumeEcShardsRebuild",
-                {"volume_id": vid, "collection": collection, "remote": True},
+                {
+                    "volume_id": vid,
+                    "collection": collection,
+                    "remote": True,
+                    "trace_mode": trace_mode,
+                },
                 timeout=600,
             )
             rebuilt = resp.get("rebuilt_shard_ids", [])
@@ -447,6 +456,12 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
                 detail = f" (remote survivors {resp['remote_survivors']}"
                 if resp.get("failed_over"):
                     detail += f", failed over {resp['failed_over']}"
+                if resp.get("mode"):
+                    detail += f", {resp['mode']} mode"
+                    if resp.get("wire_bytes") is not None:
+                        detail += f" moved {resp['wire_bytes']} bytes"
+                    if resp.get("trace_fallback"):
+                        detail += f", trace fell back: {resp['trace_fallback']}"
                 detail += ")"
             w.write(
                 f"ec.rebuild volume {vid}: rebuilt {rebuilt} on "
@@ -477,10 +492,14 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
 register(
     ShellCommand(
         "ec.rebuild",
-        "ec.rebuild [-collection <name>] [-remote]\n\tfind EC volumes with lost "
-        "shards and reconstruct them on a rebuilder node; -remote streams\n"
-        "\tsurvivors from their holders through the network-overlapped rebuild\n"
-        "\tpipeline instead of bulk-copying shard files first",
+        "ec.rebuild [-collection <name>] [-remote] [-trace on|off|auto]\n\tfind "
+        "EC volumes with lost shards and reconstruct them on a rebuilder node;\n"
+        "\t-remote streams survivors from their holders through the network-\n"
+        "\toverlapped rebuild pipeline instead of bulk-copying shard files "
+        "first;\n\t-trace (with -remote) controls repair-bandwidth projections: "
+        "holders ship\n\tGF-projected rows instead of full slabs (on = wherever "
+        "holders support\n\tit, auto = only when it also moves fewer bytes; any "
+        "failure falls back\n\tto slabs)",
         do_ec_rebuild,
     )
 )

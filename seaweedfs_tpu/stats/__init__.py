@@ -263,6 +263,14 @@ EcRebuildRemoteBytes = REGISTRY.counter(
     "weedtpu_ec_rebuild_remote_bytes_total",
     "survivor bytes fetched from peer holders by distributed rebuilds",
 )
+EcRepairNetworkBytes = REGISTRY.counter(
+    "weedtpu_ec_repair_network_bytes_total",
+    "survivor payload bytes a rebuild target pulled over the network, by "
+    "source mode: `trace` = GF projection rows (|missing| rows per holder "
+    "group), `slab` = full survivor slabs — the repair-bandwidth headline "
+    "(trace must run strictly below slab for the same rebuild)",
+    ("mode",),
+)
 DegradedReadSeconds = REGISTRY.histogram(
     "weedtpu_degraded_read_seconds",
     "end-to-end latency of degraded (reconstructing) interval reads — the "

@@ -312,6 +312,32 @@ register_env(
     "ceding the GIL/IO to foreground reads under contention. 0 = off.",
 )
 register_env(
+    "WEEDTPU_TRACE_REPAIR", str, "auto",
+    "Trace-repair projections for distributed rebuilds: `on` attempts "
+    "projection fetches wherever holders advertise the slab_projection "
+    "capability, `off` forces full survivor slabs (and stops "
+    "advertising/serving the projection read), `auto` additionally "
+    "declines projections when the plan would not move fewer bytes than "
+    "the slabs it replaces. Any trace failure mid-rebuild falls back to "
+    "full slabs.",
+    parse=_enum("on", "off", "auto"),
+)
+register_env(
+    "WEEDTPU_TRACE_CHUNK", int, 4 * 1024 * 1024,
+    "Projection-window sub-range size (bytes) a TraceSlabSource fetches "
+    "per request — the trace analog of the slab stripe size (clamped to "
+    ">= 64 KiB).",
+    parse=_clamped_int(64 * 1024),
+)
+register_env(
+    "WEEDTPU_SLAB_FANOUT", int, 4,
+    "Striping fan-out of remote slab/projection sources: concurrent "
+    "sub-range fetches per source, spread across replica holders by "
+    "least-inflight pick so one window aggregates the holders' bandwidth "
+    "instead of pinning the first-sorted holder (clamped to >= 1).",
+    parse=_clamped_int(1),
+)
+register_env(
     "WEEDTPU_LOOKUP_RETRIES", int, 2,
     "Bounded retries (with decorrelated jitter) of the single-flight "
     "master shard-location lookup leader before it fails its waiters — "

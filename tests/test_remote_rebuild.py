@@ -211,7 +211,9 @@ def test_remote_rebuild_holder_failover_mid_rebuild(cluster3, tmp_path):
         resp = tc.call(
             VOLUME_SERVICE,
             "VolumeEcShardsRebuild",
-            {"volume_id": VID, "remote": True},
+            # trace_mode off: this test pins the SLAB failover path (the
+            # trace path's failure handling is tests/test_trace_repair.py)
+            {"volume_id": VID, "remote": True, "trace_mode": "off"},
             timeout=120,
         )
     assert resp["rebuilt_shard_ids"] == [10, 11, 12, 13]

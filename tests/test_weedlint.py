@@ -102,8 +102,11 @@ def test_wire_drift_fixture_fires():
     msgs = " | ".join(f.message for f in drift)
     assert "requester" in msgs, findings
     assert "extra" in msgs, findings
-    # the legitimate req["volume_id"] read (line 11) stays clean
-    assert not any(f.line == 11 for f in drift), drift
+    # the singular typo of the repeated-projection shape fires too
+    assert "projection_row" in msgs, findings
+    # the legitimate reads stay clean: req["volume_id"] (line 12) and the
+    # extended slab-read shape's projection/projection_rows (lines 17-18)
+    assert not any(f.line in (12, 17, 18) for f in drift), drift
 
 
 def test_parse_proto_oneof_fields_belong_to_message():
@@ -117,6 +120,11 @@ def test_parse_proto_oneof_fields_belong_to_message():
     assert messages["DoThingResponse"] == {"ok", "detail", "code"}
     assert messages["DoThingRequest"] == {"volume_id", "collection"}
     assert methods["StreamThing"][0][2] is True  # stream response parsed
+    # the extended slab-read fixture: repeated nested-message field parsed
+    assert messages["SlabThingRequest"] == {
+        "volume_id", "projection", "projection_rows"
+    }
+    assert messages["ProjTerm"] == {"shard_id", "coeffs"}
 
 
 # -- suppression semantics ----------------------------------------------------

@@ -6,8 +6,15 @@ Never imported; parsed only."""
 class Server:
     def _build(self, svc):
         svc.add("DoThing", self._rpc_do_thing)
+        svc.add("SlabThing", self._rpc_slab_thing)
 
     def _rpc_do_thing(self, req, ctx):
         vid = req["volume_id"]  # fine: in DoThingRequest
         who = req["requester"]  # BAD: not a DoThingRequest field
         return {"ok": True, "extra": who}  # "extra" BAD: not in DoThingResponse
+
+    def _rpc_slab_thing(self, req, ctx):
+        terms = req.get("projection")  # fine: repeated message field
+        rows = req["projection_rows"]  # fine
+        bad = req["projection_row"]  # BAD: singular typo of the field
+        yield bytes(rows or 0) + bytes(len(terms or ())) + bytes(bool(bad))
