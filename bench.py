@@ -1221,6 +1221,189 @@ def _measure_rebuild_trace(
 
 
 # ---------------------------------------------------------------------------
+# stage 2g: inline-EC ingest — amortized encode-on-write + delta parity
+# ---------------------------------------------------------------------------
+
+
+def mode_ingest() -> None:
+    """Write-heavy workload headline: a volume's bytes streamed through the
+    encode-on-write stripe builder (poll per append burst) vs the warm
+    batch conversion, plus the small-write delta-parity accounting — the
+    < 0.5x bytes gate for <=1% stripe overwrites."""
+    import tempfile
+
+    import jax  # noqa: F401
+
+    from seaweedfs_tpu.utils.devices import honor_platform_env
+
+    honor_platform_env()
+    with tempfile.TemporaryDirectory() as td:
+        _emit(_measure_ingest(td))
+
+
+def _measure_ingest(
+    td: str,
+    dat_bytes: int = 192 << 20,
+    large: int = 1 << 20,
+    small: int = 256 << 10,
+    buffer_size: int = 256 << 10,
+    append_chunk: int = 4 << 20,
+    overwrite_fraction: float = 0.01,
+    overwrite_count: int = 16,
+    encoder=None,
+) -> dict:
+    """Inline-vs-warm encode on the same volume bytes.
+
+    Inline: the .dat is appended in `append_chunk` bursts with a builder
+    poll after each (the ingest write-path shape); amortized GB/s counts
+    data bytes over the SUM of encode time (polls + seal), i.e. what the
+    encoder actually spent, spread across ingest. Warm: one
+    `write_ec_files` over the finished .dat. Output byte-identity is
+    asserted, not assumed.
+
+    Delta: `overwrite_count` random ranges totaling `overwrite_fraction`
+    of the .dat are folded into a FULLY-encoded stripe via the journaled
+    delta path; the gate compares deterministic BYTE counts (not
+    timings): delta bytes computed/moved (changed x (2 data + 2x parity
+    RMW)) must stay under 0.5x a full re-encode's dat read + 14 shard
+    writes. Shards after the deltas are verified byte-identical to a
+    warm encode of the mutated .dat."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec import ingest, stripe
+    from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+    from seaweedfs_tpu.ops.rs_codec import new_encoder
+
+    enc = encoder or new_encoder()
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, dat_bytes, dtype=np.uint8).tobytes()
+    out: dict = {
+        "dat_mib": round(dat_bytes / (1 << 20), 2),
+        "large_block": large,
+        "small_block": small,
+        "backend": enc.backend,
+        "protocol": (
+            "inline = append in bursts + builder poll per burst + seal; "
+            "amortized GB/s = data bytes / (sum of poll secs + seal secs); "
+            "warm = one write_ec_files over the finished .dat; both outputs "
+            "byte-compared. delta gate compares BYTE counts: changed x "
+            "(2 + 2 x parity RMW) vs dat read + 14 shard writes of a full "
+            "re-encode, for <=1% overwrites"
+        ),
+    }
+
+    # -- inline: stream-append + poll ---------------------------------------
+    base_i = os.path.join(td, "inline", "5")
+    os.makedirs(os.path.dirname(base_i))
+    builder = ingest.InlineStripeBuilder(
+        base_i, enc, large, small, buffer_size=buffer_size
+    )
+    encode_s = 0.0
+    polls = 0
+    with open(base_i + ".dat", "wb") as f:
+        for off in range(0, dat_bytes, append_chunk):
+            f.write(data[off : off + append_chunk])
+            f.flush()
+            t0 = time.perf_counter()
+            if builder.poll():
+                polls += 1
+            encode_s += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    info = builder.seal()
+    seal_s = time.perf_counter() - t0
+    out["inline"] = {
+        "amortized_gbps": round(dat_bytes / (encode_s + seal_s) / 1e9, 3),
+        "poll_s": round(encode_s, 3),
+        "seal_s": round(seal_s, 3),
+        "polls_with_work": polls,
+        "rows_inline": info["rows_inline"],
+        "rows_total": info["rows_total"],
+    }
+
+    # -- warm reference ------------------------------------------------------
+    base_w = os.path.join(td, "warm", "5")
+    os.makedirs(os.path.dirname(base_w))
+    with open(base_w + ".dat", "wb") as f:
+        f.write(data)
+    t0 = time.perf_counter()
+    stripe.write_ec_files(
+        base_w, large_block_size=large, small_block_size=small,
+        buffer_size=buffer_size, encoder=enc,
+    )
+    warm_s = time.perf_counter() - t0
+    out["warm"] = {"gbps": round(dat_bytes / warm_s / 1e9, 3), "wall_s": round(warm_s, 3)}
+    match = all(
+        open(stripe.shard_file_name(base_i, s), "rb").read()
+        == open(stripe.shard_file_name(base_w, s), "rb").read()
+        for s in range(TOTAL_SHARDS_COUNT)
+    ) and open(base_i + ".eci", "rb").read() == open(base_w + ".eci", "rb").read()
+    out["match"] = bool(match)
+
+    # -- delta parity updates on a fully-encoded stripe ----------------------
+    base_d = os.path.join(td, "delta", "5")
+    os.makedirs(os.path.dirname(base_d))
+    with open(base_d + ".dat", "wb") as f:
+        f.write(data)
+    b2 = ingest.InlineStripeBuilder(
+        base_d, enc, large, small, buffer_size=buffer_size
+    )
+    b2.poll()
+    encoded_limit = b2.encoded_limit()
+    per = max(1, int(dat_bytes * overwrite_fraction) // overwrite_count)
+    mutated = bytearray(data)
+    t0 = time.perf_counter()
+    for i in range(overwrite_count):
+        off = int(rng.integers(0, max(1, encoded_limit - per)))
+        new_seg = rng.integers(0, 256, per, dtype=np.uint8).tobytes()
+        old_seg = bytes(mutated[off : off + per])
+
+        def mutate(off=off, new_seg=new_seg):
+            with open(base_d + ".dat", "r+b") as f:
+                f.seek(off)
+                f.write(new_seg)
+
+        b2.overwrite(off, old_seg, new_seg, mutate=mutate)
+        mutated[off : off + per] = new_seg
+    delta_wall = time.perf_counter() - t0
+    changed = b2.delta_stats["changed_bytes"]
+    delta_bytes = b2.delta_stats["accounted_bytes"]
+    b2.seal()
+    shard_size = os.path.getsize(stripe.shard_file_name(base_d, 0))
+    reencode_bytes = dat_bytes + TOTAL_SHARDS_COUNT * shard_size
+    base_m = os.path.join(td, "mut", "5")
+    os.makedirs(os.path.dirname(base_m))
+    with open(base_m + ".dat", "wb") as f:
+        f.write(bytes(mutated))
+    t0 = time.perf_counter()
+    stripe.write_ec_files(
+        base_m, large_block_size=large, small_block_size=small,
+        buffer_size=buffer_size, encoder=enc,
+    )
+    reencode_wall = time.perf_counter() - t0
+    delta_match = all(
+        open(stripe.shard_file_name(base_d, s), "rb").read()
+        == open(stripe.shard_file_name(base_m, s), "rb").read()
+        for s in range(TOTAL_SHARDS_COUNT)
+    )
+    out["delta"] = {
+        "overwrites": overwrite_count,
+        "overwrite_fraction": round(changed / dat_bytes, 5),
+        "changed_bytes": int(changed),
+        "delta_bytes": int(delta_bytes),
+        "reencode_bytes": int(reencode_bytes),
+        "bytes_ratio": round(delta_bytes / reencode_bytes, 5),
+        "wall_s": round(delta_wall, 3),
+        "reencode_wall_s": round(reencode_wall, 3),
+        "wall_ratio": round(delta_wall / reencode_wall, 4) if reencode_wall else None,
+        "match": bool(delta_match),
+    }
+    out["ok"] = bool(
+        match and delta_match and out["delta"]["bytes_ratio"] < 0.5
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # stage 2d: dp-scaling sweep (child, 8 virtual CPU devices)
 # ---------------------------------------------------------------------------
 
@@ -1488,6 +1671,17 @@ def main() -> None:
     else:
         result["ec_rebuild_trace_error"] = rt_err
 
+    # stage 2g: inline-EC ingest — amortized encode-on-write + delta gate
+    ing, ing_err = _run_child(
+        "ingest",
+        timeout=min(300, max(30, int(deadline - time.monotonic()))),
+        extra_env={"JAX_PLATFORMS": "cpu"},
+    )
+    if ing:
+        result["ec_ingest"] = ing
+    else:
+        result["ec_ingest_error"] = ing_err
+
     # stage 2d: dp-scaling sweep over the virtual 8-device CPU mesh
     if deadline - time.monotonic() > 30:
         dp, dp_err = _run_child(
@@ -1646,6 +1840,8 @@ if __name__ == "__main__":
         mode_rebuild_remote()
     elif mode == "rebuild_trace":
         mode_rebuild_trace()
+    elif mode == "ingest":
+        mode_ingest()
     elif mode == "dp":
         mode_dp()
     elif mode == "device":

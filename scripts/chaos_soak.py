@@ -33,10 +33,19 @@ replica) inside the SAME rebuild call, with zero lost bytes. Kill-mode
 nodes run with a small WEEDTPU_BENCH_RPC_DELAY_MS so the rebuild spans
 enough wall time for the kill to land mid-stream.
 
+`--inline` starts every volume server with WEEDTPU_INLINE_EC=on (bench-
+scale stripe geometry so rows actually complete) and adds an INLINE-
+INGEST scenario to kill mode: a volume taking writes is SIGKILLed ON ITS
+OWNER mid-inline-encode (stripe partials + journal on disk), the node
+restarts, more writes land (the builder resumes from the journaled
+sidecar), and the volume is then sealed with VolumeEcShardsGenerate
+{inline:true} — resume-or-fallback must produce a mountable shard set
+and the final read pass must verify EVERY byte.
+
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
-      python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency]
-Writes artifacts/SOAK_r08.json and exits nonzero on any lost byte.
+      python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency] [--inline]
+Writes artifacts/SOAK_r09.json and exits nonzero on any lost byte.
 """
 
 from __future__ import annotations
@@ -126,8 +135,15 @@ def main() -> int:
         seconds = int(sys.argv[sys.argv.index("--seconds") + 1])
     wedge_mode = "--wedge" in sys.argv
     latency_mode = "--latency" in sys.argv
+    inline_mode = "--inline" in sys.argv
     rng = random.Random(7)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if inline_mode:
+        # must land before the server subprocesses start (Node.start copies
+        # os.environ); bench-scale rows so soak-sized volumes complete them
+        os.environ.setdefault("WEEDTPU_INLINE_EC", "on")
+        os.environ.setdefault("WEEDTPU_INLINE_EC_LARGE_BLOCK", "8192")
+        os.environ.setdefault("WEEDTPU_INLINE_EC_SMALL_BLOCK", "2048")
     modeled_delay_ms = 0.0
     if not wedge_mode:
         # stretch rebuild windows so the trace scenario's mid-rebuild kill
@@ -149,6 +165,7 @@ def main() -> int:
         "when": time.strftime("%FT%TZ", time.gmtime()),
         "seconds": seconds,
         "mode": "wedge" if wedge_mode else "kill",
+        "inline_ec": inline_mode,
         # kill-mode nodes run with this per-RPC server-side sleep on shard/
         # slab reads (the trace scenario needs rebuilds to span wall time);
         # latency quantiles below therefore include it on any degraded read
@@ -444,6 +461,92 @@ def main() -> int:
                 report["trace_rebuild"] = outcome
                 return True
 
+            def try_inline_seal() -> bool:
+                """Inline-ingest chaos scenario (--inline, kill mode): pick
+                a volume still taking writes, SIGKILL its owner while the
+                encode-on-write builder has stripe partials + journal on
+                disk, restart it, land more writes (the builder must
+                RESUME from the journaled sidecar), then seal with
+                VolumeEcShardsGenerate{inline:true}. resume-or-fallback
+                must yield a mountable shard set; the final read pass
+                proves zero lost bytes either way."""
+                if not inline_mode or wedge_mode:
+                    return True  # nothing to do in this mode: stop retrying
+                ec_vid = report.get("ec_encoded_vid")
+                vids = sorted(
+                    {int(f.split(",")[0]) for f in blobs}
+                    - {ec_vid if ec_vid is not None else -1}
+                )
+                outcome: dict = {}
+                for vid in vids:
+                    owner = None
+                    for n in nodes:
+                        if not n.alive:
+                            continue
+                        try:
+                            with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                                st = c.call(
+                                    VOLUME_SERVICE, "VolumeStatus",
+                                    {"volume_id": vid}, timeout=5,
+                                )
+                            if st.get("kind") == "normal" and not st.get("read_only"):
+                                owner = n
+                                break
+                        except Exception:  # noqa: BLE001 — not the owner
+                            continue
+                    if owner is None:
+                        continue
+                    outcome = {"vid": vid, "owner_killed": owner.i}
+                    try:
+                        # a couple of writes so the builder is live, then
+                        # the kill lands with partials mid-flight
+                        for _ in range(3):
+                            write_one()
+                        owner.kill(hard=True)
+                        report["kills"] += 1
+                        owner.start()
+                        time.sleep(2.5)
+                        for _ in range(3):
+                            write_one()  # resume path: builder reloads journal
+                        with _rpc.RpcClient(f"127.0.0.1:{owner.grpc}") as c:
+                            c.call(
+                                VOLUME_SERVICE, "VolumeMarkReadonly",
+                                {"volume_id": vid}, timeout=30,
+                            )
+                            resp = c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                                {"volume_id": vid, "inline": True}, timeout=120,
+                            )
+                            outcome.update(
+                                mode=resp.get("mode"),
+                                inline_rows=resp.get("inline_rows"),
+                            )
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsMount",
+                                {"volume_id": vid}, timeout=30,
+                            )
+                            c.call(
+                                VOLUME_SERVICE, "VolumeDelete",
+                                {"volume_id": vid}, timeout=30,
+                            )
+                        outcome["sealed"] = True
+                    except Exception as e:  # noqa: BLE001 — recorded; reads
+                        # below still hold the zero-loss bar either way
+                        outcome["error"] = str(e)[:200]
+                    report["inline_seal"] = outcome
+                    return True
+                return False  # no live unsealed volume this round: retry
+
+            # the inline-ingest scenario runs BEFORE the kill loop (it
+            # brings its own SIGKILL): every node is alive, so seeding a
+            # fresh non-EC volume with writes is reliable — mid-loop the
+            # replication fan-out fails too often to guarantee a candidate
+            for _ in range(5):
+                if try_inline_seal():
+                    break
+                for _ in range(3):
+                    write_one()
+
             t_end = time.monotonic() + seconds
             rebuild_tried = False
             trace_tried = False
@@ -521,7 +624,7 @@ def main() -> int:
         report["latency"] = lat_rec.phases().get("soak", {})
     report["ok"] = not report["lost"]
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, "SOAK_r08.json"), "w", encoding="utf-8") as f:
+    with open(os.path.join(ART, "SOAK_r09.json"), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["ok"] else 1

@@ -58,6 +58,10 @@ SCRAPED_COUNTERS = (
     "weedtpu_degraded_read_seconds_count",
     "weedtpu_degraded_read_errors_total",
     "weedtpu_ec_repair_network_bytes_total",
+    "weedtpu_inline_ec_rows_total",
+    "weedtpu_inline_ec_bytes_total",
+    "weedtpu_inline_ec_delta_updates_total",
+    "weedtpu_inline_ec_seals_total",
 )
 
 
@@ -75,6 +79,13 @@ def parse_args(argv):
                         "this much before the client fails over, for healthy "
                         "and degraded traffic alike (30 s would let one "
                         "SIGSTOP dominate every class's tail)")
+    p.add_argument("--put-fraction", type=float, default=0.0,
+                   help="fraction of arrivals that are PUTs (assign + upload "
+                        "over the master HTTP front). Any value > 0 also "
+                        "starts the servers with WEEDTPU_INLINE_EC=on so "
+                        "every PUT streams through the encode-on-write "
+                        "stripe builders — the write-heavy workload. PUT "
+                        "latency lands in the artifact under class `put`")
     p.add_argument("--dropped-shards", type=int, nargs="*", default=[0, 1],
                    help="data shards deleted cluster-wide (degraded fraction)")
     p.add_argument("--ec-large-block", type=int, default=1 << 20,
@@ -285,11 +296,14 @@ class _InprocNode:
 
 def run_load(
     args, client, rec, lost, keys, cdf, klass_of, phases: list[tuple[str, float]],
-    chaos_fn=None,
+    chaos_fn=None, put_fn=None,
 ):
     """Open-loop Poisson arrivals over `phases` ([(name, seconds), ...]):
     latency is measured from each request's SCHEDULED time, so server
-    stalls surface as tail latency instead of reduced offered load."""
+    stalls surface as tail latency instead of reduced offered load.
+    `put_fn(sched, phase)` (when given) serves the --put-fraction share of
+    arrivals — write traffic interleaved with the read mix, same open-loop
+    accounting."""
     rng = random.Random(args.seed + 1)
     pool = ThreadPoolExecutor(max_workers=args.concurrency)
     issued = 0
@@ -326,8 +340,11 @@ def run_load(
                 if now < next_t:
                     time.sleep(min(next_t - now, 0.02))
                     continue
-                fid = pick_zipf(rng, keys, cdf)
-                pool.submit(one, fid, client_blobs[fid], next_t, phase)
+                if put_fn is not None and rng.random() < args.put_fraction:
+                    pool.submit(put_fn, next_t, phase)
+                else:
+                    fid = pick_zipf(rng, keys, cdf)
+                    pool.submit(one, fid, client_blobs[fid], next_t, phase)
                 issued += 1
                 next_t += rng.expovariate(args.rps)
             stop_chaos.set()
@@ -371,6 +388,14 @@ def main(argv=None) -> int:
         # must land BEFORE the server processes start (they read it once
         # at init); a tight gate makes the storm actually queue
         os.environ.setdefault("WEEDTPU_REBUILD_MAX_INFLIGHT", "4")
+    if args.put_fraction > 0:
+        # write traffic exercises the encode-on-write path: servers start
+        # with inline EC on and a bench-scale stripe geometry so PUT-fed
+        # volumes actually complete large rows within the run (the
+        # production 1 GiB rows would never fill here)
+        os.environ.setdefault("WEEDTPU_INLINE_EC", "on")
+        os.environ.setdefault("WEEDTPU_INLINE_EC_LARGE_BLOCK", str(256 << 10))
+        os.environ.setdefault("WEEDTPU_INLINE_EC_SMALL_BLOCK", str(16 << 10))
 
     rec = slo.LatencyRecorder()
     lost: list[dict] = []
@@ -468,6 +493,35 @@ def main(argv=None) -> int:
 
             scraper = CounterScraper()
 
+            put_rng = random.Random(args.seed + 3)
+            put_lock = threading.Lock()
+            puts_done = [0]
+
+            def put_one(sched: float, phase: str) -> None:
+                """One open-loop PUT: assign + upload over the master front.
+                New blobs join client_blobs so the final zero-loss pass
+                verifies them; a read-only race (a volume sealing under
+                the writer) retries once with a fresh assign before it
+                counts as an error — exactly what a real client does.
+                Payload construction stays OUTSIDE the lock (os.urandom,
+                not the shared RNG): latency is measured from scheduled
+                time, so serialized generation would read as server tail."""
+                with put_lock:
+                    size = put_rng.randrange(500, 40_000)
+                payload = os.urandom(size)
+                for _ in range(2):
+                    try:
+                        a = client.assign(replication="001")
+                        client.upload(a.fid, payload)
+                        client_blobs[a.fid] = payload
+                        with put_lock:
+                            puts_done[0] += 1
+                        rec.observe(phase, "put", time.monotonic() - sched)
+                        return
+                    except Exception:  # noqa: BLE001 — re-assign once
+                        continue
+                rec.error(phase, "put")
+
             storm_threads: list[threading.Thread] = []
             if args.rebuild_storm:
                 # concurrent remote rebuilds of the dropped shards at the
@@ -550,6 +604,7 @@ def main(argv=None) -> int:
             issued = run_load(
                 args, client, rec, lost, keys, cdf, klass_of, phases,
                 chaos_fn=chaos_fn if args.chaos else None,
+                put_fn=put_one if args.put_fraction > 0 else None,
             )
             for t in storm_threads:
                 t.join(timeout=10)
@@ -611,6 +666,8 @@ def main(argv=None) -> int:
             "concurrency": args.concurrency,
             "front": "master-http",
             "servers": "in-process" if args.smoke else "subprocess",
+            "put_fraction": args.put_fraction,
+            "puts_acked": puts_done[0],
         },
         chaos=chaos_report,
         knobs={
@@ -619,11 +676,16 @@ def main(argv=None) -> int:
                 "WEEDTPU_HEDGE_READS", "WEEDTPU_HEDGE_DELAY_MS",
                 "WEEDTPU_COALESCE_READS", "WEEDTPU_REBUILD_MAX_INFLIGHT",
                 "WEEDTPU_REBUILD_YIELD_MS", "WEEDTPU_LOOKUP_RETRIES",
+                "WEEDTPU_INLINE_EC", "WEEDTPU_INLINE_EC_SEAL_BYTES",
+                "WEEDTPU_INLINE_EC_DELTA",
             )
         },
         counters=counters,
         lost=lost,
         slo_factor=args.slo_factor,
+        classes=("healthy", "degraded", "put")
+        if args.put_fraction > 0
+        else ("healthy", "degraded"),
     )
     slo.write_report(args.out, report)
     print(json.dumps(report, indent=1))

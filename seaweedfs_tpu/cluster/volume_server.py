@@ -182,6 +182,19 @@ class VolumeServer:
         # nor serves the projection read — the capability-negotiation
         # fallback path): on | off | auto
         self._trace_repair = config.env("WEEDTPU_TRACE_REPAIR")
+        # inline-EC ingest (encode-on-write): when the policy is on, every
+        # acked append polls the volume's stripe builder through the
+        # Store.on_write seam, so a sealing volume is born EC'd instead of
+        # paying a warm batch conversion; crossing the auto-seal threshold
+        # finalizes in a background thread. Policy off = no hook, no cost.
+        self._ingest = None
+        if config.env("WEEDTPU_INLINE_EC") == "on":
+            from seaweedfs_tpu.ec.ingest import IngestManager
+
+            self._ingest = IngestManager(
+                self.store, seal_trigger=self._auto_inline_seal
+            )
+            self.store.on_write = self._ingest.on_write
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -218,6 +231,8 @@ class VolumeServer:
         for c in self._masters.values():
             c.close()
         self._peer_pool.close_all()
+        if self._ingest is not None:
+            self._ingest.close()  # journaled state stays on disk for resume
         self.store.close()
 
     def __enter__(self):
@@ -611,6 +626,11 @@ class VolumeServer:
         return {}
 
     def _rpc_volume_delete(self, req: dict, ctx) -> dict:
+        if self._ingest is not None:  # partial stripe state dies with the .dat
+            v = self.store.get_volume(int(req["volume_id"]))
+            self._ingest.discard(
+                int(req["volume_id"]), v.base_path if v is not None else None
+            )
         self.store.remove_volume(int(req["volume_id"]))
         self.heartbeat_once()  # push the deletion to the master now
         return {}
@@ -668,7 +688,19 @@ class VolumeServer:
                 # frozen volumes are frozen for a reason (ec.encode, copy in
                 # flight): compacting one would shift every needle offset
                 raise rpc.RpcFault(f"volume {vid} is read-only; not compacting")
+            if self._ingest is not None:
+                # compaction rewrites the whole .dat: every encoded inline
+                # row is stale — drop the state (journal + partials too),
+                # a fresh builder restarts from the compacted file on the
+                # next write
+                self._ingest.discard(vid, v.base_path)
             before, after = v.compact()
+            if self._ingest is not None:
+                # again AFTER the rewrite: a write that acked just before
+                # the compact may have raced a builder back into existence
+                # from the PRE-compact .dat between the first discard and
+                # the offset-shifting rewrite
+                self._ingest.discard(vid, v.base_path)
         return {"bytes_before": before, "bytes_after": after}
 
     def _rpc_volume_copy(self, req: dict, ctx) -> dict:
@@ -726,6 +758,8 @@ class VolumeServer:
         if v.tiered:
             raise rpc.RpcFault(f"volume {vid} is already tiered")
         client = make_remote_client(req["destination"])
+        if self._ingest is not None:  # the local .dat is leaving this disk
+            self._ingest.discard(vid, v.base_path)
         was_read_only = v.read_only
         v.read_only = True  # freeze writes; READS keep serving during upload
         try:
@@ -879,7 +913,31 @@ class VolumeServer:
                 f"volume {v.id} is tiered — fetch it local first (volume.tier.fetch)",
                 code=grpc.StatusCode.FAILED_PRECONDITION,
             )
-        v.configure_replication(req["replication"])
+        if self._ingest is not None:
+            # the superblock rewrite is an IN-PLACE .dat overwrite inside
+            # stripe row 0 — route it through the journaled delta-parity
+            # path so the inline stripe stays exact instead of silently
+            # stale (the end-to-end consumer of Encoder.parity_delta).
+            # Under the maintenance lock: a seal (generate/auto-seal) holds
+            # it while finalizing, so the rewrite can never land BETWEEN
+            # the builder being popped and the shards being renamed — the
+            # window where it would bypass the delta path silently.
+            import dataclasses
+
+            from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+            with self.maintenance_lock(int(req["volume_id"])):
+                old = v.super_block.to_bytes()
+                new = dataclasses.replace(
+                    v.super_block,
+                    replica_placement=ReplicaPlacement.parse(req["replication"]),
+                ).to_bytes()
+                self._ingest.overwrite(
+                    int(req["volume_id"]), 0, old, new,
+                    mutate=lambda: v.configure_replication(req["replication"]),
+                )
+        else:
+            v.configure_replication(req["replication"])
         self.heartbeat_once()  # the topology keys layouts by (coll, rp, ttl)
         return {"replication": str(v.super_block.replica_placement)}
 
@@ -927,7 +985,16 @@ class VolumeServer:
     # EC surface (SURVEY.md §2.4)
 
     def _rpc_ec_generate(self, req: dict, ctx) -> dict:
-        """VolumeEcShardsGenerate: local .dat+.idx -> 14 shards + .ecx."""
+        """VolumeEcShardsGenerate: local .dat+.idx -> 14 shards + .ecx.
+
+        With `inline: true` the shards are finalized from the encode-on-
+        write stripe state (resumed from the journaled sidecar after a
+        crash) instead of re-encoding the whole sealed .dat — byte-
+        identical output, but the bulk of the encode already happened at
+        ingest time. Any unusable inline state (policy off, geometry
+        mismatch, broken/un-vouchable journal) falls back to the warm
+        conversion inside the same call; the response's `mode` says which
+        path actually produced the shards."""
         vid = int(req["volume_id"])
         v = self.store.get_volume(vid)
         if v is None:
@@ -938,12 +1005,79 @@ class VolumeServer:
         if req.get("small_block_size"):
             kwargs["small_block_size"] = int(req["small_block_size"])
         t0 = time.monotonic()
+        info: dict = {"mode": "warm"}
         with self.maintenance_lock(vid):  # never interleave with compact/copy
-            stripe.write_ec_files(v.base_path, encoder=self.store.encoder, **kwargs)
+            if req.get("inline") and self._inline_usable(kwargs):
+                info = self._ingest.seal_volume(vid, v.base_path)
+            else:
+                if self._ingest is not None:
+                    # a warm generate supersedes any inline partial state:
+                    # leftovers must not shadow the fresh shard set — base
+                    # included, so journaled state from before a restart
+                    # (no live builder) is scrubbed from disk too
+                    self._ingest.discard(vid, v.base_path)
+                stripe.write_ec_files(
+                    v.base_path, encoder=self.store.encoder, **kwargs
+                )
             stripe.write_sorted_file_from_idx(v.base_path)
         stats.EcEncodeSeconds.observe(time.monotonic() - t0)
         stats.EcEncodeBytes.inc(os.path.getsize(v.base_path + ".dat"))
-        return {"shard_ids": list(range(TOTAL_SHARDS_COUNT))}
+        return {
+            "shard_ids": list(range(TOTAL_SHARDS_COUNT)),
+            "mode": info.get("mode", "warm"),
+            "inline_rows": int(info.get("rows_inline", 0)),
+            "delta_updates": int(info.get("delta_updates", 0)),
+        }
+
+    def _inline_usable(self, kwargs: dict) -> bool:
+        """Inline finalize serves the request only when the policy is on
+        and any explicitly-requested geometry matches what the builders
+        encoded with — a mismatched request warm-encodes with ITS sizes."""
+        if self._ingest is None:
+            return False
+        if kwargs.get("large_block_size", self._ingest.large) != self._ingest.large:
+            return False
+        if kwargs.get("small_block_size", self._ingest.small) != self._ingest.small:
+            return False
+        return True
+
+    def _auto_inline_seal(self, vid: int) -> None:
+        """Threshold auto-seal (WEEDTPU_INLINE_EC_SEAL_BYTES): freeze the
+        volume, finalize its inline stripe (warm fallback inside
+        seal_volume), write the sorted index, and mount the EC volume —
+        the volume is born EC'd with no operator in the loop. Reads keep
+        serving from the now read-only volume; spreading shards across
+        the cluster stays the shell's (ec.encode) cut-over decision."""
+        sealed = False
+        froze = False  # only roll back a freeze THIS seal applied — the
+        # early-return guard must never un-freeze a volume an operator
+        # (or the shell's ec.encode) made read-only
+        v = None
+        try:
+            with self.maintenance_lock(vid):
+                v = self.store.get_volume(vid)
+                if v is None or v.read_only or getattr(v, "tiered", False):
+                    return
+                with v._lock:
+                    v.read_only = True
+                    froze = True
+                t0 = time.monotonic()
+                self._ingest.seal_volume(vid, v.base_path)
+                stripe.write_sorted_file_from_idx(v.base_path)
+                self.store.mount_ec_volume(vid, v.base_path)
+                stats.EcEncodeSeconds.observe(time.monotonic() - t0)
+                stats.EcEncodeBytes.inc(os.path.getsize(v.base_path + ".dat"))
+                sealed = True
+            self.heartbeat_once()
+        except Exception:  # noqa: BLE001 — auto-seal is opportunistic: the
+            # volume must come back writable and the trigger re-arms, so a
+            # transient failure costs a retry at the next threshold write
+            pass
+        finally:
+            if not sealed and self._ingest is not None:
+                if froze:
+                    v.read_only = False
+                self._ingest.seal_failed(vid)
 
     def _rpc_ec_copy(self, req: dict, ctx) -> dict:
         """VolumeEcShardsCopy: PULL the named shards (+index files) from the

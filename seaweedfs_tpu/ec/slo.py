@@ -202,10 +202,15 @@ def assemble_report(
     counters: Optional[dict] = None,
     lost: Optional[list] = None,
     slo_factor: float = 5.0,
+    classes: tuple = ("healthy", "degraded"),
 ) -> dict:
     """The SLO_r*.json schema (committed-artifact format, BENCH_r* sibling):
-    workload parameters, per-phase healthy/degraded quantiles, whole-run
-    aggregates, the SLO verdict, the chaos ledger, and zero-loss evidence."""
+    workload parameters, per-phase per-class quantiles, whole-run
+    aggregates, the SLO verdict, the chaos ledger, and zero-loss evidence.
+    `classes` lists the traffic classes folded into the `overall` section
+    — healthy/degraded always (the SLO comparison), plus e.g. `put` when
+    the run offered write traffic (weedload --put-fraction)."""
+    merged_classes = tuple(dict.fromkeys(("healthy", "degraded") + tuple(classes)))
     report = {
         "when": time.strftime("%FT%TZ", time.gmtime()),
         "kind": "slo",
@@ -213,8 +218,7 @@ def assemble_report(
         "chaos": chaos or {},
         "phases": recorder.phases(),
         "overall": {
-            "healthy": recorder.merged("healthy").summary(),
-            "degraded": recorder.merged("degraded").summary(),
+            klass: recorder.merged(klass).summary() for klass in merged_classes
         },
         "slo": slo_verdict(recorder, factor=slo_factor),
         "knobs": knobs or {},

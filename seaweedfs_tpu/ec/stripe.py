@@ -250,6 +250,29 @@ def _encode_rows(
         raise
 
 
+def stripe_layout(
+    dat_size: int, large_block_size: int, small_block_size: int
+) -> tuple[int, int]:
+    """(n_large, n_small) rows for a .dat of `dat_size` bytes — THE layout
+    rule (WriteEcFiles semantics): while strictly more than one full large
+    row remains, rows are large; the tail becomes small rows, the last one
+    zero-padded past EOF. The ONE definition shared by the warm converter
+    and the inline-ingest builder: their byte-identity contract is exactly
+    this function agreeing with itself."""
+    large_row = large_block_size * DATA_SHARDS_COUNT
+    small_row = small_block_size * DATA_SHARDS_COUNT
+    n_large = 0
+    remaining = dat_size
+    while remaining > large_row:
+        n_large += 1
+        remaining -= large_row
+    n_small = 0
+    while remaining > 0:
+        n_small += 1
+        remaining -= small_row
+    return n_large, n_small
+
+
 def write_ec_files(
     base_file_name: str,
     large_block_size: int = ERASURE_CODING_LARGE_BLOCK_SIZE,
@@ -271,17 +294,7 @@ def write_ec_files(
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     large_row = large_block_size * DATA_SHARDS_COUNT
-    small_row = small_block_size * DATA_SHARDS_COUNT
-
-    n_large = 0
-    remaining = dat_size
-    while remaining > large_row:
-        n_large += 1
-        remaining -= large_row
-    n_small = 0
-    while remaining > 0:
-        n_small += 1
-        remaining -= small_row
+    n_large, n_small = stripe_layout(dat_size, large_block_size, small_block_size)
 
     crcs = [0] * TOTAL_SHARDS_COUNT
     try:
@@ -1161,8 +1174,15 @@ def write_idx_file_from_ec_index(base_file_name: str) -> None:
 
 
 def append_ecj(base_file_name: str, needle_id: int) -> None:
+    """Journal one EC deletion, fsync'd: an acked EC delete must survive a
+    power cut (the .ecj is the ONLY record of it until compact_ecj folds
+    the journal — same flush+fsync discipline kernel_sweep's --out uses).
+    A crash mid-append can still leave a torn tail record; read_ecj
+    ignores it, so the worst a torn append costs is the un-acked delete."""
     with open(base_file_name + ".ecj", "ab") as f:
         f.write(needle_id.to_bytes(types.NEEDLE_ID_SIZE, "big"))
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def read_ecj(base_file_name: str) -> list[int]:
@@ -1171,6 +1191,8 @@ def read_ecj(base_file_name: str) -> list[int]:
         return []
     with open(path, "rb") as f:
         buf = f.read()
+    # // drops a torn tail record (crash mid-append): every COMPLETE entry
+    # replays, the partial one is noise, never a mis-parsed needle id
     n = len(buf) // types.NEEDLE_ID_SIZE
     return [
         int.from_bytes(buf[i * 8 : i * 8 + 8], "big") for i in range(n)

@@ -257,6 +257,25 @@ def gf_project_bits(coeffs: np.ndarray, stack: np.ndarray) -> np.ndarray:
     ).reshape(r_n, -1)
 
 
+def gf_delta_parity(coeffs: np.ndarray, delta: np.ndarray) -> np.ndarray:
+    """Small-write parity maintenance, host golden path: the parity rows'
+    CHANGE when one data shard's bytes change.
+
+    With generator column c = G_parity[:, d] and delta = old ⊕ new over the
+    touched byte columns, GF(2^8) linearity gives
+
+        parity' = parity ⊕ gf_delta_parity(c, delta)
+
+    byte-exact vs re-encoding the whole stripe (the XOR-EC program-
+    optimization family in PAPERS.md builds on exactly this identity —
+    parity is linear in each data shard, so a small overwrite is a rank-1
+    update, not a re-encode). coeffs: (P,) uint8; delta: (n,) uint8 ->
+    (P, n) uint8 delta rows."""
+    coeffs = np.asarray(coeffs, dtype=np.uint8).ravel()
+    delta = np.asarray(delta, dtype=np.uint8).ravel()
+    return GF_MUL_TABLE[coeffs[:, None], delta[None, :]]
+
+
 def gf_matrix_to_bits(m: np.ndarray) -> np.ndarray:
     """Lift an (R, C) GF(2^8) matrix to its (R*8, C*8) GF(2) block matrix.
 

@@ -233,6 +233,57 @@ class Encoder:
             raise ValueError(f"want (B, {self.data_shards}, N), got {data.shape}")
         return self._apply_lazy(self.parity_matrix, data, donate=donate)
 
+    # -- delta parity maintenance (the small-write/inline-ingest seam) -------
+
+    def parity_delta(self, shard_index: int, old_block, new_block):
+        """The parity CHANGE for a single data shard's byte change:
+        (parity_shards, n) rows to XOR into the stored parity columns
+        covering the same byte range — parity' = parity ⊕ delta rows.
+
+        GF(2^8) linearity makes a small overwrite a rank-1 update instead
+        of a stripe re-encode (gf8.gf_delta_parity is the numpy golden
+        this is tested byte-exact against): the generator-matrix COLUMN
+        for `shard_index` is applied to (old ⊕ new) through the same
+        backend dispatch the bulk encode runs, so inline-ingest delta
+        updates ride whatever kernel the encode path measured fastest."""
+        if not 0 <= int(shard_index) < self.data_shards:
+            raise ValueError(
+                f"shard_index {shard_index} out of range 0..{self.data_shards - 1}"
+            )
+        old = np.asarray(old_block, dtype=np.uint8).ravel()
+        new = np.asarray(new_block, dtype=np.uint8).ravel()
+        if old.shape != new.shape:
+            raise ValueError(
+                f"old/new blocks disagree on length: {old.shape} vs {new.shape}"
+            )
+        delta = old ^ new
+        col = np.ascontiguousarray(
+            self.parity_matrix[:, int(shard_index) : int(shard_index) + 1]
+        )  # (P, 1)
+        return np.asarray(self._apply_lazy(col, delta[None, :]))
+
+    def update_parity(
+        self, parity, shard_index: int, old_block, new_block
+    ) -> np.ndarray:
+        """Delta parity update: given the stored parity columns `parity`
+        ((parity_shards, n) uint8, covering the SAME byte range as the
+        blocks), return the parity of the stripe with data shard
+        `shard_index`'s bytes changed old -> new — byte-exact vs a full
+        re-encode of the updated stripe, at O(changed bytes) instead of
+        O(stripe). The caller rewrites only the touched parity ranges."""
+        parity = np.asarray(parity, dtype=np.uint8)
+        old = np.asarray(old_block, dtype=np.uint8).ravel()
+        if parity.ndim != 2 or parity.shape[0] != self.parity_shards:
+            raise ValueError(
+                f"want ({self.parity_shards}, n) parity, got {parity.shape}"
+            )
+        if parity.shape[1] != old.size:
+            raise ValueError(
+                f"parity covers {parity.shape[1]} bytes but the block "
+                f"changes {old.size}"
+            )
+        return parity ^ self.parity_delta(shard_index, old, new_block)
+
     def _pick_survivors(self, shards: Sequence[Optional[np.ndarray]]) -> list[int]:
         present = [i for i, s in enumerate(shards) if s is not None]
         if len(present) < self.data_shards:

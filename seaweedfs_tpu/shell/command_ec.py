@@ -126,6 +126,7 @@ def _do_ec_encode(
     w: TextIO,
     large_block_size: int = 0,
     small_block_size: int = 0,
+    inline: bool = False,
 ) -> None:
     locations = _volume_locations(nodes, vid)
     if not locations:
@@ -136,7 +137,8 @@ def _do_ec_encode(
         env.vs_call(grpc_addr(loc), "VolumeMarkReadonly", {"volume_id": vid})
     try:
         _encode_spread_cutover(
-            env, nodes, locations, vid, collection, w, large_block_size, small_block_size
+            env, nodes, locations, vid, collection, w, large_block_size,
+            small_block_size, inline,
         )
     except Exception:
         for loc in locations:
@@ -156,8 +158,13 @@ def _encode_spread_cutover(
     w: TextIO,
     large_block_size: int,
     small_block_size: int,
+    inline: bool = False,
 ) -> None:
     # 2. generate all 14 shards + .ecx on the first replica holder
+    # (-inline: finalize from the server's encode-on-write stripe state —
+    # byte-identical shards, the encode already amortized into ingest;
+    # the server falls back to the warm conversion when no usable inline
+    # state exists and reports which path ran)
     source = locations[0]
     src_addr = grpc_addr(source)
     gen_req = {"volume_id": vid, "collection": collection}
@@ -165,7 +172,10 @@ def _encode_spread_cutover(
         gen_req["large_block_size"] = large_block_size
     if small_block_size:
         gen_req["small_block_size"] = small_block_size
-    env.vs_call(src_addr, "VolumeEcShardsGenerate", gen_req)
+    if inline:
+        gen_req["inline"] = True
+    gen_resp = env.vs_call(src_addr, "VolumeEcShardsGenerate", gen_req)
+    gen_mode = gen_resp.get("mode") if inline else None
     # 3. spread: balanced, rack-aware allocation; targets pull from source
     alloc = allocate_shards(nodes)
 
@@ -215,7 +225,8 @@ def _encode_spread_cutover(
     # 5. drop the original volume + replicas — cut-over complete
     for loc in locations:
         env.vs_call(grpc_addr(loc), "VolumeDelete", {"volume_id": vid})
-    w.write(f"ec.encode volume {vid}: spread {_fmt_alloc(alloc)}\n")
+    mode_note = f" ({gen_mode} encode)" if gen_mode else ""
+    w.write(f"ec.encode volume {vid}: spread {_fmt_alloc(alloc)}{mode_note}\n")
 
 
 def _fmt_alloc(alloc: dict[str, list[int]]) -> str:
@@ -232,6 +243,7 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
         force=False,
         largeBlockSize=0,
         smallBlockSize=0,
+        inline=False,  # finalize from encode-on-write state (WEEDTPU_INLINE_EC)
         checkpoint=".ec_encode.checkpoint",
     )
     env.confirm_locked()
@@ -307,6 +319,7 @@ def do_ec_encode(args: list[str], env: CommandEnv, w: TextIO) -> None:
             w,
             large_block_size=fl.largeBlockSize,
             small_block_size=fl.smallBlockSize,
+            inline=bool(fl.inline),
         )
         if ckpt is not None:
             done.add(vid)
@@ -319,9 +332,12 @@ register(
     ShellCommand(
         "ec.encode",
         "ec.encode -volumeId <id> | -collection <name> [-fullPercent 95] "
-        "[-quietFor <secs>] [-force] [-checkpoint <file>]\n"
+        "[-quietFor <secs>] [-force] [-inline] [-checkpoint <file>]\n"
         "\tencode a volume into 14 EC shards, spread them, delete the original;\n"
-        "\tbatch runs checkpoint per-volume progress and resume on rerun",
+        "\tbatch runs checkpoint per-volume progress and resume on rerun;\n"
+        "\t-inline finalizes from the server's encode-on-write stripe state\n"
+        "\t(WEEDTPU_INLINE_EC=on) instead of re-encoding the sealed .dat —\n"
+        "\tbyte-identical shards, warm fallback when no usable inline state",
         do_ec_encode,
     )
 )

@@ -301,6 +301,33 @@ DegradedReadErrors = REGISTRY.counter(
     "EcDegradedReadTimeout, HedgeMismatch)",
     ("class",),
 )
+InlineEcRows = REGISTRY.counter(
+    "weedtpu_inline_ec_rows_total",
+    "large stripe rows encoded by the inline-EC ingest path (encode "
+    "amortized into writes instead of a seal-time batch conversion)",
+)
+InlineEcBytes = REGISTRY.counter(
+    "weedtpu_inline_ec_bytes_total",
+    "volume data bytes whose parity was computed inline at ingest time",
+)
+InlineEcDeltaUpdates = REGISTRY.counter(
+    "weedtpu_inline_ec_delta_updates_total",
+    "delta parity updates applied to already-encoded inline stripe rows "
+    "(overwrites folded in as GF rank-1 updates, not re-encodes)",
+)
+InlineEcDeltaBytes = REGISTRY.counter(
+    "weedtpu_inline_ec_delta_bytes_total",
+    "bytes computed+moved by inline delta parity updates (changed bytes x "
+    "(2 data + 2x parity-shard read-modify-write) — compare against "
+    "full-stripe re-encode bytes for the <0.5x small-write gate)",
+)
+InlineEcSeals = REGISTRY.counter(
+    "weedtpu_inline_ec_seals_total",
+    "volume seals by how the shard files were produced: inline = live "
+    "stripe state finalized, resumed = journaled state recovered after a "
+    "restart then finalized, warm = full .dat re-encode fallback",
+    ("mode",),
+)
 EcBackendSelected = REGISTRY.gauge(
     "weedtpu_ec_backend_selected",
     "codec backend chosen by new_encoder (1 = currently selected; source "

@@ -77,6 +77,11 @@ class Store:
         # sorted_file binary-searches a persistent .sdx sidecar
         self.needle_map_kind = needle_map_kind
         self._lock = threading.RLock()
+        #: optional post-append hook `callback(vid)`, fired after every
+        #: acked needle write/delete (both are .dat appends) — the inline-EC
+        #: ingest manager polls its stripe builders through this seam. Must
+        #: never raise into the write path (callers install a guarded fn).
+        self.on_write: Optional[callable] = None
 
     def load(self) -> None:
         with self._lock:
@@ -139,7 +144,10 @@ class Store:
         v = self.get_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        return v.write_needle(n)
+        out = v.write_needle(n)
+        if self.on_write is not None:
+            self.on_write(vid)
+        return out
 
     def read_needle(self, vid: int, needle_id: int, cookie: Optional[int] = None) -> Needle:
         v = self.get_volume(vid)
@@ -153,7 +161,10 @@ class Store:
     def delete_needle(self, vid: int, needle_id: int) -> bool:
         v = self.get_volume(vid)
         if v is not None:
-            return v.delete_needle(needle_id)
+            found = v.delete_needle(needle_id)
+            if self.on_write is not None:
+                self.on_write(vid)  # a tombstone is a .dat append too
+            return found
         ev = self.get_ec_volume(vid)
         if ev is not None:
             return ev.delete_needle(needle_id)

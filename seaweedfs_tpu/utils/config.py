@@ -338,6 +338,46 @@ register_env(
     parse=_clamped_int(1),
 )
 register_env(
+    "WEEDTPU_INLINE_EC", str, "off",
+    "Inline-EC ingest (encode-on-write): `on` streams every volume "
+    "append through the staging-ring encode pipeline so a sealing volume "
+    "is born EC'd (stripe state accumulates per open volume, parity is "
+    "encoded incrementally per completed large row, journaled for "
+    "crash-resume); `off` (default) keeps EC a warm-storage conversion.",
+    parse=_enum("on", "off"),
+)
+register_env(
+    "WEEDTPU_INLINE_EC_SEAL_BYTES", int, 0,
+    "Auto-seal threshold for inline-EC ingest: a volume whose .dat "
+    "crosses this many bytes is sealed in place (read-only, inline "
+    "stripe finalized to .ec00-.ec13/.ecx/.eci, EC volume mounted). "
+    "0 = never auto-seal; sealing then happens only via the "
+    "VolumeEcShardsGenerate{inline:true} control RPC (ec.encode -inline).",
+    parse=_clamped_int(0),
+)
+register_env(
+    "WEEDTPU_INLINE_EC_LARGE_BLOCK", int, 1024 * 1024 * 1024,
+    "Large stripe-block size (bytes) the inline-EC ingest builders "
+    "encode with; must match the seal-time geometry or the inline state "
+    "is discarded for the warm path (clamped to >= 4096).",
+    parse=_clamped_int(4096),
+)
+register_env(
+    "WEEDTPU_INLINE_EC_SMALL_BLOCK", int, 1024 * 1024,
+    "Small (tail) stripe-block size (bytes) for inline-EC ingest — the "
+    "inline sibling of the warm encoder's small_block_size (clamped to "
+    ">= 512).",
+    parse=_clamped_int(512),
+)
+register_env(
+    "WEEDTPU_INLINE_EC_DELTA", bool, True,
+    "Delta parity updates for overwrites landing inside already-encoded "
+    "inline stripe rows: parity' = parity XOR G_col*(old XOR new) on just "
+    "the touched byte columns (GF-linearity rank-1 update). Off = an "
+    "overwrite invalidates the inline state and the seal falls back to "
+    "the warm full re-encode.",
+)
+register_env(
     "WEEDTPU_LOOKUP_RETRIES", int, 2,
     "Bounded retries (with decorrelated jitter) of the single-flight "
     "master shard-location lookup leader before it fails its waiters — "

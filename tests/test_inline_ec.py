@@ -1,0 +1,864 @@
+"""Inline-EC ingest tests: encode-on-write stripe builders born byte-
+identical to the warm `write_ec_files` conversion, GF-linear delta parity
+updates byte-exact vs full re-encode (tile-edge/odd/multi-block shapes),
+crash/resume journal semantics (torn tails, truncated partials, pending
+overwrite intents), the off/on/threshold policy at the volume-server
+level, PR-7 interop (a delta-updated stripe rebuilt via trace-repair
+projections), fsync'd .ecj appends with torn-tail tolerance, and the
+tier-1 `BENCH_MODE=ingest` smoke with its deterministic < 0.5x delta-
+bytes gate."""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import ingest, stripe
+from seaweedfs_tpu.ec.constants import (
+    DATA_SHARDS_COUNT,
+    TOTAL_SHARDS_COUNT,
+)
+from seaweedfs_tpu.ops import gf8
+from seaweedfs_tpu.ops.rs_codec import Encoder
+from seaweedfs_tpu.storage import types
+
+ENC = Encoder(10, 4, backend="numpy")
+LARGE, SMALL, BUF = 8192, 2048, 2048
+LARGE_ROW = LARGE * DATA_SHARDS_COUNT
+VID = 7
+
+
+def _write_dat(base: str, n_bytes: int, seed: int = 11) -> bytes:
+    os.makedirs(os.path.dirname(base), exist_ok=True)
+    data = np.random.default_rng(seed).integers(
+        0, 256, n_bytes, dtype=np.uint8
+    ).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+    return data
+
+
+def _warm_reference(tmp_path, data: bytes, name: str = "warm") -> str:
+    wbase = os.path.join(str(tmp_path), name, str(VID))
+    os.makedirs(os.path.dirname(wbase), exist_ok=True)
+    with open(wbase + ".dat", "wb") as f:
+        f.write(data)
+    stripe.write_ec_files(
+        wbase, large_block_size=LARGE, small_block_size=SMALL,
+        buffer_size=BUF, encoder=ENC,
+    )
+    return wbase
+
+
+def _assert_identical(base: str, wbase: str) -> None:
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            got = f.read()
+        with open(stripe.shard_file_name(wbase, s), "rb") as f:
+            assert got == f.read(), f"shard {s} differs from warm reference"
+    with open(base + ".eci", "rb") as f, open(wbase + ".eci", "rb") as g:
+        assert f.read() == g.read(), ".eci differs from warm reference"
+
+
+def _builder(base, **kw):
+    kw.setdefault("buffer_size", BUF)
+    return ingest.InlineStripeBuilder(base, ENC, LARGE, SMALL, **kw)
+
+
+def _resume(base, **kw):
+    kw.setdefault("buffer_size", BUF)
+    return ingest.InlineStripeBuilder.resume(base, ENC, LARGE, SMALL, **kw)
+
+
+# -- born-EC'd byte-identity --------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n_bytes",
+    [
+        LARGE_ROW * 3 + 12345,      # large rows + odd small tail
+        LARGE_ROW * 2,              # exact row multiple (last row is SMALL)
+        LARGE_ROW + SMALL * 3 + 1,  # one large row + partial small rows
+        SMALL * 2 + 7,              # no large rows at all
+    ],
+)
+def test_streamed_ingest_byte_identical_to_warm(tmp_path, n_bytes):
+    """Appending in bursts with a poll per burst, then sealing, yields
+    .ec00-.ec13 + .eci byte-identical to warm write_ec_files on the same
+    final .dat — across tile-edge/exact/odd/tiny layouts."""
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    os.makedirs(os.path.dirname(base))
+    data = np.random.default_rng(n_bytes).integers(
+        0, 256, n_bytes, dtype=np.uint8
+    ).tobytes()
+    b = _builder(base)
+    with open(base + ".dat", "wb") as f:
+        for off in range(0, n_bytes, 30_000):
+            f.write(data[off : off + 30_000])
+            f.flush()
+            b.poll()
+    info = b.seal()
+    assert info["rows_total"] == stripe.stripe_layout(n_bytes, LARGE, SMALL)[0]
+    _assert_identical(base, _warm_reference(tmp_path, data, f"w{n_bytes}"))
+    # journal and partials are gone after a clean seal
+    assert not os.path.exists(ingest.journal_path(base))
+    assert not any(
+        os.path.exists(ingest.part_path(base, s)) for s in range(TOTAL_SHARDS_COUNT)
+    )
+
+
+def test_partials_invisible_to_shard_discovery(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    _write_dat(base, LARGE_ROW * 2 + 99)
+    b = _builder(base)
+    b.poll()
+    assert stripe.find_local_shards(base) == []  # .inp never masquerades
+    b.abort()
+    assert not os.path.exists(ingest.journal_path(base))
+
+
+# -- delta parity updates: Encoder.update_parity vs golden --------------------
+
+
+@pytest.mark.parametrize("n", [1, 255, 256, 4096, 4097])  # tile-edge + odd
+@pytest.mark.parametrize("shard", [0, 3, 9])
+def test_update_parity_byte_exact_vs_reencode(n, shard):
+    """parity' from update_parity == parity of a full re-encode of the
+    mutated stripe, and parity_delta == the gf8 golden formulation."""
+    rng = np.random.default_rng(n * 31 + shard)
+    stack = rng.integers(0, 256, (DATA_SHARDS_COUNT, n), dtype=np.uint8)
+    parity = np.asarray(ENC.encode_parity_lazy(stack))
+    new_block = rng.integers(0, 256, n, dtype=np.uint8)
+    got = ENC.update_parity(parity, shard, stack[shard], new_block)
+    mutated = stack.copy()
+    mutated[shard] = new_block
+    want = np.asarray(ENC.encode_parity_lazy(mutated))
+    np.testing.assert_array_equal(got, want)
+    # the gf8 golden: generator column x delta
+    delta = stack[shard] ^ new_block
+    np.testing.assert_array_equal(
+        ENC.parity_delta(shard, stack[shard], new_block),
+        gf8.gf_delta_parity(ENC.parity_matrix[:, shard], delta),
+    )
+
+
+def test_update_parity_multi_block_composes():
+    """Changes to SEVERAL data shards compose by chaining single-shard
+    updates — the linearity the inline builder's segment loop relies on."""
+    rng = np.random.default_rng(77)
+    stack = rng.integers(0, 256, (DATA_SHARDS_COUNT, 1000), dtype=np.uint8)
+    parity = np.asarray(ENC.encode_parity_lazy(stack))
+    mutated = stack.copy()
+    for shard in (2, 6, 9):
+        new_block = rng.integers(0, 256, 1000, dtype=np.uint8)
+        parity = ENC.update_parity(parity, shard, mutated[shard], new_block)
+        mutated[shard] = new_block
+    np.testing.assert_array_equal(
+        parity, np.asarray(ENC.encode_parity_lazy(mutated))
+    )
+
+
+def test_update_parity_jax_backend_matches_numpy():
+    """The delta column dispatch rides the same backend seam as bulk
+    encode — the (P, 1) x (1, n) shape must survive the bit-plane lift."""
+    jax_enc = Encoder(10, 4, backend="jax")
+    rng = np.random.default_rng(13)
+    stack = rng.integers(0, 256, (DATA_SHARDS_COUNT, 777), dtype=np.uint8)
+    parity = np.asarray(ENC.encode_parity_lazy(stack))
+    new_block = rng.integers(0, 256, 777, dtype=np.uint8)
+    np.testing.assert_array_equal(
+        jax_enc.update_parity(parity, 4, stack[4], new_block),
+        ENC.update_parity(parity, 4, stack[4], new_block),
+    )
+
+
+def test_update_parity_validates_shapes():
+    parity = np.zeros((4, 10), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        ENC.update_parity(parity, 10, b"x" * 10, b"y" * 10)  # shard oob
+    with pytest.raises(ValueError):
+        ENC.update_parity(parity, 0, b"x" * 9, b"y" * 10)  # length mismatch
+    with pytest.raises(ValueError):
+        ENC.update_parity(parity, 0, b"x" * 11, b"y" * 11)  # parity span
+
+
+def test_builder_overwrite_byte_identical_to_warm(tmp_path):
+    """An overwrite spanning a data-shard block boundary inside encoded
+    rows, folded in via the journaled delta path, seals byte-identical to
+    a warm encode of the mutated .dat (CRCs recomputed)."""
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    data = _write_dat(base, LARGE_ROW * 3 + 4321)
+    b = _builder(base)
+    b.poll()
+    assert b.rows_done == 3
+    off = LARGE * 5 - 100  # crosses the shard-4/shard-5 block boundary
+    new = bytes(np.random.default_rng(1).integers(0, 256, 300, dtype=np.uint8))
+
+    def mutate():
+        with open(base + ".dat", "r+b") as f:
+            f.seek(off)
+            f.write(new)
+
+    patched = b.overwrite(off, data[off : off + 300], new, mutate=mutate)
+    assert patched == 300
+    assert b.delta_stats["updates"] == 1
+    assert b.delta_stats["changed_bytes"] == 300
+    info = b.seal()
+    assert info["delta_updates"] == 1
+    final = bytearray(data)
+    final[off : off + 300] = new
+    _assert_identical(base, _warm_reference(tmp_path, bytes(final)))
+
+
+def test_overwrite_identical_bytes_is_free(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 2)
+    b = _builder(base)
+    b.poll()
+    assert b.overwrite(100, data[100:200], data[100:200]) == 0
+    assert b.delta_stats["updates"] == 0 and b.crc_valid
+    b.abort()
+
+
+def test_overwrite_with_delta_disabled_forces_warm(tmp_path):
+    """WEEDTPU_INLINE_EC_DELTA off: a touched encoded range breaks the
+    builder (stale parity must never seal) but the mutate still runs."""
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 2 + 5)
+    b = _builder(base, delta_enabled=False)
+    b.poll()
+    ran = []
+    patched = b.overwrite(
+        0, data[:50], b"\x01" * 50, mutate=lambda: ran.append(1)
+    )
+    assert patched == 0 and ran == [1] and b.broken
+    with pytest.raises(IOError):
+        b.seal()
+    b.abort()
+
+
+# -- crash/resume journal semantics -------------------------------------------
+
+
+def test_resume_after_crash_continues_and_matches(tmp_path):
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    os.makedirs(os.path.dirname(base))
+    data = np.random.default_rng(9).integers(
+        0, 256, LARGE_ROW * 4 + 777, dtype=np.uint8
+    ).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(data[: LARGE_ROW * 2 + 7])
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    assert b.rows_done == 2
+    b._close_handles()  # crash: no seal, no abort
+    with open(base + ".dat", "ab") as f:
+        f.write(data[LARGE_ROW * 2 + 7 :])
+    r = _resume(base)
+    assert r is not None and r.resumed and r.rows_done == 2
+    r.poll()
+    assert r.rows_done == 4
+    r.seal()
+    _assert_identical(base, _warm_reference(tmp_path, data))
+
+
+def test_resume_truncates_rows_past_durable_watermark(tmp_path):
+    """Rows encoded but not yet watermarked (lazy durability) are dropped
+    on resume and re-encoded — unfsync'd bytes are never trusted."""
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 3 + 10)
+    b = _builder(base)
+    b.poll()
+    assert b.rows_done == 3 and b._durable_rows == 0
+    b._close_handles()  # crash before ANY watermark record
+    r = _resume(base)
+    assert r is not None and r.rows_done == 0  # everything re-encodes
+    r.poll()
+    assert r.rows_done == 3
+    r.seal()
+    _assert_identical(base, _warm_reference(tmp_path, data))
+
+
+def test_resume_ignores_torn_journal_tail(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 2 + 50)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    b._close_handles()
+    with open(ingest.journal_path(base), "ab") as f:
+        f.write(b'{"kind":"rows","rows"')  # crash mid-append
+    r = _resume(base)
+    assert r is not None and r.rows_done == 2
+    r.seal()
+    _assert_identical(base, _warm_reference(tmp_path, data))
+
+
+def test_resume_refuses_truncated_partial(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    _write_dat(base, LARGE_ROW * 2 + 50)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    b._close_handles()
+    with open(ingest.part_path(base, 4), "r+b") as f:
+        f.truncate(100)  # below the durable watermark: contract broken
+    assert _resume(base) is None
+
+
+def test_resume_refuses_geometry_or_codec_drift(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    _write_dat(base, LARGE_ROW * 2)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    b._close_handles()
+    assert ingest.InlineStripeBuilder.resume(
+        base, ENC, LARGE * 2, SMALL, buffer_size=BUF
+    ) is None
+    other = Encoder(10, 4, matrix_kind="cauchy", backend="numpy")
+    assert ingest.InlineStripeBuilder.resume(
+        base, other, LARGE, SMALL, buffer_size=BUF
+    ) is None
+
+
+def test_resume_refuses_compacted_dat(tmp_path):
+    """The journal pins the .dat's compact revision (superblock bytes
+    4:6): a stale journal surviving a restart must NOT resume over a
+    compacted (offset-shifted) rewrite — its rows encode deleted bytes."""
+    base = os.path.join(str(tmp_path), str(VID))
+    _write_dat(base, LARGE_ROW * 2 + 9)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    b._close_handles()
+    with open(base + ".dat", "r+b") as f:  # simulate a compaction: bump rev
+        f.seek(4)
+        f.write((99).to_bytes(2, "big"))
+    assert _resume(base) is None
+    # the replication byte (offset 1) is NOT part of the pin — the
+    # configure-replication delta path rewrites it legitimately
+    base2 = os.path.join(str(tmp_path), "v2", str(VID))
+    _write_dat(base2, LARGE_ROW * 2 + 9)
+    b2 = _builder(base2)
+    b2.poll()
+    b2._flush_watermark()
+    b2._close_handles()
+    with open(base2 + ".dat", "r+b") as f:
+        f.seek(1)
+        f.write(b"\x77")
+    assert _resume(base2) is not None
+
+
+def test_manager_discard_scrubs_disk_state(tmp_path):
+    """discard(vid, base) drops the on-disk journal and partials too —
+    compaction/volume-delete must not leave dead stripe state waiting."""
+    base = os.path.join(str(tmp_path), str(VID))
+    _write_dat(base, LARGE_ROW + 50)
+    mgr = ingest.IngestManager(
+        _FakeStore(base), large_block_size=LARGE, small_block_size=SMALL,
+    )
+    mgr.on_write(VID)
+    with mgr._lock:
+        b = mgr._builders.get(VID)
+    b.poll()
+    b._flush_watermark()
+    assert os.path.exists(ingest.journal_path(base))
+    mgr.discard(VID, base)
+    assert not os.path.exists(ingest.journal_path(base))
+    assert not any(
+        os.path.exists(ingest.part_path(base, s))
+        for s in range(TOTAL_SHARDS_COUNT)
+    )
+    # restart shape: journal on disk, empty builder dict, discard by base
+    mgr2 = ingest.IngestManager(
+        _FakeStore(base), large_block_size=LARGE, small_block_size=SMALL,
+    )
+    mgr2.on_write(VID)
+    with mgr2._lock:
+        b2 = mgr2._builders.pop(VID)
+    b2.poll()
+    b2._flush_watermark()
+    b2._close_handles()  # "restart": no in-memory builder anywhere
+    mgr3 = ingest.IngestManager(
+        _FakeStore(base), large_block_size=LARGE, small_block_size=SMALL,
+    )
+    mgr3.discard(VID, base)
+    assert not os.path.exists(ingest.journal_path(base))
+
+
+def test_overwrite_mutate_failure_breaks_builder_and_propagates(tmp_path):
+    """A mutate() that fails with encoded rows at stake may have partially
+    rewritten the .dat: the builder must mark itself broken (warm fallback
+    at seal) and the caller's error must propagate — the RPC has to fail
+    exactly like the non-inline path's would."""
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 2)
+    b = _builder(base)
+    b.poll()
+
+    def bad_mutate():
+        raise OSError("disk full")
+
+    with pytest.raises(OSError):
+        b.overwrite(0, data[:16], b"\x05" * 16, mutate=bad_mutate)
+    assert b.broken
+    with pytest.raises(IOError):
+        b.seal()
+    b.abort()
+
+
+def test_overwrite_on_closed_builder_still_mutates(tmp_path):
+    """A seal closing the builder between lookup and overwrite must not
+    swallow the caller's .dat mutation."""
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW + 20)
+    b = _builder(base)
+    b.poll()
+    b.seal()
+    ran = []
+    assert b.overwrite(0, data[:8], b"\x01" * 8, mutate=lambda: ran.append(1)) == 0
+    assert ran == [1]
+
+
+def test_resume_resolves_pending_overwrite_intent(tmp_path):
+    """Crash between the intent record and the delta application: the
+    resume compares the .dat against the recorded old/new bytes and
+    finishes exactly the unapplied segments."""
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    data = _write_dat(base, LARGE_ROW * 3 + 123)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    off = LARGE * 7 - 100  # spans two blocks -> two delta segments
+    old = data[off : off + 300]
+    new = bytes(np.random.default_rng(2).integers(0, 256, 300, dtype=np.uint8))
+    ingest._append_record(
+        b._journal,
+        {"kind": "ow", "off": off, "old": ingest._b64(old), "new": ingest._b64(new)},
+    )
+    with open(base + ".dat", "r+b") as f:
+        f.seek(off)
+        f.write(new)
+    # apply only the FIRST segment's delta before the "crash"
+    row, q = divmod(off, LARGE_ROW)
+    d, col = divmod(q, LARGE)
+    seg = min(LARGE - col, 300)
+    o_np = np.frombuffer(old, dtype=np.uint8)
+    n_np = np.frombuffer(new, dtype=np.uint8)
+    b._apply_delta(row * LARGE + col, d, o_np[:seg], n_np[:seg])
+    b._close_handles()
+    r = _resume(base)
+    assert r is not None
+    r.seal()
+    final = bytearray(data)
+    final[off : off + 300] = new
+    _assert_identical(base, _warm_reference(tmp_path, bytes(final)))
+
+
+def test_resume_refuses_unknown_dat_mutation(tmp_path):
+    """A pending intent whose range matches NEITHER old nor new bytes
+    means someone else mutated the .dat — not recoverable, warm fallback."""
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 2)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()
+    ingest._append_record(
+        b._journal,
+        {
+            "kind": "ow",
+            "off": 0,
+            "old": ingest._b64(data[:50]),
+            "new": ingest._b64(b"\x01" * 50),
+        },
+    )
+    with open(base + ".dat", "r+b") as f:
+        f.write(b"\x02" * 50)  # a third state
+    b._close_handles()
+    assert _resume(base) is None
+
+
+# -- IngestManager + seal fallback --------------------------------------------
+
+
+class _FakeVol:
+    def __init__(self, base):
+        self.base_path = base
+        self.dat_path = base + ".dat"
+        self.read_only = False
+        self.tiered = False
+
+
+class _FakeStore:
+    def __init__(self, base, encoder=ENC):
+        self.encoder = encoder
+        self._vol = _FakeVol(base)
+
+    def get_volume(self, vid):
+        return self._vol
+
+
+def test_manager_seal_inline_then_warm_fallback(tmp_path):
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    data = _write_dat(base, LARGE_ROW * 2 + 999)
+    mgr = ingest.IngestManager(
+        _FakeStore(base), large_block_size=LARGE, small_block_size=SMALL,
+        seal_bytes=0,
+    )
+    mgr.on_write(VID)
+    info = mgr.seal_volume(VID, base)
+    assert info["mode"] == "inline" and info["rows_inline"] == 2
+    _assert_identical(base, _warm_reference(tmp_path, data))
+    # second volume: corrupt journal -> resume fails -> warm fallback
+    base2 = os.path.join(str(tmp_path), "v2", str(VID))
+    data2 = _write_dat(base2, LARGE_ROW + 100, seed=3)
+    mgr2 = ingest.IngestManager(
+        _FakeStore(base2), large_block_size=LARGE, small_block_size=SMALL,
+        seal_bytes=0,
+    )
+    mgr2.on_write(VID)
+    with mgr2._lock:
+        b = mgr2._builders.pop(VID)
+    b.poll()  # deterministic: the worker may not have run yet
+    b._flush_watermark()
+    b._close_handles()
+    with open(ingest.journal_path(base2), "r+b") as f:
+        f.truncate(3)  # unreadable head: un-vouchable state
+    info2 = mgr2.seal_volume(VID, base2)
+    assert info2["mode"] == "warm"
+    _assert_identical(base2, _warm_reference(tmp_path, data2, "w2"))
+    # the fallback cleaned the leftovers
+    assert not os.path.exists(ingest.journal_path(base2))
+
+
+def test_manager_seal_resumed_after_crash(tmp_path):
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    data = _write_dat(base, LARGE_ROW * 2 + 11)
+    mgr = ingest.IngestManager(
+        _FakeStore(base), large_block_size=LARGE, small_block_size=SMALL,
+    )
+    mgr.on_write(VID)
+    with mgr._lock:
+        b = mgr._builders.pop(VID)
+    b.poll()  # deterministic: the worker may not have run yet
+    b._flush_watermark()
+    b._close_handles()  # crash; a NEW manager (fresh process) seals
+    mgr2 = ingest.IngestManager(
+        _FakeStore(base), large_block_size=LARGE, small_block_size=SMALL,
+    )
+    info = mgr2.seal_volume(VID, base)
+    assert info["mode"] == "resumed"
+    _assert_identical(base, _warm_reference(tmp_path, data))
+
+
+# -- policy off/on/threshold at the volume-server level -----------------------
+
+
+def _wait_for(cond, timeout=25.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_server_policy_off_by_default(tmp_path):
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    vs = VolumeServer([str(tmp_path)], master.address, heartbeat_interval=0.5)
+    vs.start()
+    try:
+        assert vs._ingest is None
+        assert vs.store.on_write is None
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_server_threshold_auto_seal_and_inline_generate(tmp_path, monkeypatch):
+    """WEEDTPU_INLINE_EC=on + a seal threshold: PUTs stream through the
+    builders, the volume crossing the threshold is sealed in place
+    (read-only, shards byte-identical to warm, EC volume mounted), reads
+    keep verifying, and the explicit inline-generate RPC serves a second
+    volume from its live stripe state."""
+    from seaweedfs_tpu import rpc
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    monkeypatch.setenv("WEEDTPU_INLINE_EC", "on")
+    monkeypatch.setenv("WEEDTPU_INLINE_EC_LARGE_BLOCK", str(LARGE))
+    monkeypatch.setenv("WEEDTPU_INLINE_EC_SMALL_BLOCK", str(SMALL))
+    seal_at = LARGE_ROW * 2 + 5000
+    monkeypatch.setenv("WEEDTPU_INLINE_EC_SEAL_BYTES", str(seal_at))
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    vdir = os.path.join(str(tmp_path), "v")
+    os.makedirs(vdir)
+    vs = VolumeServer([vdir], master.address, heartbeat_interval=0.4)
+    vs.start()
+    client = MasterClient(master.address)
+    rng = np.random.default_rng(21)
+    try:
+        _wait_for(lambda: master.topology.nodes, msg="cluster form-up")
+        blobs = {}
+        for _ in range(40):
+            payload = rng.integers(0, 256, 6000, dtype=np.uint8).tobytes()
+            for _attempt in range(5):
+                a = client.assign()
+                try:
+                    client.upload(a.fid, payload)
+                    blobs[a.fid] = payload
+                    break
+                except Exception:  # noqa: BLE001 — sealing race: re-assign
+                    time.sleep(0.3)
+        vid = int(next(iter(blobs)).split(",")[0])
+        base = vs._base_path_for(vid)
+        _wait_for(
+            lambda: stripe.find_local_shards(base) == list(range(TOTAL_SHARDS_COUNT)),
+            msg="auto-seal",
+        )
+        with rpc.RpcClient(vs.grpc_address) as c:
+            st = c.call(VOLUME_SERVICE, "VolumeStatus", {"volume_id": vid})
+        assert st["kind"] == "normal" and st["read_only"]
+        # byte-identity vs warm on the same sealed bytes
+        wdir = os.path.join(str(tmp_path), "warm")
+        os.makedirs(wdir)
+        wbase = os.path.join(wdir, str(vid))
+        shutil.copy(base + ".dat", wbase + ".dat")
+        shutil.copy(base + ".idx", wbase + ".idx")
+        stripe.write_ec_files(
+            wbase, large_block_size=LARGE, small_block_size=SMALL,
+            encoder=vs.store.encoder,
+        )
+        stripe.write_sorted_file_from_idx(wbase)
+        for s in range(TOTAL_SHARDS_COUNT):
+            with open(stripe.shard_file_name(base, s), "rb") as f:
+                got = f.read()
+            with open(stripe.shard_file_name(wbase, s), "rb") as f:
+                assert got == f.read(), f"shard {s} differs"
+        with open(base + ".ecx", "rb") as f, open(wbase + ".ecx", "rb") as g:
+            assert f.read() == g.read()
+        for fid, want in blobs.items():
+            assert client.read(fid) == want
+        # explicit inline generate on a later (unsealed) volume
+        vid2 = max(
+            int(fid.split(",")[0]) for fid in blobs
+        )
+        if vid2 != vid:
+            with rpc.RpcClient(vs.grpc_address) as c:
+                c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid2})
+                resp = c.call(
+                    VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                    {"volume_id": vid2, "inline": True}, timeout=120,
+                )
+            assert resp["mode"] in ("inline", "resumed"), resp
+            assert resp["shard_ids"] == list(range(TOTAL_SHARDS_COUNT))
+    finally:
+        client.close()
+        vs.stop()
+        master.stop()
+
+
+def test_server_inline_generate_mismatched_geometry_goes_warm(tmp_path, monkeypatch):
+    """An inline request whose explicit block sizes disagree with the
+    builders' geometry must warm-encode with the REQUESTED sizes."""
+    from seaweedfs_tpu import rpc
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    monkeypatch.setenv("WEEDTPU_INLINE_EC", "on")
+    monkeypatch.setenv("WEEDTPU_INLINE_EC_LARGE_BLOCK", str(LARGE))
+    monkeypatch.setenv("WEEDTPU_INLINE_EC_SMALL_BLOCK", str(SMALL))
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    vdir = os.path.join(str(tmp_path), "v")
+    os.makedirs(vdir)
+    vs = VolumeServer([vdir], master.address, heartbeat_interval=0.4)
+    vs.start()
+    client = MasterClient(master.address)
+    rng = np.random.default_rng(4)
+    try:
+        _wait_for(lambda: master.topology.nodes, msg="cluster form-up")
+        a = client.assign()
+        client.upload(a.fid, rng.integers(0, 256, 9000, dtype=np.uint8).tobytes())
+        vid = int(a.fid.split(",")[0])
+        with rpc.RpcClient(vs.grpc_address) as c:
+            c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+            resp = c.call(
+                VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                {
+                    "volume_id": vid,
+                    "inline": True,
+                    "large_block_size": LARGE * 2,  # mismatched geometry
+                    "small_block_size": SMALL,
+                },
+                timeout=120,
+            )
+        assert resp["mode"] == "warm", resp
+        base = vs._base_path_for(vid)
+        info = stripe.read_ec_info(base)
+        assert info["large_block_size"] == LARGE * 2
+    finally:
+        client.close()
+        vs.stop()
+        master.stop()
+
+
+# -- PR-7 interop: delta-updated stripe rebuilt via trace projections ---------
+
+
+def test_delta_updated_shard_rebuilds_via_trace_repair(tmp_path):
+    """A stripe sealed from inline state WITH a delta update rebuilds a
+    lost shard via the trace-repair projection pipeline byte-identically
+    — the two GF-linearity exploits (rank-1 parity update, projection
+    XOR-combine) agree on the same bytes."""
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    data = _write_dat(base, LARGE_ROW * 3 + 2222)
+    b = _builder(base)
+    b.poll()
+    off = LARGE * 12 + 31  # row 1, shard 2
+    new = bytes(np.random.default_rng(8).integers(0, 256, 400, dtype=np.uint8))
+
+    def mutate():
+        with open(base + ".dat", "r+b") as f:
+            f.seek(off)
+            f.write(new)
+
+    assert b.overwrite(off, data[off : off + 400], new, mutate=mutate) == 400
+    b.seal()
+    golden = {}
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            golden[s] = f.read()
+    missing = [2]  # the delta-touched data shard itself
+    os.unlink(stripe.shard_file_name(base, 2))
+    shard_size = len(golden[0])
+    survivors = sorted(stripe.find_local_shards(base))[:DATA_SHARDS_COUNT]
+    plan = ENC.repair_projection_plan(survivors, missing)
+    groups = [
+        stripe.LocalProjectionSource(
+            [stripe.shard_file_name(base, s) for s in survivors[:5]],
+            np.stack([plan[s] for s in survivors[:5]], axis=1),
+            ENC,
+        ),
+        stripe.LocalProjectionSource(
+            [stripe.shard_file_name(base, s) for s in survivors[5:]],
+            np.stack([plan[s] for s in survivors[5:]], axis=1),
+            ENC,
+        ),
+    ]
+    try:
+        rebuilt = stripe.rebuild_ec_files_from_projections(
+            base, groups, shard_size, missing, encoder=ENC,
+            buffer_size=16384, max_batch_bytes=10 * 3 * 16384,
+        )
+    finally:
+        for g in groups:
+            g.close()
+    assert rebuilt == missing
+    with open(stripe.shard_file_name(base, 2), "rb") as f:
+        assert f.read() == golden[2]
+
+
+# -- .ecj fsync + torn-tail tolerance -----------------------------------------
+
+
+def test_append_ecj_survives_torn_tail(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    stripe.append_ecj(base, 101)
+    stripe.append_ecj(base, 202)
+    with open(base + ".ecj", "ab") as f:
+        f.write(b"\x00\x01\x02")  # torn tail: crash mid-append
+    assert stripe.read_ecj(base) == [101, 202]
+    # appending after the torn tail still replays the COMPLETE records
+    # (the torn fragment corrupts alignment only past itself — compact
+    # folds the journal long before that matters, but the reader must
+    # not crash)
+    assert len(stripe.read_ecj(base)) == 2
+
+
+def test_journal_reader_ignores_torn_tail(tmp_path):
+    base = os.path.join(str(tmp_path), str(VID))
+    with open(ingest.journal_path(base), "wb") as f:
+        f.write(b'{"kind":"begin","version":1}\n{"kind":"rows","rows":2}\n')
+        f.write(b'{"kind":"rows","ro')  # torn
+    recs = ingest.read_journal(base)
+    assert [r["kind"] for r in recs] == ["begin", "rows"]
+
+
+# -- stats + registry ---------------------------------------------------------
+
+
+def test_inline_counters_move(tmp_path):
+    from seaweedfs_tpu import stats
+
+    rows0 = stats.InlineEcRows.value
+    deltas0 = stats.InlineEcDeltaUpdates.value
+    base = os.path.join(str(tmp_path), str(VID))
+    data = _write_dat(base, LARGE_ROW * 2 + 10)
+    b = _builder(base)
+    b.poll()
+    assert stats.InlineEcRows.value == rows0 + 2
+    new = bytes(np.random.default_rng(5).integers(0, 256, 64, dtype=np.uint8))
+
+    def mutate():
+        with open(base + ".dat", "r+b") as f:
+            f.seek(0)
+            f.write(new)
+
+    b.overwrite(0, data[:64], new, mutate=mutate)
+    assert stats.InlineEcDeltaUpdates.value == deltas0 + 1
+    b.abort()
+
+
+def test_inline_env_knobs_registered():
+    from seaweedfs_tpu.utils import config
+
+    for name in (
+        "WEEDTPU_INLINE_EC",
+        "WEEDTPU_INLINE_EC_SEAL_BYTES",
+        "WEEDTPU_INLINE_EC_DELTA",
+        "WEEDTPU_INLINE_EC_LARGE_BLOCK",
+        "WEEDTPU_INLINE_EC_SMALL_BLOCK",
+    ):
+        assert name in config.ENV_REGISTRY
+    assert config.env("WEEDTPU_INLINE_EC") in ("on", "off")
+
+
+# -- tier-1 bench smoke: the deterministic delta-bytes gate -------------------
+
+
+def test_bench_ingest_smoke(tmp_path):
+    """Fast CPU smoke of bench.py's ec_ingest harness: inline output must
+    match warm byte-for-byte and the delta path's BYTE accounting (not a
+    timing) must meet the < 0.5x gate for a ~1% overwrite mix."""
+    import bench
+
+    out = bench._measure_ingest(
+        str(tmp_path),
+        dat_bytes=1 << 20,
+        large=16384,
+        small=4096,
+        buffer_size=4096,
+        append_chunk=96 << 10,
+        overwrite_count=4,
+        encoder=ENC,
+    )
+    assert out["ok"], out
+    assert out["match"] and out["delta"]["match"]
+    assert out["inline"]["rows_inline"] == out["inline"]["rows_total"] > 0
+    assert out["delta"]["bytes_ratio"] < 0.5, out["delta"]
+    assert out["delta"]["overwrite_fraction"] <= 0.011

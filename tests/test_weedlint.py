@@ -104,9 +104,17 @@ def test_wire_drift_fixture_fires():
     assert "extra" in msgs, findings
     # the singular typo of the repeated-projection shape fires too
     assert "projection_row" in msgs, findings
-    # the legitimate reads stay clean: req["volume_id"] (line 12) and the
-    # extended slab-read shape's projection/projection_rows (lines 17-18)
-    assert not any(f.line in (12, 17, 18) for f in drift), drift
+    # the inline-encode shapes: the mode-switch typo and the response-key
+    # drift both fire
+    assert "inlined" in msgs, findings
+    assert "rows_inline" in msgs, findings
+    # the legitimate reads stay clean: req["volume_id"] (line 12), the
+    # extended slab-read shape's projection/projection_rows (lines 17-18),
+    # and the inline mode-switch read req.get("inline") (line 31) — and
+    # the good "mode" response key on line 33 is flagged only for its BAD
+    # sibling key, never for itself
+    assert not any(f.line in (12, 17, 18, 31) for f in drift), drift
+    assert "returns key 'mode'" not in msgs, drift
 
 
 def test_parse_proto_oneof_fields_belong_to_message():
@@ -125,6 +133,13 @@ def test_parse_proto_oneof_fields_belong_to_message():
         "volume_id", "projection", "projection_rows"
     }
     assert messages["ProjTerm"] == {"shard_id", "coeffs"}
+    # the inline-encode fixture shapes parse too
+    assert messages["GenThingRequest"] == {
+        "volume_id", "large_block_size", "inline"
+    }
+    assert messages["GenThingResponse"] == {
+        "shard_ids", "mode", "inline_rows", "delta_updates"
+    }
 
 
 # -- suppression semantics ----------------------------------------------------

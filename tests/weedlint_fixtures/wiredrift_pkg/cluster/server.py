@@ -18,3 +18,17 @@ class Server:
         rows = req["projection_rows"]  # fine
         bad = req["projection_row"]  # BAD: singular typo of the field
         yield bytes(rows or 0) + bytes(len(terms or ())) + bytes(bool(bad))
+
+
+class GenServer:
+    """Inline-encode-shaped drift: the handler reads a mode-switch field
+    that exists, one that does not, and returns one good + one bad key."""
+
+    def _build(self, svc):
+        svc.add("GenerateThing", self._rpc_generate_thing)
+
+    def _rpc_generate_thing(self, req, ctx):
+        inline = req.get("inline")  # fine: in GenThingRequest
+        bad = req["inlined"]  # BAD: typo of the mode-switch field
+        return {"mode": "warm" if not inline else "inline", "rows_inline": bad}
+        # "rows_inline" BAD: the response field is inline_rows
