@@ -14,6 +14,14 @@ passing it at a donated position is use-after-free that happens to work
 on CPU and corrupts on device. The checker tracks names bound to
 donated jits file-locally and flags any later read of a donated
 argument in the same function unless it is re-bound first.
+
+Both rules see THROUGH `shard_map` wrappers (the mesh backend's shape:
+`jax.jit(shard_map(f, ...), donate_argnums=...)` bindings and
+`@functools.partial(jax.jit, donate_argnums=...)` stacked over
+`@functools.partial(shard_map, ...)` defs): the mapped body is traced
+exactly like a jitted one, so host work inside it is flagged, and a
+name passed at a donated position of the wrapped callable follows the
+same dead-until-rebound rule.
 """
 
 from __future__ import annotations
@@ -49,21 +57,58 @@ def _is_jit_ref(node: ast.AST) -> bool:
     )
 
 
+def _is_shard_map_ref(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "shard_map") or (
+        isinstance(node, ast.Name) and node.id == "shard_map"
+    )
+
+
+def _is_shard_map_call(node: ast.AST) -> bool:
+    """`shard_map(f, ...)` / `functools.partial(shard_map, mesh=...)` —
+    the mapped body is traced like a jitted one, so both checkers must
+    see through the wrapper."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if _is_shard_map_ref(f):
+        return True
+    if isinstance(f, ast.Attribute) and f.attr == "partial" or (
+        isinstance(f, ast.Name) and f.id == "partial"
+    ):
+        return bool(node.args) and _is_shard_map_ref(node.args[0])
+    return False
+
+
 def _jitted_function_defs(tree: ast.AST) -> list[ast.FunctionDef]:
-    """Defs that run under jit: decorated with jit/partial(jit), or passed
-    to a `jax.jit(f, ...)` call anywhere in the file (by name)."""
+    """Defs that run traced: decorated with jit/partial(jit) or
+    shard_map/partial(shard_map), or passed by name to a `jax.jit(f, ...)`
+    or `shard_map(f, ...)` call anywhere in the file."""
     jitted_names: set[str] = set()
     for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_jit_call(node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_call(node) or _is_shard_map_call(node):
             args = node.args
-            if args and isinstance(args[0], ast.Name):
+            # first positional arg is the wrapped callable — but in the
+            # partial(jit/shard_map, ...) spelling it is the wrapper
+            # itself, not a user function
+            if (
+                args
+                and isinstance(args[0], ast.Name)
+                and not _is_jit_ref(args[0])
+                and not _is_shard_map_ref(args[0])
+            ):
                 jitted_names.add(args[0].id)
     out = []
     for node in ast.walk(tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if node.name in jitted_names or any(
-            _is_jit_call(d) or _is_jit_ref(d) for d in node.decorator_list
+            _is_jit_call(d)
+            or _is_jit_ref(d)
+            or _is_shard_map_call(d)
+            or _is_shard_map_ref(d)
+            for d in node.decorator_list
         ):
             out.append(node)
     return out
@@ -122,19 +167,27 @@ def _donated_positions(call: ast.Call) -> Optional[list[int]]:
 
 def _donating_names(tree: ast.AST) -> dict[str, list[int]]:
     """name -> donated positions, for `g = jax.jit(f, donate_argnums=...)`
-    bindings anywhere in the file (module or function scope)."""
+    bindings anywhere in the file (module or function scope; `f` may be a
+    `shard_map(...)` wrapper — the binding is what donates), and for defs
+    decorated with a donating jit (`@functools.partial(jax.jit,
+    donate_argnums=...)`, typically stacked over a shard_map partial)."""
     out: dict[str, list[int]] = {}
     for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not (isinstance(node.value, ast.Call) and _is_jit_call(node.value)):
-            continue
-        pos = _donated_positions(node.value)
-        if not pos:
-            continue
-        for t in node.targets:
-            if isinstance(t, ast.Name):
-                out[t.id] = pos
+        if isinstance(node, ast.Assign):
+            if not (isinstance(node.value, ast.Call) and _is_jit_call(node.value)):
+                continue
+            pos = _donated_positions(node.value)
+            if not pos:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if isinstance(d, ast.Call) and _is_jit_call(d):
+                    pos = _donated_positions(d)
+                    if pos:
+                        out[node.name] = pos
     return out
 
 

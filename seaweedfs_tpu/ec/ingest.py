@@ -126,6 +126,32 @@ def _b64(b) -> str:
     return base64.b64encode(bytes(b)).decode()
 
 
+def _fsync_all(handles) -> None:
+    """flush + fsync a set of shard handles CONCURRENTLY: a watermark
+    flush syncs all 14 partials, and on latency-bound storage serial
+    fsync pays 14 round-trips where parallel pays ~one. Ordering is
+    unchanged — every fsync still completes before the caller journals
+    the watermark record."""
+    handles = list(handles)
+    if not handles:
+        return
+    if len(handles) == 1:
+        handles[0].flush()
+        os.fsync(handles[0].fileno())
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    def sync(h):
+        h.flush()
+        os.fsync(h.fileno())
+
+    with ThreadPoolExecutor(
+        max_workers=min(8, len(handles)), thread_name_prefix="inline-ec-fsync"
+    ) as ex:
+        for fut in [ex.submit(sync, h) for h in handles]:
+            fut.result()
+
+
 def _dat_revision(base_file_name: str) -> Optional[int]:
     """The volume superblock's compact_revision (bytes 4:6 of the .dat),
     or None when unreadable. Compaction bumps it while rewriting every
@@ -194,8 +220,27 @@ class InlineStripeBuilder:
         self.resumed = _resume
         self.delta_stats = {"updates": 0, "changed_bytes": 0, "accounted_bytes": 0}
         self._lock = threading.RLock()
+        #: serializes journal appends across the poll/overwrite threads and
+        #: the async watermark flusher (lock order: _lock before
+        #: _journal_lock, everywhere)
+        self._journal_lock = threading.Lock()
         self._parts: list = []
         self._journal = None
+        #: per-poll overhead killers (ROADMAP inline-EC follow-up 1): the
+        #: staging ring persists ACROSS polls (stripe._encode_rows reuses
+        #: it via ring_cache instead of re-allocating fresh buffers whose
+        #: first touch page-faults every poll), the .dat read handle
+        #: stays open for the builder's life (the file is append-only;
+        #: compaction discards the whole builder), and watermark fsyncs
+        #: run on a flusher thread so durability batching never stalls
+        #: the encode lane
+        self._ring_cache: dict = {}
+        self._dat = None
+        self._flusher = None  # lazy single-worker executor
+        #: rows already handed to the flusher — the threshold check must
+        #: not re-submit a job per poll while one is still fsyncing (each
+        #: stale job would re-fsync all 14 partials before noticing)
+        self._flush_submitted_rows = 0
         if not _resume:
             try:
                 self._parts = [
@@ -204,7 +249,7 @@ class InlineStripeBuilder:
                 ]
                 # weedlint: ignore[open-no-ctx] builder-lifetime journal handle, closed in abort()/seal()
                 self._journal = open(journal_path(base_file_name), "wb")
-                _append_record(self._journal, self._begin_record())
+                self._journal_append(self._begin_record())
             except BaseException:
                 self._close_handles()
                 raise
@@ -269,6 +314,16 @@ class InlineStripeBuilder:
                 raise
             return n_new
 
+    def _dat_handle(self):
+        """The builder-lifetime .dat read handle: the file is append-only
+        for the builder's life (compaction/delete discard the builder),
+        so one open amortizes over every poll instead of paying an
+        open/close per poll."""
+        if self._dat is None:
+            # weedlint: ignore[open-no-ctx] builder-lifetime read handle, closed in abort()/seal()
+            self._dat = open(self.base + ".dat", "rb")
+        return self._dat
+
     def _encode_large(self, n_rows: int) -> None:
         """Encode `n_rows` large rows starting at the progress cursor.
         Durability is batched: shard bytes are fsync'd BEFORE their
@@ -276,29 +331,35 @@ class InlineStripeBuilder:
         partials back to the last durable watermark), but the flush
         itself fires only per `_durable_batch` bytes — a crash costs
         re-encoding the undurable tail, never trusting unfsync'd bytes."""
-        with open(self.base + ".dat", "rb") as f:
-            for h in self._parts:
-                h.seek(self.rows_done * self.large)
-            stripe._encode_rows(
-                f,
-                self._enc,
-                self._parts,
-                self.rows_done * self._large_row,
-                self.large,
-                n_rows,
-                self._buffer,
-                # right-size the staging ring to the work actually available:
-                # an ingest poll usually encodes ONE row, and allocating the
-                # warm path's full batch budget per poll would dominate the
-                # amortized cost with dead buffer churn
-                min(self._max_batch, max(self._buffer * DATA_SHARDS_COUNT,
-                                         n_rows * self._large_row)),
-                self._depth,
-                self.crcs,
-            )
+        f = self._dat_handle()
+        for h in self._parts:
+            h.seek(self.rows_done * self.large)
+        stripe._encode_rows(
+            f,
+            self._enc,
+            self._parts,
+            self.rows_done * self._large_row,
+            self.large,
+            n_rows,
+            self._buffer,
+            # right-size the staging ring to the work actually available:
+            # an ingest poll usually encodes ONE row (so steady-state polls
+            # hit the SAME cached ring geometry every time), and allocating
+            # the warm path's full batch budget per poll would dominate the
+            # amortized cost with dead buffer churn
+            min(self._max_batch, max(self._buffer * DATA_SHARDS_COUNT,
+                                     n_rows * self._large_row)),
+            self._depth,
+            self.crcs,
+            ring_cache=self._ring_cache,
+        )
         self.rows_done += n_rows
-        if (self.rows_done - self._durable_rows) * self._large_row >= self._durable_batch:
-            self._flush_watermark()
+        undurable = self.rows_done - max(self._durable_rows, self._flush_submitted_rows)
+        if undurable * self._large_row >= self._durable_batch:
+            # async: the encode lane keeps rolling while the flusher
+            # thread makes the batch durable (fsync-before-record
+            # ordering preserved inside the job)
+            self._flush_watermark(wait=False)
         try:
             from seaweedfs_tpu import stats
 
@@ -307,23 +368,60 @@ class InlineStripeBuilder:
         except Exception:  # noqa: BLE001 — metrics must never break ingest
             pass
 
-    def _flush_watermark(self) -> None:
+    def _journal_append(self, record: dict) -> None:
+        with self._journal_lock:
+            _append_record(self._journal, record)
+
+    def _flush_watermark(self, wait: bool = True) -> None:
         """fsync every partial, THEN journal the watermark: a durable
-        `rows` record always describes bytes that are already on disk."""
+        `rows` record always describes bytes that are already on disk.
+
+        wait=False hands the whole job (fsync + record) to the builder's
+        flusher thread — the poll path's durability batching then
+        overlaps the next rows' encode instead of stalling it. The
+        ordering contract is unchanged: the job fsyncs before it
+        journals, and a job whose snapshot fell behind a newer durable
+        watermark (a later sync flush won the race) appends nothing."""
         if self._durable_rows == self.rows_done:
             return
-        for h in self._parts:
-            h.flush()
-            os.fsync(h.fileno())
-        _append_record(
-            self._journal,
-            {
-                "kind": "rows",
-                "rows": self.rows_done,
-                "crcs": [int(c) for c in self.crcs] if self.crc_valid else None,
-            },
-        )
-        self._durable_rows = self.rows_done
+        rows = self.rows_done
+        crcs = [int(c) for c in self.crcs] if self.crc_valid else None
+        if wait:
+            _fsync_all(self._parts)
+            self._journal_append({"kind": "rows", "rows": rows, "crcs": crcs})
+            self._durable_rows = rows
+            self._flush_submitted_rows = max(self._flush_submitted_rows, rows)
+            return
+        if self._flusher is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._flusher = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="inline-ec-flush"
+            )
+        self._flush_submitted_rows = rows
+        self._flusher.submit(self._flush_job, list(self._parts), rows, crcs)
+
+    def _flush_job(self, parts: list, rows: int, crcs) -> None:
+        """One async watermark: fsync the snapshot's handles (outside the
+        builder lock — encodes keep rolling), then journal the record iff
+        the builder is still live and this watermark is still the newest.
+        A seal/abort racing the fsync just makes it a no-op: their own
+        fsync covers the bytes, and closed handles raise harmlessly."""
+        try:
+            _fsync_all(parts)
+        except Exception:  # noqa: BLE001 — closed mid-seal/abort: skip
+            return
+        with self._lock:
+            if self.closed or self.broken or self._journal is None:
+                return
+            if rows <= self._durable_rows:
+                return  # a newer sync flush already covered these rows
+            try:
+                self._journal_append({"kind": "rows", "rows": rows, "crcs": crcs})
+            except Exception:  # noqa: BLE001 — a missed watermark only
+                # costs resume re-encoding from the previous one
+                return
+            self._durable_rows = rows
 
     # -- delta parity updates -------------------------------------------------
 
@@ -390,8 +488,7 @@ class InlineStripeBuilder:
                 # deltas must land ABOVE a durable watermark: resume replays
                 # them against rows it can actually truncate back to
                 self._flush_watermark()
-                _append_record(
-                    self._journal,
+                self._journal_append(
                     {"kind": "ow", "off": int(offset), "old": _b64(old_b), "new": _b64(new_b)},
                 )
             except BaseException:
@@ -408,7 +505,7 @@ class InlineStripeBuilder:
                     np.frombuffer(old_b, dtype=np.uint8),
                     np.frombuffer(new_b, dtype=np.uint8),
                 )
-                _append_record(self._journal, {"kind": "ow-done"})
+                self._journal_append({"kind": "ow-done"})
             except BaseException:  # noqa: BLE001 — the mutation LANDED and
                 # the intent record preserves it; a failed delta just means
                 # this builder can no longer vouch for parity (warm
@@ -482,8 +579,7 @@ class InlineStripeBuilder:
             writes[DATA_SHARDS_COUNT + pi] = (
                 np.frombuffer(cur, dtype=np.uint8) ^ dp[pi]
             ).tobytes()
-        _append_record(
-            self._journal,
+        self._journal_append(
             {
                 "kind": "delta",
                 "pos": int(pos),
@@ -521,26 +617,26 @@ class InlineStripeBuilder:
                 if n_large > self.rows_done:
                     self._encode_large(n_large - self.rows_done)
                 if n_small:
-                    with open(self.base + ".dat", "rb") as f:
-                        for h in self._parts:
-                            h.seek(0, os.SEEK_END)
-                        stripe._encode_rows(
-                            f,
-                            self._enc,
-                            self._parts,
-                            n_large * self._large_row,
-                            self.small,
-                            n_small,
-                            min(self._buffer, self.small),
-                            self._max_batch,
-                            self._depth,
-                            self.crcs,
-                        )
+                    f = self._dat_handle()
+                    for h in self._parts:
+                        h.seek(0, os.SEEK_END)
+                    stripe._encode_rows(
+                        f,
+                        self._enc,
+                        self._parts,
+                        n_large * self._large_row,
+                        self.small,
+                        n_small,
+                        min(self._buffer, self.small),
+                        self._max_batch,
+                        self._depth,
+                        self.crcs,
+                        ring_cache=self._ring_cache,
+                    )
                 if not self.crc_valid:
                     self._recompute_crcs()
+                _fsync_all(self._parts)
                 for h in self._parts:
-                    h.flush()
-                    os.fsync(h.fileno())
                     h.close()
                 self._parts = []
                 for s in range(TOTAL_SHARDS_COUNT):
@@ -556,6 +652,13 @@ class InlineStripeBuilder:
             if self._journal is not None:
                 self._journal.close()
                 self._journal = None
+            if self._dat is not None:
+                self._dat.close()
+                self._dat = None
+            if self._flusher is not None:
+                self._flusher.shutdown(wait=False)
+                self._flusher = None
+            self._ring_cache.clear()
             try:
                 os.unlink(journal_path(self.base))
             except OSError:
@@ -601,6 +704,16 @@ class InlineStripeBuilder:
             except OSError:
                 pass
             self._journal = None
+        if self._dat is not None:
+            try:
+                self._dat.close()
+            except OSError:
+                pass
+            self._dat = None
+        if self._flusher is not None:
+            self._flusher.shutdown(wait=False)
+            self._flusher = None
+        self._ring_cache.clear()
 
     def abort(self) -> None:
         """Drop the in-progress state: close handles, unlink partials and
@@ -731,10 +844,8 @@ class InlineStripeBuilder:
                 if not b._resolve_pending(pending, pending_deltas):
                     b._close_handles()
                     return None
-                _append_record(b._journal, {"kind": "ow-done"})
-            for h in b._parts:
-                h.flush()
-                os.fsync(h.fileno())
+                b._journal_append({"kind": "ow-done"})
+            _fsync_all(b._parts)
         except BaseException:
             b._close_handles()
             raise

@@ -121,6 +121,30 @@ class _StagingRing:
         return buf
 
 
+def _ring_for(cache: Optional[dict], slots: int, shape: tuple) -> _StagingRing:
+    """A staging ring of the requested geometry, reused across calls when
+    the caller supplies a cache dict (the inline-ingest poll path: one
+    persistent ring per builder instead of fresh page-faulted buffers per
+    poll). The cache is bounded — geometry churn (a seal's bigger batch
+    after steady one-row polls) evicts the oldest entry."""
+    if cache is None:
+        return _StagingRing(slots, shape)
+    key = (slots, shape)
+    ring = cache.get(key)
+    if ring is None:
+        while len(cache) >= 2:
+            cache.pop(next(iter(cache)))
+        ring = cache[key] = _StagingRing(slots, shape)
+    return ring
+
+
+def _aligned(width: int, align: int) -> int:
+    """Round a staged width up to the encoder's dispatch alignment (the
+    mesh backend shards columns over dp*sp devices; single-device
+    backends align to 1 and this is the identity)."""
+    return -(-width // align) * align
+
+
 def _abandon_future(fut) -> None:
     """Cancel an abandoned fetch future; if it is already running, attach a
     callback that observes (and drops) its outcome so late errors never
@@ -160,6 +184,7 @@ def _encode_rows(
     max_batch_bytes: int,
     pipeline_depth: Optional[int] = None,
     crcs: Optional[list] = None,
+    ring_cache: Optional[dict] = None,
 ) -> None:
     """Encode `n_rows` rows of `block_size` blocks as a stream of flat
     (DATA_SHARDS, width) device dispatches over reused staging buffers.
@@ -171,7 +196,15 @@ def _encode_rows(
     and drains happen FIFO so parity files receive bytes in order. Data
     shards stream to disk at fill time (their bytes never cross the
     device); when `crcs` is given, each shard's running CRC32 is folded
-    in the same pass — bytes are touched once, no second host pass."""
+    in the same pass — bytes are touched once, no second host pass.
+
+    On a mesh-backend encoder the staging span is rounded up to the
+    encoder's `width_align` (dp*sp) and each dispatch covers the aligned
+    width (the gap zero-filled, written/CRC'd only to the true width), so
+    every batch's host->device transfer splits evenly across the chips
+    with no dispatcher-side pad copy. `ring_cache` (a caller-owned dict)
+    keeps the staging ring alive ACROSS calls — the inline-ingest
+    builder's per-poll path."""
     if n_rows <= 0:
         return
     if buffer_size > block_size:
@@ -179,11 +212,12 @@ def _encode_rows(
     if block_size % buffer_size:
         raise ValueError(f"block size {block_size} not a multiple of buffer {buffer_size}")
     depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
+    align = int(getattr(enc, "width_align", 1) or 1)
     segs_per_row = block_size // buffer_size
     # how many (10 x buffer) segments fit the device-batch budget
     batch_cap = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
-    span = batch_cap * buffer_size
-    ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
+    span = _aligned(batch_cap * buffer_size, align)
+    ring = _ring_for(ring_cache, depth + 1, (DATA_SHARDS_COUNT, span))
     inflight: deque = deque()  # FIFO of (parity_handle, width)
 
     def drain_one() -> None:
@@ -231,7 +265,10 @@ def _encode_rows(
             outputs[d].write(view[d])
             if crcs is not None:
                 crcs[d] = zlib.crc32(view[d], crcs[d])
-        inflight.append((enc.encode_parity_lazy(view, donate=True), width))
+        aw = _aligned(width, align)  # <= span: roundup is monotone
+        if aw > width:
+            staging[:, width:aw] = 0  # tail batch: pad columns are zeros
+        inflight.append((enc.encode_parity_lazy(staging[:, :aw], donate=True), width))
 
     try:
         # iterate segments in global order (row-major, then segment in block)
@@ -933,8 +970,9 @@ def rebuild_ec_files_from_sources(
         DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
     )
     survivors = present[:DATA_SHARDS_COUNT]
+    align = int(getattr(enc, "width_align", 1) or 1)
     chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
-    span = chunks_per_batch * buffer_size
+    span = _aligned(chunks_per_batch * buffer_size, align)
     ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
     crcs = {s: 0 for s in missing}
     #: (offset, valid_bytes, staged_width) per batch, precomputed so the
@@ -977,8 +1015,11 @@ def rebuild_ec_files_from_sources(
                     staging = ring.take()
                     for i, s in enumerate(survivors):
                         sources[s].read_into(off, staging[i, :width])
+                    aw = _aligned(width, align)  # <= span: roundup is monotone
+                    if aw > width:
+                        staging[:, width:aw] = 0  # tail: pad columns are zeros
                     decoded = enc.reconstruct_lazy(
-                        staging[:, :width], survivors, missing, donate=True
+                        staging[:, :aw], survivors, missing, donate=True
                     )  # async
                     inflight.append((decoded, valid))
                 while inflight:

@@ -41,7 +41,7 @@ EVIDENCE_MAX_AGE_DAYS = config.env("WEEDTPU_EVIDENCE_MAX_AGE_DAYS")
 #: single-client TPU tunnel) needs no rs_pallas/jax import.
 FUSED_VARIANTS = ("int8", "bf16", "u8", "mplane", "dma")
 
-_BACKENDS = ("numpy", "native", "jax", "pallas")
+_BACKENDS = ("numpy", "native", "jax", "pallas", "mesh")
 
 #: LRU cap on cached decode matrices. A long-lived volume server whose
 #: shard-loss patterns churn (peers flapping, rolling repairs) sees an
@@ -111,6 +111,8 @@ class Encoder:
         backend: str = "numpy",
         pallas_mxu: str = "int8",
         pallas_tile: Optional[int] = None,
+        mesh_shape: Optional[Sequence[int]] = None,
+        mesh_rebuild: Optional[str] = None,
     ):
         if data_shards <= 0 or parity_shards <= 0:
             raise ValueError("shard counts must be positive")
@@ -130,6 +132,12 @@ class Encoder:
         # new_encoder("auto") from the winning committed measurement
         self.pallas_mxu = pallas_mxu
         self.pallas_tile = pallas_tile
+        # mesh backend config: dp x sp axis shape and distributed-rebuild
+        # variant (None = resolve from WEEDTPU_MESH_SHAPE / committed
+        # MULTICHIP evidence / the all-devices default at first dispatch)
+        self.mesh_shape = tuple(int(v) for v in mesh_shape) if mesh_shape else None
+        self.mesh_rebuild = mesh_rebuild
+        self._mesh_obj = None
         #: how this encoder's backend was chosen (new_encoder fills it;
         #: direct construction is an explicit choice)
         self.selection: dict = {"backend": backend, "source": "explicit"}
@@ -137,6 +145,34 @@ class Encoder:
         self.parity_matrix = np.ascontiguousarray(self.gen_matrix[data_shards:])
 
     # -- kernel dispatch ----------------------------------------------------
+
+    def _mesh_dispatch(self):
+        """The lazily-built mesh state (imports jax; builds the Mesh and
+        exports the weedtpu_ec_mesh_devices gauge on first use)."""
+        if self._mesh_obj is None:
+            from seaweedfs_tpu.parallel import backend as mesh_backend
+
+            self._mesh_obj = mesh_backend.MeshDispatch(
+                shape=self.mesh_shape, rebuild=self.mesh_rebuild
+            )
+        return self._mesh_obj
+
+    @property
+    def width_align(self) -> int:
+        """Staging-width multiple the streaming pipelines should round
+        their spans to so every steady-state batch dispatches pad-free
+        (1 on single-device backends; dp*sp on the mesh backend)."""
+        if self.backend != "mesh":
+            return 1
+        return self._mesh_dispatch().width_align
+
+    def _count_dispatch(self) -> None:
+        try:
+            from seaweedfs_tpu import stats
+
+            stats.EcDispatchTotal.labels(self.backend).inc()
+        except Exception:  # noqa: BLE001 — metrics must never break dispatch
+            pass
 
     def _apply_lazy(self, m: np.ndarray, shards: np.ndarray, donate: bool = False):
         """Apply GF matrix m without forcing the result to the host: the
@@ -146,6 +182,9 @@ class Encoder:
         (jax/pallas, off-CPU only) releases the input's device buffer at
         dispatch-consume time so a streaming pipeline's inflight HBM stays
         bounded (an early-release hint — see rs_jax's donated-twin note)."""
+        self._count_dispatch()
+        if self.backend == "mesh":
+            return self._mesh_dispatch().apply(m, shards, donate=donate)
         if self.backend == "pallas":
             from seaweedfs_tpu.ops import rs_pallas
 
@@ -429,6 +468,14 @@ class Encoder:
                 raise ValueError(f"want ({self.data_shards}, N), got {stack.shape}")
         elif stack.ndim != 3 or stack.shape[1] != self.data_shards:
             raise ValueError(f"want (B, {self.data_shards}, N), got {stack.shape}")
+        if self.backend == "mesh":
+            # the bulk-repair path rides the DISTRIBUTED formulations
+            # (ring ppermute / all_to_all over the mesh) rather than the
+            # generic column-sharded apply — same bytes, pod bandwidth
+            self._count_dispatch()
+            return self._mesh_dispatch().reconstruct(
+                self.reconstruction_matrix(survivors, wanted), stack, donate=donate
+            )
         return self._apply_lazy(
             self.reconstruction_matrix(survivors, wanted), stack, donate=donate
         )
@@ -713,6 +760,142 @@ def pick_device_backend(art_dir: Optional[str] = None) -> tuple[str, dict]:
     return "jax", decision
 
 
+# -- committed mesh evidence (the pod-scale promotion input) -----------------
+
+
+def _multichip_dir() -> str:
+    """MULTICHIP_r*.json artifacts live at the repo root (beside
+    BENCH_r*.json), not under artifacts/."""
+    return os.path.dirname(_artifacts_dir())
+
+
+def load_mesh_evidence(art_dir: Optional[str] = None) -> Optional[dict]:
+    """Newest committed `MULTICHIP_r*.json` (lexically latest round), with
+    `_file` recording provenance. None when no readable artifact exists."""
+    art_dir = art_dir or _multichip_dir()
+    try:
+        names = sorted(
+            f
+            for f in os.listdir(art_dir)
+            if f.startswith("MULTICHIP_r") and f.endswith(".json")
+        )
+    except OSError:
+        return None
+    for name in reversed(names):
+        try:
+            import json
+
+            with open(os.path.join(art_dir, name), encoding="utf-8") as f:
+                ev = json.load(f)
+            if isinstance(ev, dict):
+                ev["_file"] = name
+                return ev
+        except (OSError, ValueError):
+            continue  # an unreadable newest artifact must not hide older ones
+    return None
+
+
+def _evidence_round(ev: dict) -> Optional[int]:
+    r = ev.get("round")
+    if isinstance(r, int):
+        return r
+    name = str(ev.get("_file", ""))
+    digits = "".join(c for c in name if c.isdigit())
+    return int(digits) if digits else None
+
+
+def pick_mesh_backend(
+    n_devices: int, art_dir: Optional[str] = None
+) -> tuple[bool, dict]:
+    """The pod-scale promotion decision: flip `auto` to the mesh backend
+    ONLY when a committed `MULTICHIP_r*.json` carries fresh ON-CHIP
+    per-mesh-shape measurements (the PR-4 evidence rule generalized from
+    per-kernel to per-mesh-shape) in which an achievable shape's encode
+    beats the single-device number recorded beside it. Absent, stale,
+    off-chip, or losing evidence keeps the current backend. The decision
+    dict names the evidence file/round, the winning shape, and both
+    numbers, so the selection stays auditable."""
+    ev = load_mesh_evidence(art_dir)
+    if ev is None:
+        return False, {
+            "reason": "no committed mesh evidence (MULTICHIP_r*.json)",
+        }
+    decision: dict = {
+        "evidence_file": ev.get("_file"),
+        "evidence_round": _evidence_round(ev),
+    }
+    shapes = ev.get("shapes")
+    if not isinstance(shapes, dict) or not shapes:
+        decision["reason"] = "evidence has no per-mesh-shape measurements"
+        return False, decision
+    if "tpu" not in str(ev.get("platform", "")).lower():
+        decision["reason"] = "mesh evidence is not an on-chip measurement"
+        return False, decision
+    age = _evidence_age_days(ev)
+    if age is None:
+        decision["reason"] = (
+            f"mesh evidence age unparseable (when={ev.get('when')!r}): treated as stale"
+        )
+        return False, decision
+    if age > EVIDENCE_MAX_AGE_DAYS:
+        decision["reason"] = (
+            f"mesh evidence stale ({age:.0f}d > {EVIDENCE_MAX_AGE_DAYS:.0f}d)"
+        )
+        return False, decision
+    single = (ev.get("single_device") or {}).get("encode_gbps")
+    single = float(single) if isinstance(single, (int, float)) else 0.0
+    best_label, best = None, 0.0
+    for label, rec in shapes.items():
+        if not isinstance(rec, dict):
+            continue
+        # parse `DPxSP` locally — this function runs in jax-free parents
+        # (bench), so it must not import the parallel package
+        parts = str(label).lower().split("x")
+        if len(parts) != 2 or not all(p.isdigit() and int(p) > 0 for p in parts):
+            continue
+        dims = (int(parts[0]), int(parts[1]))
+        if dims[0] * dims[1] > int(n_devices):
+            continue  # shape not achievable on this pod
+        if rec.get("match") is not True or rec.get("error"):
+            # only a shape that COMPLETED byte-verification is evidence —
+            # a missing `match` (e.g. a rebuild variant crashed after the
+            # encode measurement landed) must not promote
+            continue
+        gbps = rec.get("encode_gbps")
+        if not isinstance(gbps, (int, float)) or gbps <= 0:
+            continue
+        if single and gbps <= single:
+            continue  # aggregate number must beat the single-device one
+        if gbps > best:
+            best_label, best = str(label), float(gbps)
+    if best_label is None:
+        decision["reason"] = (
+            "no achievable mesh shape beats the single-device number"
+            if single
+            else "no achievable mesh shape with a usable encode measurement"
+        )
+        return False, decision
+    rec = shapes[best_label]
+    ring = rec.get("rebuild_ring_gbps")
+    a2a = rec.get("rebuild_alltoall_gbps")
+    variant = "ring"
+    if isinstance(a2a, (int, float)) and (
+        not isinstance(ring, (int, float)) or a2a > ring
+    ):
+        variant = "alltoall"
+    decision.update(
+        mesh_shape=best_label,
+        mesh_rebuild=variant,
+        encode_gbps=best,
+        single_device_gbps=single or None,
+        reason=(
+            f"committed on-chip mesh evidence: {best_label} encode={best} "
+            f"beats single-device {single}"
+        ),
+    )
+    return True, decision
+
+
 def _export_selection(selection: dict) -> None:
     """Mirror the factory's decision into the Prometheus registry: the
     previously-selected label (if any) drops to 0 so a scrape shows ONE
@@ -760,6 +943,16 @@ def new_encoder(
     (r4 numbers: XLA 31-32 GB/s vs fused 18.7). The decision lands on
     `encoder.selection`, in the `weedtpu_ec_backend_selected` stats gauge,
     and in bench.py output. backend="pallas" still forces the fused kernel.
+
+    POD promotion: with more than one device, `pick_mesh_backend` extends
+    the same rule to per-mesh-shape measurements in the committed
+    `MULTICHIP_r*.json` artifact — fresh on-chip evidence of an achievable
+    dp x sp shape beating the single-device encode flips `auto` to the
+    mesh backend (shape + ring/all_to_all rebuild variant from the
+    evidence); absent/stale/off-chip mesh evidence keeps whatever the
+    per-chip decision chose. backend="mesh" forces the mesh path with
+    `WEEDTPU_MESH_SHAPE`/`WEEDTPU_MESH_REBUILD` (or evidence/default)
+    config; the selection audit records the mesh shape and evidence round.
     """
     selection: dict = {"requested": backend}
     pallas_kwargs: dict = {}
@@ -782,6 +975,7 @@ def new_encoder(
             # cpu-pinned server process blocks on the one-client TPU tunnel
             honor_platform_env()
             d = jax.devices()[0]
+            n_dev = jax.device_count()
             if is_tpu_device(d):
                 backend, decision = pick_device_backend()
                 selection.update(decision)
@@ -797,6 +991,27 @@ def new_encoder(
                         "pallas_mxu": decision.get("pallas_mxu", "int8"),
                         "pallas_tile": decision.get("pallas_tile"),
                     }
+                # pod promotion: >1 device + committed per-mesh-shape
+                # evidence outranks any per-chip kernel choice (the
+                # aggregate number is the one the rebuild target is
+                # stated against)
+                if n_dev > 1:
+                    mesh_ok, mesh_dec = pick_mesh_backend(n_dev)
+                    selection["mesh"] = mesh_dec
+                    if mesh_ok:
+                        backend = "mesh"
+                        dims = tuple(
+                            int(p) for p in mesh_dec["mesh_shape"].split("x")
+                        )
+                        pallas_kwargs = {
+                            "mesh_shape": dims,
+                            "mesh_rebuild": mesh_dec["mesh_rebuild"],
+                        }
+                        selection.update(
+                            backend="mesh",
+                            source="mesh-evidence",
+                            reason=mesh_dec["reason"],
+                        )
             elif d.platform != "cpu":
                 backend = "jax"
                 selection.update(
@@ -809,6 +1024,19 @@ def new_encoder(
                     backend=backend, source="platform",
                     reason="cpu host: AVX2 library when loadable, else numpy",
                 )
+            if n_dev > 1 and "mesh" not in selection:
+                # audit-only on non-TPU multi-device hosts: the decision
+                # dict records WHY the pod path is not promoted here, so
+                # `ec.backend` can print it (off-chip hosts never promote
+                # even when committed evidence would qualify)
+                mesh_ok, mesh_dec = pick_mesh_backend(n_dev)
+                if mesh_ok:
+                    mesh_dec = dict(
+                        mesh_dec,
+                        reason="qualifying evidence exists but this host "
+                        "is not a TPU pod: not promoted",
+                    )
+                selection["mesh"] = mesh_dec
         except Exception:
             backend = _cpu_backend()
             selection.update(
@@ -822,6 +1050,20 @@ def new_encoder(
         data_shards, parity_shards, matrix_kind=matrix_kind, backend=backend,
         **pallas_kwargs,
     )
+    if enc.backend == "mesh":
+        # audit must name the ACTUAL mesh (explicit/env requests resolve
+        # their shape inside MeshDispatch) — build it now so a mesh
+        # encoder that cannot construct its mesh fails at the factory,
+        # not mid-stream
+        md = enc._mesh_dispatch()
+        selection.setdefault("mesh_shape", md.shape_str())
+        selection.setdefault("mesh_rebuild", md.rebuild_variant)
+        selection["mesh_devices"] = md.n_devices
+        selection["audit"] = (
+            f"mesh {md.shape_str()} ({md.n_devices} devices, "
+            f"rebuild={md.rebuild_variant}, evidence="
+            f"r{selection.get('mesh', {}).get('evidence_round', '-')})"
+        )
     enc.selection = selection
     _export_selection(selection)
     return enc

@@ -38,7 +38,7 @@ from seaweedfs_tpu.parallel import shard_map
 from seaweedfs_tpu.parallel.sharded import matrix_bits, pad_survivor_matrix, place_survivors
 
 
-def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
+def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray, donate: bool = False):
     """Ring rebuild over the 'sp' mesh axis.
 
     recon_m: (L, S) GF(2^8) decode matrix (survivors -> lost shards). The
@@ -48,6 +48,9 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
     Returns run(survivors (B, S, N) uint8) -> (B, L, N) device array with
     N sharded over 'sp' — the same contract as make_distributed_rebuild_fn,
     so the two are drop-in alternatives and directly comparable.
+    donate=True releases the placed survivor buffer at dispatch-consume
+    time (run() owns the device_put'ed copy; caller memory is never
+    donated).
     """
     n_lost, n_surv = np.asarray(recon_m).shape
     sp = mesh.shape["sp"]
@@ -57,14 +60,13 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
     l8 = n_lost * 8
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=(P("dp", "sp", None),),
         out_specs=P("dp", None, "sp"),
     )
-    def rebuild(survivors):
+    def _ring_rebuild(survivors):
         # local block: (B/dp, s_pad/sp, N) — whole shards, full byte extent
         b_local, s_local, n = survivors.shape
         tile = n // sp
@@ -94,6 +96,9 @@ def make_ring_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
 
         _, acc = jax.lax.fori_loop(0, sp, body, (survivors, acc0))
         return acc
+
+    donate_argnums = (0,) if donate else ()
+    rebuild = jax.jit(_ring_rebuild, donate_argnums=donate_argnums)
 
     def run(survivors: np.ndarray) -> jax.Array:
         return rebuild(place_survivors(mesh, survivors, n_surv, s_pad))

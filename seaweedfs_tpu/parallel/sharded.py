@@ -67,6 +67,30 @@ def place_survivors(
     return jax.device_put(survivors, NamedSharding(mesh, P("dp", "sp", None)))
 
 
+def make_matrix_apply_fn(mesh: Mesh, matrix: np.ndarray, donate: bool = False):
+    """Column-sharded GF(2^8) matrix apply over the FULL device set:
+    (C, W) uint8 with W sharded across every mesh axis -> (R, W), zero
+    communication (GF matmul is column-independent, so each chip's column
+    tile is an independent matmul). This is the mesh backend's generic
+    dispatch — parity encode, repair projections, and delta columns all
+    ride it; W must divide evenly over the device count (the dispatcher
+    zero-pads, which is exact: zero columns map to zero columns).
+
+    donate=True releases the input's device buffer at dispatch-consume
+    time (the mesh dispatcher always device_puts its own copy first, so
+    the donated buffer is jax-owned, never caller memory — the same
+    early-release contract as rs_jax.apply_matrix)."""
+    b_bits = _bits(matrix)
+    spec = P(None, tuple(mesh.axis_names))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    def apply(cols):
+        return rs_jax.gf_apply(b_bits, cols)
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(apply, donate_argnums=donate_argnums)
+
+
 def make_encode_fn(mesh: Mesh, parity_m: np.ndarray):
     """Jitted sharded encode: (B, D, N) uint8 -> (B, D+P, N) uint8, with B on
     'dp' and N on 'sp' (either axis may be size 1)."""
@@ -177,7 +201,7 @@ def make_multislice_ec_cycle_fn(
     return run
 
 
-def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
+def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray, donate: bool = False):
     """Multi-chip distributed rebuild — the TPU-native analog of the
     reference's `ec.rebuild` fan-out of survivor-shard copies to one
     rebuilder node ([ref: weed/shell/command_ec_rebuild.go, mount empty —
@@ -198,27 +222,31 @@ def make_distributed_rebuild_fn(mesh: Mesh, recon_m: np.ndarray):
     columns contribute nothing, so correctness is unaffected).
 
     Returns run(survivors (B, S, N) uint8) -> (B, L, N) device array.
-    B must divide evenly over 'dp' and N over 'sp'.
+    B must divide evenly over 'dp' and N over 'sp'. donate=True releases
+    the placed survivor buffer at dispatch-consume time (run() owns the
+    device_put'ed copy, so donation never touches caller memory).
     """
     n_surv = np.asarray(recon_m).shape[1]
     padded = pad_survivor_matrix(recon_m, mesh.shape["sp"])
     s_pad = padded.shape[1]
     b_rec = _bits(padded)
 
-    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=(P("dp", "sp", None),),
         out_specs=P("dp", None, "sp"),
     )
-    def rebuild(survivors):
+    def _rebuild(survivors):
         # local view: (B/dp, s_pad/sp, N) whole-shard rows ->
         # (B/dp, s_pad, N/sp) full survivor set for this chip's byte tile
         regrouped = jax.lax.all_to_all(
             survivors, "sp", split_axis=2, concat_axis=1, tiled=True
         )
         return rs_jax.gf_apply(b_rec, regrouped)
+
+    donate_argnums = (0,) if donate else ()
+    rebuild = jax.jit(_rebuild, donate_argnums=donate_argnums)
 
     def run(survivors: np.ndarray) -> jax.Array:
         return rebuild(place_survivors(mesh, survivors, n_surv, s_pad))

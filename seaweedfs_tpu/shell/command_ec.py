@@ -688,3 +688,41 @@ register(
         do_ec_balance,
     )
 )
+
+
+# -- ec.backend --------------------------------------------------------------
+
+
+def do_ec_backend(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Operator view of the encoder factory's selection audit: which codec
+    backend `new_encoder("auto")` picks HERE and why — the evidence file/
+    round behind a fused-kernel or mesh promotion, the mesh shape and
+    rebuild variant when the pod path is selected, and the reason string
+    when conservative defaults hold. Read-only; no cluster lock."""
+    parse_flags(args)
+    from seaweedfs_tpu.ops.rs_codec import new_encoder
+
+    enc = new_encoder()
+    sel = dict(enc.selection)
+    sel.pop("mesh", None)  # the nested decision dict is too noisy for a shell line
+    w.write(
+        "ec.backend: "
+        + " ".join(f"{k}={sel[k]}" for k in sorted(sel) if sel[k] is not None)
+        + "\n"
+    )
+    mesh_dec = enc.selection.get("mesh")
+    if isinstance(mesh_dec, dict) and enc.backend != "mesh":
+        w.write(
+            f"ec.backend: mesh not promoted: {mesh_dec.get('reason', 'n/a')}\n"
+        )
+
+
+register(
+    ShellCommand(
+        "ec.backend",
+        "ec.backend\n\treport the encoder factory's backend selection audit "
+        "(evidence file,\n\tmesh shape/evidence round when the pod path is "
+        "promoted, and the reason\n\ta conservative default held otherwise)",
+        do_ec_backend,
+    )
+)

@@ -328,6 +328,17 @@ InlineEcSeals = REGISTRY.counter(
     "restart then finalized, warm = full .dat re-encode fallback",
     ("mode",),
 )
+EcMeshDevices = REGISTRY.gauge(
+    "weedtpu_ec_mesh_devices",
+    "devices in the mesh backend's dp x sp device mesh (0 = every dispatch "
+    "is single-device; set when a mesh encoder builds its mesh)",
+)
+EcDispatchTotal = REGISTRY.counter(
+    "weedtpu_ec_dispatch_total",
+    "codec matrix dispatches by backend (one batched device/host apply per "
+    "increment — the per-backend traffic split behind the selection gauge)",
+    ("backend",),
+)
 EcBackendSelected = REGISTRY.gauge(
     "weedtpu_ec_backend_selected",
     "codec backend chosen by new_encoder (1 = currently selected; source "

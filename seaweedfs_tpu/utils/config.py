@@ -238,8 +238,25 @@ register_env(
 register_env(
     "WEEDTPU_BACKEND", str, "",
     "Operator override of the evidence-based auto backend selection: one "
-    "of numpy | native | jax | pallas (empty/auto = measured decision). "
-    "Explicit new_encoder(backend=...) callers are never overridden.",
+    "of numpy | native | jax | pallas | mesh (empty/auto = measured "
+    "decision). Explicit new_encoder(backend=...) callers are never "
+    "overridden.",
+)
+register_env(
+    "WEEDTPU_MESH_SHAPE", str, "",
+    "dp x sp axis shape of the mesh backend's device mesh, as `DPxSP` "
+    "(e.g. `4x2`). Empty/`auto` resolves from the best achievable shape "
+    "in committed MULTICHIP_r*.json evidence, falling back to "
+    "(devices/2) x 2 (or devices x 1 below 4 devices).",
+)
+register_env(
+    "WEEDTPU_MESH_REBUILD", str, "ring",
+    "Distributed-rebuild formulation of the mesh backend: `ring` rotates "
+    "one resident survivor block per chip with ppermute (peak per-chip "
+    "memory = one block; measured faster), `alltoall` regroups "
+    "shard-major survivors to byte-major with one all_to_all. Both are "
+    "byte-identical to the single-device decode.",
+    parse=_enum("ring", "alltoall"),
 )
 register_env(
     "WEEDTPU_EVIDENCE_MAX_AGE_DAYS", float, 120.0,

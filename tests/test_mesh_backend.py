@@ -1,0 +1,486 @@
+"""Mesh backend: pod-scale encode/rebuild reachable from ec.encode/
+ec.rebuild — byte-identity vs the single-device oracle on tile-edge/odd/
+multi-loss shapes (the r9 contract), the per-mesh-shape MULTICHIP
+evidence rule for `auto` promotion, the WEEDTPU_MESH* knobs, stats, the
+BENCH_MODE=mesh smoke, and the ingest persistent-staging-ring follow-up.
+All on the 8 virtual CPU devices conftest forces — no TPU needed."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.ops import rs_codec
+from seaweedfs_tpu.ops.rs_codec import Encoder
+
+pytestmark = []
+
+
+def _golden():
+    return Encoder(10, 4, backend="numpy")
+
+
+def _encode_all(enc, data):
+    return np.stack(enc.encode(list(data)))
+
+
+# -- dispatch-level byte-identity --------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_mesh_encode_matches_golden_odd_width(shape):
+    """Odd widths force the internal zero-pad path; output must still be
+    byte-identical to the numpy oracle."""
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=shape)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(10, 1003), dtype=np.uint8)
+    out = np.asarray(enc.encode_parity_lazy(data))
+    want = np.asarray(_golden().encode_parity_lazy(data))
+    assert np.array_equal(out, want)
+
+
+@pytest.mark.parametrize("rebuild", ["ring", "alltoall"])
+@pytest.mark.parametrize("lost", [(3,), (1, 5, 10, 13), (0, 1, 2, 3)])
+def test_mesh_reconstruct_lazy_matches_golden(rebuild, lost):
+    """The rebuild pipeline's flat (survivors, width) form through BOTH
+    distributed formulations, single- and multi-loss, odd width."""
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(4, 2), mesh_rebuild=rebuild)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(10, 777), dtype=np.uint8)
+    shards = _encode_all(_golden(), data)
+    surv = [i for i in range(14) if i not in lost][:10]
+    got = np.asarray(enc.reconstruct_lazy(shards[surv], surv, list(lost), donate=True))
+    assert np.array_equal(got, shards[list(lost)])
+
+
+def test_mesh_batched_forms_match_golden():
+    """3-D (B, C, N) encode/reconstruct forms (serving/batched paths)."""
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(2, 4))
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=(3, 10, 257), dtype=np.uint8)
+    assert np.array_equal(enc.encode_batch(data), _golden().encode_batch(data))
+    shards = np.stack([_encode_all(_golden(), v) for v in data])
+    lost = [2, 7, 11]
+    surv = [i for i in range(14) if i not in lost][:10]
+    got = enc.reconstruct_batch(shards[:, surv, :], surv, lost)
+    assert np.array_equal(got, shards[:, lost, :])
+
+
+def test_mesh_serving_reconstruct_and_verify():
+    """The reedsolomon-parity API surface (reconstruct/verify/encode)
+    through the mesh backend, including the bucketed serving path."""
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(4, 2))
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=(10, 5000), dtype=np.uint8)
+    shards = list(_encode_all(_golden(), data))
+    assert enc.verify(shards)
+    holed = list(shards)
+    holed[0] = holed[12] = None
+    rec = enc.reconstruct(holed)
+    for s in range(14):
+        assert np.array_equal(rec[s], shards[s]), s
+
+
+# -- file-pipeline byte-identity (the production path) ------------------------
+
+
+def _write_dat(base, data):
+    os.makedirs(os.path.dirname(base), exist_ok=True)
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+
+
+def test_mesh_write_ec_files_byte_identical_tile_edge(tmp_path):
+    """write_ec_files through the mesh streaming pipeline (aligned spans,
+    zero-filled tail gap, donation, inline CRC) vs the warm oracle on a
+    tile-edge/odd layout."""
+    rng = np.random.default_rng(5)
+    large, small, buf = 64 * 1024, 16 * 1024, 16 * 1024
+    data = rng.integers(
+        0, 256, 2 * large * 10 + 3 * small * 10 + 4321, dtype=np.uint8
+    ).tobytes()
+    base_o, base_m = str(tmp_path / "o" / "7"), str(tmp_path / "m" / "7")
+    for b in (base_o, base_m):
+        _write_dat(b, data)
+    stripe.write_ec_files(base_o, large, small, buf, encoder=_golden(),
+                          max_batch_bytes=1 << 20)
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(4, 2))
+    stripe.write_ec_files(base_m, large, small, buf, encoder=enc,
+                          max_batch_bytes=1 << 20)
+    for s in range(14):
+        assert (
+            open(stripe.shard_file_name(base_o, s), "rb").read()
+            == open(stripe.shard_file_name(base_m, s), "rb").read()
+        ), f"shard {s}"
+    # identical geometry AND identical streamed CRCs in the sidecar
+    assert open(base_o + ".eci", "rb").read() == open(base_m + ".eci", "rb").read()
+
+
+@pytest.mark.parametrize("rebuild", ["ring", "alltoall"])
+def test_mesh_rebuild_ec_files_byte_identical_to_serial(tmp_path, rebuild):
+    """rebuild_ec_files with the mesh encoder (both variants) vs the
+    serial oracle on the same survivor set, multi-loss, with the .eci CRC
+    gate active (a byte drift would fail the rebuild, not just the
+    comparison)."""
+    rng = np.random.default_rng(6)
+    large, small, buf = 64 * 1024, 16 * 1024, 16 * 1024
+    data = rng.integers(0, 256, 3 * large * 10 + 987, dtype=np.uint8).tobytes()
+    base = str(tmp_path / "v" / "7")
+    _write_dat(base, data)
+    stripe.write_ec_files(base, large, small, buf, encoder=_golden(),
+                          max_batch_bytes=1 << 20)
+    lost = (0, 5, 11, 13)
+    expected = {
+        s: open(stripe.shard_file_name(base, s), "rb").read() for s in lost
+    }
+    for s in lost:
+        os.unlink(stripe.shard_file_name(base, s))
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(2, 4), mesh_rebuild=rebuild)
+    rebuilt = stripe.rebuild_ec_files(
+        base, encoder=enc, buffer_size=48 * 1024, max_batch_bytes=1 << 20
+    )
+    assert sorted(rebuilt) == sorted(lost)
+    for s in lost:
+        assert open(stripe.shard_file_name(base, s), "rb").read() == expected[s]
+    # serial oracle on the SAME survivor set agrees (transitivity check)
+    for s in lost:
+        os.unlink(stripe.shard_file_name(base, s))
+    stripe.rebuild_ec_files_serial(base, encoder=_golden())
+    for s in lost:
+        assert open(stripe.shard_file_name(base, s), "rb").read() == expected[s]
+
+
+# -- factory, knobs, audit -----------------------------------------------------
+
+
+def test_new_encoder_mesh_explicit_and_audit():
+    enc = rs_codec.new_encoder(backend="mesh")
+    assert enc.backend == "mesh"
+    sel = enc.selection
+    assert sel.get("mesh_shape") and "x" in sel["mesh_shape"]
+    assert sel.get("mesh_rebuild") in ("ring", "alltoall")
+    assert sel.get("mesh_devices") >= 1
+    assert "mesh" in sel.get("audit", "")
+
+
+def test_mesh_shape_env_knob(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_MESH_SHAPE", "2x2")
+    enc = Encoder(10, 4, backend="mesh")
+    md = enc._mesh_dispatch()
+    assert (md.dp, md.sp) == (2, 2)
+    assert md.width_align == 4
+
+
+def test_mesh_shape_env_knob_malformed(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_MESH_SHAPE", "banana")
+    enc = Encoder(10, 4, backend="mesh")
+    with pytest.raises(ValueError, match="DPxSP"):
+        enc._mesh_dispatch()
+
+
+def test_mesh_rebuild_variant_validation():
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(2, 2), mesh_rebuild="bogus")
+    with pytest.raises(ValueError, match="variant"):
+        enc._mesh_dispatch()
+
+
+def test_default_mesh_shape_rule():
+    from seaweedfs_tpu.parallel import backend as mb
+
+    assert mb.default_mesh_shape(8) == (4, 2)
+    assert mb.default_mesh_shape(2) == (2, 1)
+    assert mb.parse_mesh_shape("") is None
+    assert mb.parse_mesh_shape("auto") is None
+    assert mb.parse_mesh_shape("4x2") == (4, 2)
+    with pytest.raises(ValueError):
+        mb.parse_mesh_shape("0x4")
+
+
+def test_mesh_stats_gauge_and_dispatch_counter():
+    from seaweedfs_tpu import stats
+
+    enc = Encoder(10, 4, backend="mesh", mesh_shape=(4, 2))
+    before = stats.EcDispatchTotal.labels("mesh").value
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, size=(10, 64), dtype=np.uint8)
+    np.asarray(enc.encode_parity_lazy(data))
+    assert stats.EcMeshDevices.value == 8
+    assert stats.EcDispatchTotal.labels("mesh").value == before + 1
+
+
+# -- per-mesh-shape evidence rule ---------------------------------------------
+
+
+def _fresh_when():
+    import datetime
+
+    return datetime.datetime.utcnow().strftime("%Y-%m-%dT%H:%MZ")
+
+
+def _write_multichip(dirpath, meas, name="MULTICHIP_r91.json"):
+    with open(os.path.join(dirpath, name), "w", encoding="utf-8") as f:
+        json.dump(meas, f)
+
+
+def _evidence(**kw):
+    ev = {
+        "when": _fresh_when(),
+        "platform": "tpu (TPU v5 lite)",
+        "round": 91,
+        "single_device": {"encode_gbps": 31.0},
+        "shapes": {
+            "4x2": {
+                "encode_gbps": 180.0,
+                "rebuild_ring_gbps": 120.0,
+                "rebuild_alltoall_gbps": 95.0,
+                "match": True,
+            },
+            "16x2": {"encode_gbps": 500.0, "match": True},
+        },
+    }
+    ev.update(kw)
+    return ev
+
+
+def test_mesh_evidence_promotes_on_fresh_onchip(tmp_path):
+    _write_multichip(tmp_path, _evidence())
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert ok
+    # 16x2 is faster but needs 32 devices — only achievable shapes count
+    assert dec["mesh_shape"] == "4x2"
+    assert dec["mesh_rebuild"] == "ring"  # ring beats alltoall in the evidence
+    assert dec["evidence_round"] == 91
+    assert "beats single-device" in dec["reason"]
+
+
+def test_mesh_evidence_alltoall_wins_when_faster(tmp_path):
+    ev = _evidence()
+    ev["shapes"]["4x2"]["rebuild_alltoall_gbps"] = 200.0
+    _write_multichip(tmp_path, ev)
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert ok and dec["mesh_rebuild"] == "alltoall"
+
+
+def test_mesh_evidence_absent_keeps_backend(tmp_path):
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok and "no committed mesh evidence" in dec["reason"]
+
+
+def test_mesh_evidence_off_chip_never_promotes(tmp_path):
+    _write_multichip(tmp_path, _evidence(platform="cpu (cpu)"))
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok and "on-chip" in dec["reason"]
+
+
+def test_mesh_evidence_stale_never_promotes(tmp_path):
+    _write_multichip(tmp_path, _evidence(when="2020-01-01T00:00Z"))
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok and "stale" in dec["reason"]
+
+
+def test_mesh_evidence_unparseable_age_is_stale(tmp_path):
+    _write_multichip(tmp_path, _evidence(when="yesterday-ish"))
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok and "stale" in dec["reason"]
+
+
+def test_mesh_evidence_losing_shape_keeps_backend(tmp_path):
+    ev = _evidence()
+    ev["shapes"]["4x2"]["encode_gbps"] = 12.0  # below single_device 31.0
+    del ev["shapes"]["16x2"]
+    _write_multichip(tmp_path, ev)
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok and "beats the single-device" in dec["reason"]
+
+
+def test_mesh_evidence_failed_byte_verify_disqualifies(tmp_path):
+    ev = _evidence()
+    ev["shapes"]["4x2"]["match"] = False
+    del ev["shapes"]["16x2"]
+    _write_multichip(tmp_path, ev)
+    ok, _dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok
+
+
+def test_mesh_evidence_no_shape_table_keeps_backend(tmp_path):
+    _write_multichip(tmp_path, {"when": _fresh_when(), "platform": "tpu", "tail": "ok"})
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    assert not ok and "per-mesh-shape" in dec["reason"]
+
+
+def test_mesh_evidence_newest_round_wins(tmp_path):
+    _write_multichip(tmp_path, _evidence(), name="MULTICHIP_r90.json")
+    ev2 = _evidence(platform="cpu (cpu)")
+    _write_multichip(tmp_path, ev2, name="MULTICHIP_r91.json")
+    ok, dec = rs_codec.pick_mesh_backend(8, art_dir=str(tmp_path))
+    # the newest round is off-chip: it must NOT fall back to older rounds
+    assert not ok and dec["evidence_file"] == "MULTICHIP_r91.json"
+
+
+def test_committed_multichip_r06_never_promotes_on_this_box():
+    """The artifact this PR commits is a CPU host-device run: the
+    evidence rule must refuse it (platform gate), so `auto` on a future
+    8-device host cannot silently flip to mesh without on-chip numbers."""
+    ev = rs_codec.load_mesh_evidence()
+    assert ev is not None and ev["_file"] >= "MULTICHIP_r06.json"
+    if ev["_file"] == "MULTICHIP_r06.json":
+        ok, dec = rs_codec.pick_mesh_backend(8)
+        assert not ok
+
+
+def test_new_encoder_auto_promotes_to_mesh_on_evidence(tmp_path, monkeypatch):
+    """End-to-end `auto` flow: a simulated TPU pod (device identity
+    faked, the 8 virtual CPU devices kept for the actual mesh build)
+    with committed fresh mesh evidence promotes to the mesh backend with
+    the evidence's shape + rebuild variant in the audit."""
+    from seaweedfs_tpu.utils import devices as devices_mod
+
+    _write_multichip(tmp_path, _evidence())
+    monkeypatch.setattr(devices_mod, "is_tpu_device", lambda d: True)
+    monkeypatch.setattr(rs_codec, "_artifacts_dir", lambda: str(tmp_path / "none"))
+    monkeypatch.setattr(rs_codec, "_multichip_dir", lambda: str(tmp_path))
+    enc = rs_codec.new_encoder()
+    assert enc.backend == "mesh"
+    assert enc.mesh_shape == (4, 2) and enc.mesh_rebuild == "ring"
+    sel = enc.selection
+    assert sel["source"] == "mesh-evidence"
+    assert sel["mesh_shape"] == "4x2" and sel["mesh_devices"] == 8
+    assert "evidence=r91" in sel["audit"]
+    # and the promoted encoder still encodes byte-identically
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=(10, 123), dtype=np.uint8)
+    assert np.array_equal(
+        np.asarray(enc.encode_parity_lazy(data)),
+        np.asarray(_golden().encode_parity_lazy(data)),
+    )
+
+
+def test_new_encoder_auto_keeps_backend_without_mesh_evidence(tmp_path, monkeypatch):
+    from seaweedfs_tpu.utils import devices as devices_mod
+
+    monkeypatch.setattr(devices_mod, "is_tpu_device", lambda d: True)
+    monkeypatch.setattr(rs_codec, "_artifacts_dir", lambda: str(tmp_path / "none"))
+    monkeypatch.setattr(rs_codec, "_multichip_dir", lambda: str(tmp_path))
+    enc = rs_codec.new_encoder()
+    assert enc.backend == "jax"  # tpu default without kernel evidence
+    assert "no committed mesh evidence" in enc.selection["mesh"]["reason"]
+
+
+# -- shell audit command ------------------------------------------------------
+
+
+def test_ec_backend_shell_command_reports_selection():
+    from seaweedfs_tpu.shell import commands
+
+    buf = io.StringIO()
+    commands()["ec.backend"].do([], None, buf)
+    out = buf.getvalue()
+    assert out.startswith("ec.backend: ")
+    assert "backend=" in out and "source=" in out
+
+
+# -- BENCH_MODE=mesh smoke (tier-1) -------------------------------------------
+
+
+def test_bench_mesh_smoke_schema_and_byte_verify(tmp_path):
+    """Scaled-down run of bench.py's mesh harness on the forced 8-device
+    CPU mesh: per-shape encode + both rebuild variants measured, every
+    shape byte-verified, artifact body round-trips through
+    device_window's MULTICHIP assembler."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    import bench
+
+    out = bench._measure_mesh(
+        str(tmp_path),
+        dat_bytes=2 * 64 * 1024 * 10 + 12345,
+        large=64 * 1024,
+        small=16 * 1024,
+        buffer_size=16 * 1024,
+        max_batch_bytes=1 << 20,
+        shapes=[(4, 2)],
+    )
+    assert out["kind"] == "multichip" and out["n_devices"] == 8
+    assert out["ok"] is True
+    rec = out["shapes"]["4x2"]
+    assert rec["match"] is True
+    for key in ("encode_gbps", "rebuild_ring_gbps", "rebuild_alltoall_gbps"):
+        assert rec[key] > 0
+    assert out["single_device"]["encode_gbps"] > 0
+    # assembler round-trip: this is exactly what a device window commits
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    import device_window
+
+    meas = device_window.assemble_multichip(out)
+    assert meas["shapes"] == out["shapes"] and meas["round"] == 6
+
+
+# -- ingest persistent staging ring (ROADMAP follow-up 1) ---------------------
+
+
+def test_inline_builder_reuses_staging_ring_across_polls(tmp_path):
+    """Steady-state polls must hit the SAME cached ring (no per-poll
+    buffer churn) and reuse the builder-lifetime .dat handle."""
+    from seaweedfs_tpu.ec import ingest
+
+    large, small, buf = 64 * 1024, 16 * 1024, 16 * 1024
+    base = str(tmp_path / "5")
+    b = ingest.InlineStripeBuilder(base, _golden(), large, small, buffer_size=buf)
+    rng = np.random.default_rng(9)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, large * 10 + 1, dtype=np.uint8).tobytes())
+        f.flush()
+        assert b.poll() == 1
+        ring_ids = {id(r) for r in b._ring_cache.values()}
+        dat_handle = b._dat
+        assert len(ring_ids) == 1 and dat_handle is not None
+        f.write(rng.integers(0, 256, large * 10, dtype=np.uint8).tobytes())
+        f.flush()
+        assert b.poll() == 1
+        assert {id(r) for r in b._ring_cache.values()} == ring_ids
+        assert b._dat is dat_handle
+    b.abort()
+    assert b._dat is None and not b._ring_cache
+
+
+def test_inline_builder_async_watermark_lands_before_seal(tmp_path):
+    """The flusher-thread watermark keeps the fsync-before-record
+    ordering: after polls cross the durable batch, the journal's last
+    rows record must describe bytes already on disk, and seal still
+    produces the warm-identical shard set."""
+    from seaweedfs_tpu.ec import ingest
+
+    large, small, buf = 64 * 1024, 16 * 1024, 16 * 1024
+    base_i, base_w = str(tmp_path / "i"), str(tmp_path / "w")
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 256, 4 * large * 10 + 321, dtype=np.uint8).tobytes()
+    b = ingest.InlineStripeBuilder(base_i, _golden(), large, small, buffer_size=buf)
+    b._durable_batch = large * 10  # force a watermark per row
+    with open(base_i + ".dat", "wb") as f:
+        f.write(data)
+        f.flush()
+    assert b.poll() == 4
+    if b._flusher is not None:
+        b._flusher.shutdown(wait=True)  # drain the async watermark
+        b._flusher = None
+    records = ingest.read_journal(base_i)
+    rows_records = [r for r in records if r.get("kind") == "rows"]
+    assert rows_records and rows_records[-1]["rows"] >= 1
+    for s in range(14):
+        size = os.path.getsize(ingest.part_path(base_i, s))
+        assert size >= rows_records[-1]["rows"] * large
+    b.seal()
+    with open(base_w + ".dat", "wb") as f:
+        f.write(data)
+    stripe.write_ec_files(base_w, large, small, buf, encoder=_golden())
+    for s in range(14):
+        assert (
+            open(stripe.shard_file_name(base_i, s), "rb").read()
+            == open(stripe.shard_file_name(base_w, s), "rb").read()
+        ), s

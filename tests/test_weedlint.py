@@ -75,6 +75,21 @@ def test_donation_fixture_fires():
     assert all(f.line < 24 for f in donated)
 
 
+def test_donation_shardmap_fixture_fires():
+    """The mesh-backend shapes: a shard_map-decorated body is traced (host
+    sync inside it fires), and names passed at donated positions of
+    shard_map-wrapped jits — the `jax.jit(shard_map(f), donate_argnums=...)`
+    binding AND the `@partial(jax.jit, donate_argnums=...)` decorator
+    stack — are dead until re-bound."""
+    findings = run(paths=[fixture("donation_shardmap.py")])
+    sync = [f for f in findings if f.rule == "jit-host-sync"]
+    donated = [f for f in findings if f.rule == "donated-buffer-read"]
+    assert len(sync) == 1 and sync[0].line == 17, findings
+    assert {f.line for f in donated} == {39, 45}, findings
+    # run_rebound's re-binding must NOT be flagged (its reads are >= 49)
+    assert all(f.line < 49 for f in donated)
+
+
 def test_env_fixture_fires():
     findings = run(paths=[fixture("env_raw.py")])
     raw = [f for f in findings if f.rule == "env-raw-read"]

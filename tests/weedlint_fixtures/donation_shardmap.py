@@ -1,0 +1,52 @@
+"""Planted violations for the donation family's shard_map extension:
+mapped bodies are traced (host sync inside them is flagged) and donated
+names passed to shard_map-wrapped jits follow the same dead-until-
+rebound rule. Never imported; parsed only (jax is not actually loaded)."""
+
+import functools
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+
+_MESH = object()
+
+
+@functools.partial(shard_map, mesh=_MESH, in_specs=None, out_specs=None)
+def _mapped_body(block):
+    host = np.asarray(block)  # BAD: host materialization in a mapped body
+    return host
+
+
+def _combine(block):
+    return block
+
+
+_donating = jax.jit(
+    shard_map(_combine, mesh=_MESH, in_specs=None, out_specs=None),
+    donate_argnums=(0,),
+)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(shard_map, mesh=_MESH, in_specs=None, out_specs=None)
+def _mapped_donating(block):
+    return block
+
+
+def run(staging):
+    out = _donating(staging)
+    checksum = staging.sum()  # BAD: staging was donated via the wrapper
+    return out, checksum
+
+
+def run_decorated(staging):
+    out = _mapped_donating(staging)
+    tail = staging[-1]  # BAD: donated through the decorator stack
+    return out, tail
+
+
+def run_rebound(staging):
+    out = _mapped_donating(staging)
+    staging = out + 1  # re-bind revives the name
+    return staging  # fine: reads the new binding
