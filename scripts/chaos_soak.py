@@ -42,10 +42,22 @@ sidecar), and the volume is then sealed with VolumeEcShardsGenerate
 {inline:true} — resume-or-fallback must produce a mountable shard set
 and the final read pass must verify EVERY byte.
 
+`--corrupt` (kill mode) injects SILENT CORRUPTION into live EC shard
+files mid-soak — one bit-flip, truncation, or deletion (cycling) per
+chaos round — with the background scrubber running hot (WEEDTPU_SCRUB=on,
+0.5 s cycles). The servers must detect each injection (scrub or
+verify-on-read), quarantine the shard out of serving, and auto-repair it
+(clean-replica re-pull or trace-mode rebuild, re-verified against .eci)
+while the kill loop keeps running; the run FAILS unless every injection
+ends healed AND every byte still reads back exactly (a corrupt byte
+served to a client shows up as BYTES DIFFER = lost).
+
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
-      python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency] [--inline]
-Writes artifacts/SOAK_r09.json and exits nonzero on any lost byte.
+      python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency] \
+          [--inline] [--corrupt]
+Writes artifacts/SOAK_r09.json (SOAK_r10.json with --corrupt) and exits
+nonzero on any lost byte or unhealed injection.
 """
 
 from __future__ import annotations
@@ -63,6 +75,54 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ART = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts")
+
+
+# -- shared corruption-injection primitives (weedload.py imports these, so
+# the two harnesses can never drift on what "injected" and "healed" mean) --
+
+
+def ec_shard_path(dirpath: str, vid: int, shard: int) -> str:
+    return os.path.join(dirpath, f"{vid}.ec{shard:02d}")
+
+
+def ec_shard_clean(dirpath: str, vid: int, shard: int, crcs) -> bool:
+    """Whole-file CRC32 equals the .eci-recorded value — the HEALED check
+    (covers repair-restored bit-flips/truncations and re-created deletes)."""
+    import zlib
+
+    try:
+        crc = 0
+        with open(ec_shard_path(dirpath, vid, shard), "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+        return crc == (crcs[shard] & 0xFFFFFFFF)
+    except OSError:
+        return False
+
+
+def inject_shard_fault(path: str, kind: str, rng) -> bool:
+    """One bitflip | truncate | delete against a live shard file. False
+    when the file vanished underneath (racing repair/kill) — the caller
+    just picks another target."""
+    try:
+        if kind == "bitflip":
+            size = os.path.getsize(path)
+            off = rng.randrange(max(1, size))
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([(b[0] if b else 0) ^ 0x40]))
+        elif kind == "truncate":
+            os.truncate(path, os.path.getsize(path) * 2 // 3)
+        else:
+            os.remove(path)
+        return True
+    except OSError:
+        return False
 
 
 def _free_port() -> int:
@@ -136,8 +196,18 @@ def main() -> int:
     wedge_mode = "--wedge" in sys.argv
     latency_mode = "--latency" in sys.argv
     inline_mode = "--inline" in sys.argv
+    corrupt_mode = "--corrupt" in sys.argv
     rng = random.Random(7)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if corrupt_mode:
+        # silent-corruption injection (bit-flips, truncations, deletions of
+        # live EC shard files) with the background scrubber running HOT:
+        # short cycle, no rate cap, prompt repair retries — detection
+        # latency is scan-bound. Must land before the servers start.
+        os.environ.setdefault("WEEDTPU_SCRUB", "on")
+        os.environ.setdefault("WEEDTPU_SCRUB_INTERVAL", "0.5")
+        os.environ.setdefault("WEEDTPU_SCRUB_RATE_MB", "0")
+        os.environ.setdefault("WEEDTPU_SCRUB_REPAIR_BACKOFF", "1.0")
     if inline_mode:
         # must land before the server subprocesses start (Node.start copies
         # os.environ); bench-scale rows so soak-sized volumes complete them
@@ -166,6 +236,7 @@ def main() -> int:
         "seconds": seconds,
         "mode": "wedge" if wedge_mode else "kill",
         "inline_ec": inline_mode,
+        "corrupt": corrupt_mode,
         # kill-mode nodes run with this per-RPC server-side sleep on shard/
         # slab reads (the trace scenario needs rebuilds to span wall time);
         # latency quantiles below therefore include it on any degraded read
@@ -537,6 +608,56 @@ def main() -> int:
                     return True
                 return False  # no live unsealed volume this round: retry
 
+            # -- corruption injection (--corrupt): one bit-flip/truncate/
+            # delete per chaos round against a live holder's EC shard
+            # file; the servers' scrubber + verify-on-read must detect,
+            # quarantine, and auto-repair each one while the kill loop
+            # keeps running. Healing is verified at the END (bytes match
+            # the .eci record again) so injections and kills interleave
+            # freely mid-run.
+            corruption = {"injected": [], "all_healed": True}
+            corrupt_kind = [0]
+
+            def _eci_crcs(vid: int):
+                for n in nodes:
+                    try:
+                        with open(os.path.join(n.dir, f"{vid}.eci")) as f:
+                            rec = json.load(f).get("shard_crc32")
+                        if rec:
+                            return rec
+                    except (OSError, ValueError):
+                        continue
+                return None
+
+            def try_corrupt_one() -> None:
+                vid = report.get("ec_encoded_vid")
+                if not corrupt_mode or vid is None:
+                    return
+                crcs = _eci_crcs(vid)
+                if crcs is None:
+                    return
+                # data shards 1..9 only: 0 would also be hit by legitimate
+                # scenario deletes' neighbors, and the trace scenario
+                # deliberately drops the largest shard ids — injections
+                # must stay distinguishable from scripted shard loss
+                cands = [
+                    (n, s)
+                    for n in nodes
+                    for s in range(1, 10)
+                    if n.alive and not n.wedged
+                    and os.path.exists(ec_shard_path(n.dir, vid, s))
+                ]
+                if not cands:
+                    return
+                node, s = rng.choice(cands)
+                kind = ("bitflip", "truncate", "delete")[corrupt_kind[0] % 3]
+                corrupt_kind[0] += 1
+                if not inject_shard_fault(ec_shard_path(node.dir, vid, s), kind, rng):
+                    return  # raced a repair/kill: next round injects again
+                corruption["injected"].append(
+                    {"node": node.i, "vid": vid, "shard": s, "kind": kind}
+                )
+
             # the inline-ingest scenario runs BEFORE the kill loop (it
             # brings its own SIGKILL): every node is alive, so seeding a
             # fresh non-EC volume with writes is reliable — mid-loop the
@@ -574,6 +695,7 @@ def main() -> int:
                     report["kills"] += 1
                 for _ in range(rng.randrange(2, 6)):
                     write_one()
+                try_corrupt_one()
                 read_all(final=False)
                 if not rebuild_tried and report.get("ec_encoded_vid") is not None:
                     rebuild_tried = True
@@ -601,6 +723,34 @@ def main() -> int:
             time.sleep(8.0)
             read_all(final=True)
 
+            if corrupt_mode:
+                # every injection must have been detected and auto-repaired:
+                # the shard file carries .eci-matching bytes again wherever
+                # a corruption landed (repairs interrupted by the last kill
+                # round get a bounded grace window to finish). Zero
+                # injections = vacuously healed (nothing was at stake),
+                # matching the weedload semantics.
+                if corruption["injected"]:
+                    vid = report["ec_encoded_vid"]
+                    crcs = _eci_crcs(vid)
+                    deadline = time.monotonic() + 120
+                    while time.monotonic() < deadline:
+                        if all(
+                            ec_shard_clean(nodes[e["node"]].dir, vid, e["shard"], crcs)
+                            for e in corruption["injected"]
+                        ):
+                            break
+                        time.sleep(1.0)
+                    for e in corruption["injected"]:
+                        e["healed"] = ec_shard_clean(
+                            nodes[e["node"]].dir, vid, e["shard"], crcs
+                        )
+                corruption["count"] = len(corruption["injected"])
+                corruption["all_healed"] = all(
+                    e["healed"] for e in corruption["injected"]
+                )
+                report["corruption"] = corruption
+
         finally:
             # teardown must run on ANY exit path (a failed form-up assert
             # must not leak three subprocesses writing into the tempdir).
@@ -622,9 +772,14 @@ def main() -> int:
         # with every soak run (weedload's open-loop artifact is the
         # user-facing number; this one is the floor under retries)
         report["latency"] = lat_rec.phases().get("soak", {})
-    report["ok"] = not report["lost"]
+    report["ok"] = not report["lost"] and (
+        not corrupt_mode or bool(report.get("corruption", {}).get("all_healed", True))
+    )
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, "SOAK_r09.json"), "w", encoding="utf-8") as f:
+    # corrupt-mode soaks are this round's artifact; plain soaks keep the
+    # r09 name so the committed inline-ingest evidence is reproducible
+    out_name = "SOAK_r10.json" if corrupt_mode else "SOAK_r09.json"
+    with open(os.path.join(ART, out_name), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["ok"] else 1

@@ -62,6 +62,10 @@ SCRAPED_COUNTERS = (
     "weedtpu_inline_ec_bytes_total",
     "weedtpu_inline_ec_delta_updates_total",
     "weedtpu_inline_ec_seals_total",
+    "weedtpu_scrub_bytes_scanned_total",
+    "weedtpu_scrub_corruptions_found_total",
+    "weedtpu_scrub_repairs_total",
+    "weedtpu_scrub_cycles_total",
 )
 
 
@@ -101,6 +105,14 @@ def parse_args(argv):
                         "bulk slab streams contend with foreground reads "
                         "through the admission gate (servers start with "
                         "WEEDTPU_REBUILD_MAX_INFLIGHT=4 unless overridden)")
+    p.add_argument("--corrupt", action="store_true",
+                   help="inject silent corruption on live servers mid-run "
+                        "(bit-flips, truncations, deletions of EC shard "
+                        "files, cycling) with the background scrubber ON — "
+                        "measures detect -> quarantine -> auto-repair under "
+                        "load, and the SLO with scrub + repair active; "
+                        "every injection is verified healed (bytes match "
+                        "the .eci record again) in the artifact")
     p.add_argument("--wedge-seconds", type=float, default=12.0,
                    help="SIGSTOP duration (must outlast the 10 s per-holder "
                         "transport timeout for the suspicion path to fire)")
@@ -378,11 +390,14 @@ def main(argv=None) -> int:
         args.rps = min(args.rps, 30.0)
         args.chaos = False
     if args.out is None:
-        args.out = (
-            os.path.join(tempfile.gettempdir(), "SLO_smoke.json")
-            if args.smoke
-            else os.path.join(ART, "SLO_r01.json")
-        )
+        if args.smoke:
+            args.out = os.path.join(tempfile.gettempdir(), "SLO_smoke.json")
+        elif args.corrupt:
+            # corruption-soak artifacts join the SOAK_r* family: this is
+            # failure-injection evidence, not a plain latency run
+            args.out = os.path.join(ART, "SOAK_r10.json")
+        else:
+            args.out = os.path.join(ART, "SLO_r01.json")
 
     if args.rebuild_storm:
         # must land BEFORE the server processes start (they read it once
@@ -396,6 +411,14 @@ def main(argv=None) -> int:
         os.environ.setdefault("WEEDTPU_INLINE_EC", "on")
         os.environ.setdefault("WEEDTPU_INLINE_EC_LARGE_BLOCK", str(256 << 10))
         os.environ.setdefault("WEEDTPU_INLINE_EC_SMALL_BLOCK", str(16 << 10))
+    if args.corrupt:
+        # corruption mode runs the scrubber hot (short cycle, no rate cap,
+        # prompt repair retries) so detection latency is scan-bound, not
+        # idle-bound; must land before the server processes start
+        os.environ.setdefault("WEEDTPU_SCRUB", "on")
+        os.environ.setdefault("WEEDTPU_SCRUB_INTERVAL", "0.5")
+        os.environ.setdefault("WEEDTPU_SCRUB_RATE_MB", "0")
+        os.environ.setdefault("WEEDTPU_SCRUB_REPAIR_BACKOFF", "1.0")
 
     rec = slo.LatencyRecorder()
     lost: list[dict] = []
@@ -580,6 +603,76 @@ def main(argv=None) -> int:
                         t.start()
                         storm_threads.append(t)
 
+            corrupt_stop = threading.Event()
+            corrupt_thread = None
+            corruption_report = None
+            if args.corrupt:
+                from seaweedfs_tpu.ec import stripe as stripe_mod
+
+                # injection/healed primitives are SHARED with chaos_soak
+                # so the two harnesses cannot drift on their semantics
+                from chaos_soak import (
+                    ec_shard_clean,
+                    ec_shard_path,
+                    inject_shard_fault,
+                )
+
+                eci = stripe_mod.read_ec_info(base)
+                assert eci and eci.get("shard_crc32"), "corrupt mode needs .eci CRCs"
+                golden_crcs = eci["shard_crc32"]
+                corruption_report = {"injected": [], "all_healed": False}
+
+                def shard_path(node, s: int) -> str:
+                    return ec_shard_path(node.dir, ec_vid, s)
+
+                def shard_clean(node, s: int) -> bool:
+                    return ec_shard_clean(node.dir, ec_vid, s, golden_crcs)
+
+                def corrupt_fn() -> None:
+                    """One corruption at a time, cycling bit-flip ->
+                    truncate -> delete across live holders' shard files,
+                    each verified SELF-HEALED (bytes match the .eci
+                    record again) before the next lands — so the stripe
+                    never carries two concurrent injections and every
+                    entry gets an exact healed-or-not verdict."""
+                    crng = random.Random(args.seed + 9)
+                    kinds = ("bitflip", "truncate", "delete")
+                    k = 0
+                    while not corrupt_stop.is_set():
+                        cands = [
+                            (n, s)
+                            for n in nodes
+                            for s in range(2, 10)
+                            if n.alive and not n.wedged
+                            and os.path.exists(shard_path(n, s))
+                        ]
+                        if not cands:
+                            corrupt_stop.wait(1.0)
+                            continue
+                        node, s = crng.choice(cands)
+                        kind = kinds[k % len(kinds)]
+                        k += 1
+                        if not inject_shard_fault(shard_path(node, s), kind, crng):
+                            continue  # racing repair/kill: pick again
+                        ent = {"node": node.i, "shard": s, "kind": kind}
+                        corruption_report["injected"].append(ent)
+                        t0 = time.monotonic()
+                        deadline = t0 + 60
+                        while (
+                            time.monotonic() < deadline
+                            and not corrupt_stop.is_set()
+                            and not shard_clean(node, s)
+                        ):
+                            corrupt_stop.wait(0.5)
+                        ent["healed"] = shard_clean(node, s)
+                        ent["healed_after_s"] = (
+                            round(time.monotonic() - t0, 2) if ent["healed"] else None
+                        )
+                        corrupt_stop.wait(2.0)
+
+                corrupt_thread = threading.Thread(target=corrupt_fn, daemon=True)
+                corrupt_thread.start()
+
             def chaos_fn(stop: threading.Event) -> None:
                 crng = random.Random(args.seed + 2)
                 while not stop.is_set():
@@ -608,6 +701,9 @@ def main(argv=None) -> int:
             )
             for t in storm_threads:
                 t.join(timeout=10)
+            if corrupt_thread is not None:
+                corrupt_stop.set()
+                corrupt_thread.join(timeout=70)
 
             # -- heal + final zero-loss verification ----------------------
             for n in nodes:
@@ -629,6 +725,27 @@ def main(argv=None) -> int:
                     lost.append({"fid": fid, "why": "unreadable at end"})
                 elif got != want:
                     lost.append({"fid": fid, "why": "BYTES DIFFER"})
+
+            if corruption_report is not None:
+                # final heal verdict: every injected corruption must have
+                # been detected + auto-repaired — shard bytes match the
+                # .eci record again everywhere an injection landed (give
+                # stragglers whose repair raced the run end one last wait)
+                deadline = time.monotonic() + 60
+                def _unhealed():
+                    return [
+                        e for e in corruption_report["injected"]
+                        if not shard_clean(nodes[e["node"]], e["shard"])
+                    ]
+                while time.monotonic() < deadline and _unhealed():
+                    time.sleep(1.0)
+                for e in corruption_report["injected"]:
+                    if not e.get("healed") and shard_clean(
+                        nodes[e["node"]], e["shard"]
+                    ):
+                        e["healed"] = True
+                corruption_report["all_healed"] = not _unhealed()
+                corruption_report["count"] = len(corruption_report["injected"])
 
             # in-process smoke nodes SHARE the module-global stats
             # registry — scraping all three would triple-count; one node's
@@ -683,6 +800,7 @@ def main(argv=None) -> int:
         counters=counters,
         lost=lost,
         slo_factor=args.slo_factor,
+        corruption=corruption_report,
         classes=("healthy", "degraded", "put")
         if args.put_fraction > 0
         else ("healthy", "degraded"),
@@ -691,6 +809,8 @@ def main(argv=None) -> int:
     print(json.dumps(report, indent=1))
     if report["lost"]:
         return 1
+    if args.corrupt and not report["corruption"]["all_healed"]:
+        return 1  # an unhealed injection is as disqualifying as a lost byte
     if args.require_slo and not report["slo"]["ok"]:
         return 2
     return 0

@@ -17,6 +17,7 @@ import base64
 import http.server
 import json
 import os
+import queue
 import random
 import shutil
 import socketserver
@@ -33,6 +34,7 @@ import time
 import grpc
 
 from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.ec import scrub as scrub_mod
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.security import Guard
 from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
@@ -44,7 +46,7 @@ from seaweedfs_tpu.ec.ec_volume import (
 )
 from seaweedfs_tpu.pb import MASTER_SERVICE, VOLUME_SERVICE, Heartbeat
 from seaweedfs_tpu.storage.file_id import FileId
-from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle import CrcError, Needle
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import VolumeReadOnly
 from seaweedfs_tpu.security import tls
@@ -182,6 +184,36 @@ class VolumeServer:
         # nor serves the projection read — the capability-negotiation
         # fallback path): on | off | auto
         self._trace_repair = config.env("WEEDTPU_TRACE_REPAIR")
+        # scrub & self-heal: the background integrity scanner (when the
+        # policy is on) plus the quarantine/repair machinery it feeds.
+        # Repair workers start LAZILY on the first quarantine — ec.verify
+        # with quarantine:true must heal even on servers running with the
+        # continuous scrubber off.
+        self._scrub: Optional[scrub_mod.Scrubber] = None
+        self._repair_q: "queue.Queue[tuple[int, int]]" = queue.Queue()
+        self._repair_threads: list[threading.Thread] = []
+        self._repair_mu = threading.Lock()
+        backoff = float(config.env("WEEDTPU_SCRUB_REPAIR_BACKOFF"))
+        self._repair_policy = scrub_mod.RepairPolicy(
+            base=backoff, max_backoff=12.0 * backoff
+        )
+        # ONE quarantine ledger per server, owned here — NOT by the scan
+        # thread — so pending repairs survive restarts even on servers
+        # running with the continuous scrubber off (ec.verify -quarantine
+        # and verify-on-read quarantine too)
+        self._scrub_cursor = scrub_mod.ScrubCursor(self._scrub_cursor_path())
+        for ent in list(self._scrub_cursor.quarantine):
+            ev = self.store.get_ec_volume(ent["vid"])
+            if ev is not None:
+                ev.quarantine_shard(ent["shard"], ent["reason"])
+            self._enqueue_repair(ent["vid"], ent["shard"])
+        # single-flight guard for verify-on-read healing: concurrent
+        # corrupt-needle reads of one volume must not each launch their
+        # own cluster-wide verify fan-out
+        self._heal_mu = threading.Lock()
+        self._heal_locks: dict[int, threading.Lock] = {}
+        if config.env("WEEDTPU_SCRUB") == "on":
+            self._start_scrub()
         # inline-EC ingest (encode-on-write): when the policy is on, every
         # acked append polls the volume's stripe builder through the
         # Store.on_write seam, so a sealing volume is born EC'd instead of
@@ -225,6 +257,9 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._leave_cluster()
+        if self._scrub is not None:
+            self._scrub.stop()  # persists the cursor; quarantine entries
+            # survive on disk for the next generation's repair queue
         self._http.shutdown()
         self._http.server_close()
         self._grpc.stop()
@@ -578,6 +613,324 @@ class VolumeServer:
             ev.remote_reader = self._remote_reader_for(vid)
         return ev
 
+    # -- scrub & self-heal ----------------------------------------------------
+
+    def _ec_volumes_snapshot(self) -> dict[int, EcVolume]:
+        return {
+            vid: ev
+            for loc in self.store.locations
+            for vid, ev in list(loc.ec_volumes.items())
+        }
+
+    def _scrub_cursor_path(self) -> str:
+        path = config.env("WEEDTPU_SCRUB_CURSOR")
+        if path:
+            return path
+        return os.path.join(
+            self.store.locations[0].directory, ".scrub_cursor.json"
+        )
+
+    def _scrub_admit(self) -> bool:
+        """Admission hook for scrub chunk reads: the scan yields whenever
+        the rebuild lane (WEEDTPU_REBUILD_MAX_INFLIGHT) is saturated —
+        integrity scanning is repair traffic and queues behind both
+        foreground reads (via the rate cap) and actual rebuild streams
+        (via this gate check). The token is probed, not held: a local
+        chunk read is milliseconds, and pinning a slab-stream slot for a
+        whole shard scan would do the starving this hook prevents."""
+        if self._rebuild_gate.acquire(blocking=False):
+            self._rebuild_gate.release()
+            return True
+        return False
+
+    def _start_scrub(self) -> None:
+        # (quarantine entries persisted by a previous generation were
+        # already re-marked and re-queued at __init__ — that recovery must
+        # not depend on the scan thread being enabled)
+        self._scrub = scrub_mod.Scrubber(
+            volumes=self._ec_volumes_snapshot,
+            on_finding=self._scrub_finding,
+            cursor_path=self._scrub_cursor_path(),
+            rate_mb=float(config.env("WEEDTPU_SCRUB_RATE_MB")),
+            chunk_bytes=int(config.env("WEEDTPU_SCRUB_CHUNK")),
+            interval=float(config.env("WEEDTPU_SCRUB_INTERVAL")),
+            admit=self._scrub_admit,
+            cursor=self._scrub_cursor,
+        )
+        self._scrub.start()
+
+    def _scrub_finding(self, vid: int, shard: int, verdict: str) -> None:
+        """Quarantine one failed shard and schedule its automatic repair
+        (called from the scrub thread and the verify RPC). The damaged
+        file moves aside to `.bad` so shard discovery — and the rebuild
+        that is about to run — treats it as missing rather than as a
+        survivor; the bytes stay on disk for forensics until the repair
+        verifies its replacement."""
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            return
+        with self.maintenance_lock(vid):
+            ev.quarantine_shard(shard, verdict)
+            p = stripe.shard_file_name(ev.base, shard)
+            if os.path.exists(p):
+                try:
+                    os.replace(p, p + ".bad")
+                except OSError:
+                    pass  # missing-class findings have nothing to move
+        self._scrub_cursor.add_quarantine(vid, shard, verdict)
+        try:
+            # push the shard delta to the master NOW: peers' degraded
+            # reads re-route to clean holders on their next lookup
+            # instead of burning an attempt on our quarantined copy
+            self.heartbeat_once()
+        except Exception:  # noqa: BLE001 — masters may be down mid-chaos
+            pass
+        self._enqueue_repair(vid, shard)
+
+    def _enqueue_repair(self, vid: int, shard: int) -> None:
+        with self._repair_mu:
+            want = int(config.env("WEEDTPU_SCRUB_MAX_REPAIRS"))
+            while len(self._repair_threads) < want:
+                t = threading.Thread(
+                    target=self._repair_loop,
+                    daemon=True,
+                    name=f"ec-scrub-repair-{len(self._repair_threads)}",
+                )
+                t.start()
+                self._repair_threads.append(t)
+        self._repair_q.put((vid, shard))
+
+    def _repair_loop(self) -> None:
+        """One repair worker: drain quarantined shards, honoring the
+        per-shard backoff clock. Failures re-queue; the worker count
+        (WEEDTPU_SCRUB_MAX_REPAIRS) is the concurrency cap."""
+        while not self._stop.is_set():
+            try:
+                vid, shard = self._repair_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            key = (vid, shard)
+            delay = self._repair_policy.delay(key)
+            if delay > 0:
+                # not due yet: wait a beat, then put it back (bounded at
+                # ~2 requeues/s per pending shard, not a spin)
+                self._stop.wait(min(delay, 0.5))
+                self._repair_q.put(key)
+                continue
+            ok = False
+            try:
+                ok = self._repair_shard(vid, shard)
+            except Exception:  # noqa: BLE001 — any failure re-queues
+                ok = False
+            if ok:
+                self._repair_policy.succeeded(key)
+                stats.ScrubRepairs.labels("ok").inc()
+                self._scrub_cursor.remove_quarantine(vid, shard)
+            else:
+                stats.ScrubRepairs.labels("failed").inc()
+                self._repair_policy.failed(key)
+                self._repair_q.put(key)
+
+    def _repair_shard(self, vid: int, shard: int) -> bool:
+        """One automatic repair attempt for a quarantined shard: pull a
+        clean replica from another holder when one exists (cheapest),
+        else trace-mode rebuild from survivors (slab fallback inside
+        `_ec_rebuild_remote`); either way the bytes ON DISK are
+        re-verified against the `.eci` CRC before the shard re-enters
+        serving. True = repaired (or nothing left to repair)."""
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            return True  # volume unmounted/deleted since: nothing to heal
+        base = ev.base
+        from seaweedfs_tpu.storage.store import parse_base_name
+
+        parsed = parse_base_name(os.path.basename(base))
+        collection = parsed[0] if parsed else ""
+        info = stripe.read_ec_info(base)
+        recorded = (info or {}).get("shard_crc32")
+        if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+            return False  # nothing to verify a repair against
+        want_size = scrub_mod.expected_shard_size(info)
+        path = stripe.shard_file_name(base, shard)
+        produced = os.path.exists(path)  # an earlier repair's rebuild may
+        # already have regenerated this shard (one rebuild call fills
+        # EVERY missing shard of the volume)
+        if not produced:
+            try:
+                self._invalidate_shard_locations(vid)
+                locs = self._lookup_shard_locations(vid)
+            except Exception:  # noqa: BLE001 — master down: try a rebuild
+                locs = {}
+            for addr in locs.get(shard, ()):
+                if self._pull_clean_shard(
+                    addr, vid, collection, base, shard, recorded[shard]
+                ):
+                    produced = True
+                    break
+        if not produced:
+            resp = self._ec_rebuild_remote(
+                vid, collection, base, {"trace_mode": self._trace_repair}
+            )
+            if shard not in resp.get("rebuilt_shard_ids", []):
+                return False
+        # belt + braces: the rebuild CRC-verified its STREAM; this pass
+        # verifies the BYTES ON DISK (a torn local write must not remount)
+        verdict = scrub_mod.scan_shard_file(path, recorded[shard], want_size)
+        if verdict != scrub_mod.OK:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        with self.maintenance_lock(vid):
+            if not ev.mount_local_shard(shard):
+                return False
+            try:
+                os.unlink(path + ".bad")
+            except OSError:
+                pass
+        try:
+            self.heartbeat_once()  # the shard is a holder again
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    def _heal_needle_read(self, vid: int, needle_id: int, cookie=None):
+        """A needle read failed its body crc32c (Needle.from_bytes) — some
+        interval of it was served from a corrupt copy BEFORE the
+        background scrubber reached it. Verify-on-read is the second
+        detection layer: identify the damaged shard (scan the needle's
+        local shards against .eci; failing that, ask every remote holder
+        of the touched shards to verify-and-quarantine via the
+        VolumeEcShardsVerify RPC), quarantine it, and retry the read —
+        with the bad copy out of serving, the ladder reconstructs from
+        clean survivors and the CLIENT NEVER SEES THE CORRUPT BYTES.
+        Raises when no culprit can be identified (nothing left to heal
+        with) — a 500, not silently-served garbage.
+
+        Healing is SINGLE-FLIGHT per volume: concurrent corrupt-needle
+        reads serialize on a per-vid lock and re-try the read first —
+        whoever got there before us likely already quarantined the
+        culprit, so one flipped bit costs one verify fan-out, never a
+        scan storm across every holder per concurrent reader."""
+        with self._heal_mu:
+            lk = self._heal_locks.setdefault(vid, threading.Lock())
+        with lk:
+            try:
+                return self.store.read_ec_needle(vid, needle_id, cookie)
+            except CrcError:
+                pass  # still corrupt: we are the healer
+            return self._heal_needle_read_locked(vid, needle_id, cookie)
+
+    def _heal_needle_read_locked(self, vid: int, needle_id: int, cookie=None):
+        ev = self._open_ec_volume(vid)
+        if ev is None:
+            raise IOError(f"needle {needle_id:x}: body crc mismatch")
+        _, _, intervals = ev.locate_needle(needle_id)
+        touched = sorted(
+            {iv.to_shard_id_and_offset(ev.large, ev.small)[0] for iv in intervals}
+        )
+        info = stripe.read_ec_info(ev.base)
+        recorded = (info or {}).get("shard_crc32")
+        found = False
+        if isinstance(recorded, list) and len(recorded) == TOTAL_SHARDS_COUNT:
+            want_size = scrub_mod.expected_shard_size(info)
+            for s in touched:
+                if s not in ev._shard_files:
+                    continue
+                verdict = scrub_mod.scan_shard_file(
+                    stripe.shard_file_name(ev.base, s), recorded[s], want_size
+                )
+                if verdict != scrub_mod.OK:
+                    stats.ScrubCorruptionsFound.labels(verdict).inc()
+                    self._scrub_finding(vid, s, verdict)
+                    found = True
+        if not found:
+            # the corrupt interval may have been FETCHED from a peer
+            # holder whose scrubber has not reached it: ask every holder
+            # of the touched shards to verify-and-quarantine its copies,
+            # then re-route — the retry lands on a clean replica (or
+            # reconstructs around the quarantined one)
+            try:
+                locs = self._lookup_shard_locations(vid)
+            except Exception:  # noqa: BLE001 — master down: nothing to ask
+                locs = {}
+            for addr in sorted({a for s in touched for a in locs.get(s, ())}):
+                try:
+                    r = self._peer_pool.get(addr).call(
+                        VOLUME_SERVICE,
+                        "VolumeEcShardsVerify",
+                        {"volume_id": vid, "quarantine": True},
+                        timeout=30,
+                    )
+                    if r.get("quarantined"):
+                        found = True
+                except Exception:  # noqa: BLE001 — holder down: next
+                    continue
+            if found:
+                self._invalidate_shard_locations(vid)
+        if not found:
+            raise IOError(
+                f"needle {needle_id:x}: body crc mismatch and no corrupt "
+                "shard could be identified on any holder"
+            )
+        try:
+            return self.store.read_ec_needle(vid, needle_id, cookie)
+        except CrcError as e:
+            # a second corrupt copy survived the quarantine round (e.g.
+            # damage outside the touched shards, or a peer's verify raced
+            # its own repair): surface a typed IOError — the HTTP handler
+            # answers 500 JSON, never a dropped connection
+            raise IOError(
+                f"needle {needle_id:x}: still failing body crc after "
+                "quarantining a corrupt shard — repair in progress"
+            ) from e
+
+    def _pull_clean_shard(
+        self,
+        addr: str,
+        vid: int,
+        collection: str,
+        base: str,
+        shard: int,
+        want_crc: int,
+    ) -> bool:
+        """Re-pull one shard file from a peer holder, CRC-verifying the
+        stream against the `.eci` record BEFORE it replaces anything —
+        the peer's copy may be silently corrupt too (its own scrubber
+        just hasn't reached it), and a repair must never launder bad
+        bytes back into serving."""
+        import zlib
+
+        tmp = base + stripe.to_ext(shard) + ".cpy"
+        try:
+            chunks = self._peer_pool.get(addr).stream(
+                VOLUME_SERVICE,
+                "VolumeEcShardFileCopy",
+                {"volume_id": vid, "collection": collection,
+                 "ext": stripe.to_ext(shard)},
+                timeout=EC_SLAB_READ_TIMEOUT,
+            )
+            crc = 0
+            with open(tmp, "wb") as f:
+                for chunk in chunks:
+                    crc = zlib.crc32(chunk, crc)
+                    f.write(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+            if crc != (want_crc & 0xFFFFFFFF):
+                return False  # replica is damaged too: rebuild instead
+            os.replace(tmp, base + stripe.to_ext(shard))
+            return True
+        except Exception:  # noqa: BLE001 — holder down/short: next option
+            return False
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
     # -- RPC service ---------------------------------------------------------
 
     def _build_service(self) -> rpc.Service:
@@ -595,6 +948,7 @@ class VolumeServer:
         add("VolumeEcShardsGenerate", self._rpc_ec_generate)
         add("VolumeEcShardsCopy", self._rpc_ec_copy)
         add("VolumeEcShardsRebuild", self._rpc_ec_rebuild)
+        add("VolumeEcShardsVerify", self._rpc_ec_verify)
         add("VolumeEcShardsMount", self._rpc_ec_mount)
         add("VolumeEcShardsUnmount", self._rpc_ec_unmount)
         add("VolumeEcShardRead", self._rpc_ec_shard_read, kind="unary_stream", resp_format="bytes")
@@ -836,6 +1190,13 @@ class VolumeServer:
                 "capabilities": (
                     ["slab_projection"] if self._trace_repair != "off" else []
                 ),
+                # shards pulled from serving by failed integrity
+                # verification (scrub/ec.verify), with WHY — operators and
+                # rebuilding peers must be able to tell "quarantined,
+                # repair pending" from "never held here"
+                "quarantined": {
+                    str(s): r for s, r in sorted(ev.quarantined.items())
+                },
             }
         raise rpc.NotFoundFault(f"volume {vid} not found")
 
@@ -873,6 +1234,10 @@ class VolumeServer:
 
         try:
             n = self.store.read_needle(int(req["volume_id"]), int(req["needle_id"]))
+        except CrcError:
+            # same verify-on-read healing as the HTTP path: a repairer
+            # must get clean reconstructed bytes, never corrupt ones
+            n = self._heal_needle_read(int(req["volume_id"]), int(req["needle_id"]))
         except KeyError as e:  # volume or needle gone (racing delete): typed fault
             raise rpc.NotFoundFault(str(e)) from e
         return {
@@ -1627,6 +1992,33 @@ class VolumeServer:
             parts.append(chunk)
         return b"".join(parts)
 
+    def _rpc_ec_verify(self, req: dict, ctx) -> dict:
+        """VolumeEcShardsVerify: CRC-verify this node's local shards of one
+        EC volume against the `.eci` record — the orphaned
+        `verify_local_shards` fsck math, wired into the control plane.
+        With `quarantine: true`, any failing shard is pulled from serving
+        and handed to the automatic-repair queue exactly as a background
+        scrub finding would be; report-only otherwise."""
+        vid = int(req["volume_id"])
+        ev = self.store.get_ec_volume(vid)
+        if ev is None:
+            raise rpc.NotFoundFault(f"ec volume {vid} not mounted")
+        verdicts, has_crcs = scrub_mod.verify_ec_volume(
+            ev, chunk_bytes=int(config.env("WEEDTPU_SCRUB_CHUNK"))
+        )
+        quarantined_now: list[int] = []
+        if req.get("quarantine") and has_crcs:
+            for s, v in sorted(verdicts.items()):
+                if v in scrub_mod.FINDING_CLASSES and s not in ev.quarantined:
+                    stats.ScrubCorruptionsFound.labels(v).inc()
+                    self._scrub_finding(vid, s, v)
+                    quarantined_now.append(s)
+        return {
+            "verdicts": {str(s): v for s, v in sorted(verdicts.items())},
+            "has_crcs": has_crcs,
+            "quarantined": quarantined_now,
+        }
+
     def _rpc_ec_mount(self, req: dict, ctx) -> dict:
         vid = int(req["volume_id"])
         base = self._base_path_for(vid, req.get("collection", ""))
@@ -1848,6 +2240,8 @@ class VolumeServer:
             p = stripe.shard_file_name(base, s)
             if os.path.exists(p):
                 os.remove(p)
+            if os.path.exists(p + ".bad"):  # quarantined original, kept
+                os.remove(p + ".bad")       # for forensics until deletion
         if not stripe.find_local_shards(base):
             for ext in _EC_EXTS:
                 if os.path.exists(base + ext):
@@ -1985,7 +2379,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return
         try:
             self.vs._open_ec_volume(fid.volume_id)  # wire the remote reader
-            n = self.vs.store.read_needle(fid.volume_id, fid.key, cookie=fid.cookie)
+            try:
+                n = self.vs.store.read_needle(
+                    fid.volume_id, fid.key, cookie=fid.cookie
+                )
+            except CrcError:
+                # verify-on-read caught a corrupt copy BEFORE it reached
+                # the client: identify + quarantine the damaged shard
+                # (here or on a peer holder) and serve the clean
+                # reconstruction; raises when nothing can be healed
+                n = self.vs._heal_needle_read(
+                    fid.volume_id, fid.key, cookie=fid.cookie
+                )
         except (KeyError, NeedleNotFound):
             self._reply_json(404, {"error": "not found"}, head=head)
             return

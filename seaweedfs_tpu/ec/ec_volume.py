@@ -97,6 +97,21 @@ class EcDegradedReadTimeout(EcDegradedReadError):
     retry_after = 1.0
 
 
+class EcShardCorrupt(EcDegradedReadError):
+    """The read failed AND this volume has shards quarantined for failed
+    integrity verification — no clean copy could serve the interval. The
+    scrubber's auto-repair is (or will be) rebuilding the quarantined
+    shards, so the retry hint matches the repair timescale, and the
+    operator-facing class says 'corruption', not 'holders down'."""
+
+    retry_after = 5.0
+
+    def __init__(self, msg: str, quarantined: Optional[dict] = None, **kw):
+        super().__init__(msg, **kw)
+        #: {shard_id: reason} snapshot of the volume's quarantine registry
+        self.quarantined = dict(quarantined or {})
+
+
 class _CoalesceSlot:
     """One in-flight degraded decode: the leader publishes its result (or
     error) here and sets the event; waiters read it instead of decoding."""
@@ -194,6 +209,12 @@ class EcVolume:
         self._deleted = set(stripe.read_ecj(base_file_name))
 
         self._shard_files = {}
+        # shards pulled out of serving by failed integrity verification:
+        # {shard_id: reason} ("corrupt" | "truncated" | "missing"). The
+        # serving handle is closed (reads route local -> remote ->
+        # reconstruct around it) and VolumeStatus surfaces the entry so
+        # rebuilding peers and operators see WHY the shard is gone.
+        self.quarantined: dict[int, str] = {}
         self.shard_size = shard_size or 0
         for s in range(TOTAL_SHARDS_COUNT):
             p = stripe.shard_file_name(base_file_name, s)
@@ -290,6 +311,33 @@ class EcVolume:
         f.close()
         return True
 
+    def quarantine_shard(self, shard_id: int, reason: str = "corrupt") -> bool:
+        """Pull a shard that failed integrity verification out of serving:
+        the handle closes (degraded reads route around it instead of
+        decoding garbage into a client response) and the reason is
+        remembered for VolumeStatus / the typed EcShardCorrupt error.
+        Returns whether a serving handle was actually dropped."""
+        self.quarantined[shard_id] = str(reason)
+        return self.drop_local_shard(shard_id)
+
+    def mount_local_shard(self, shard_id: int) -> bool:
+        """(Re)open one shard file for serving — the repair path's remount
+        after a quarantined shard was rebuilt and re-verified. Clears the
+        quarantine entry. False when the file does not exist."""
+        p = stripe.shard_file_name(self.base, shard_id)
+        try:
+            # weedlint: ignore[open-no-ctx] serving handle owned by the volume, closed in close()
+            f = open(p, "rb")
+        except OSError:
+            return False
+        old = self._shard_files.pop(shard_id, None)
+        if old is not None:
+            old.close()
+        self._shard_files[shard_id] = f
+        self.shard_size = max(self.shard_size, os.path.getsize(p))
+        self.quarantined.pop(shard_id, None)
+        return True
+
     # -- index ---------------------------------------------------------------
 
     def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
@@ -319,8 +367,14 @@ class EcVolume:
         f = self._shard_files.get(shard_id)
         if f is None:
             return None
-        f.seek(offset)
-        raw = f.read(size)
+        try:
+            f.seek(offset)
+            raw = f.read(size)
+        except (ValueError, OSError):
+            # handle closed underneath us (concurrent quarantine/unmount)
+            # or the disk faulted mid-read: both mean "this local copy is
+            # unavailable", and the remote/reconstruct ladder owns it
+            return None
         if len(raw) != size:
             # Truncated shard: serving zeros would hand clients corrupt data.
             # Treat as unavailable so the remote/reconstruct fallback kicks in.
@@ -722,6 +776,35 @@ class EcVolume:
                 for s in range(TOTAL_SHARDS_COUNT)
                 if s != shard_id and self._holder_suspected(s)
             )
+            # the corruption class applies only when quarantine is actually
+            # RELEVANT to this failure: the wanted shard itself sits
+            # quarantined, or the quarantined shards are what kept the
+            # survivor count short (with them clean the read would have had
+            # enough). An unrelated quarantined shard during a plain
+            # holder outage must still classify as holders-down.
+            quarantine_blocked = bool(self.quarantined) and (
+                shard_id in self.quarantined
+                or (
+                    not deadline_expired
+                    and have + len(self.quarantined) >= DATA_SHARDS_COUNT
+                )
+            )
+            if quarantine_blocked:
+                # local shards sit quarantined for failed verification and
+                # the stripe still couldn't be served: this is CORRUPTION
+                # awaiting repair, not holders being down — a distinct
+                # class (and retry hint) for clients and dashboards
+                stats.DegradedReadErrors.labels(EcShardCorrupt.__name__).inc()
+                raise EcShardCorrupt(
+                    f"shard {shard_id}: only {have} clean surviving shards "
+                    f"reachable, need {DATA_SHARDS_COUNT}; local shards "
+                    f"{sorted(self.quarantined)} quarantined "
+                    f"({self.quarantined}) — repair pending",
+                    quarantined=self.quarantined,
+                    shard_id=shard_id,
+                    attempted=attempted,
+                    suspected=suspected,
+                )
             cls = EcDegradedReadTimeout if deadline_expired else EcNoViableHolders
             stats.DegradedReadErrors.labels(cls.__name__).inc()
             raise cls(

@@ -815,7 +815,17 @@ class InlineStripeBuilder:
             b.rows_done = rows
             b._durable_rows = rows
             b.crcs = crcs
-            b.crc_valid = not any_delta
+            # CRC provenance contract: the watermark's streamed CRCs are
+            # exact ONLY when nothing mutated shard bytes in place since
+            # they were recorded. Any delta record, any pending overwrite
+            # intent (its resolution below may patch further segments),
+            # or a watermark that dropped its crcs (crc_valid was already
+            # False at record time — folded into any_delta above) forces
+            # seal() to RECOMPUTE the .eci CRCs from the finalized
+            # partials: the sealed record must describe the bytes on
+            # disk, never a stale stream fold that a later fsck/scrub
+            # would flag as corruption on a perfectly healthy volume.
+            b.crc_valid = not any_delta and pending is None
             for h in b._parts:
                 h.truncate(expected)  # drop rows past the durable watermark
             # redo: delta records carry absolute post-state bytes, so
@@ -829,8 +839,6 @@ class InlineStripeBuilder:
                         h = b._parts[s]
                         h.seek(pos)
                         h.write(data)
-            if any_delta:
-                b.crc_valid = False
             # drop any torn tail BEFORE appending: records written after a
             # torn fragment would concatenate onto it and become invisible
             # to every later recovery

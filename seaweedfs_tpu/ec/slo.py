@@ -203,13 +203,18 @@ def assemble_report(
     lost: Optional[list] = None,
     slo_factor: float = 5.0,
     classes: tuple = ("healthy", "degraded"),
+    corruption: Optional[dict] = None,
 ) -> dict:
     """The SLO_r*.json schema (committed-artifact format, BENCH_r* sibling):
     workload parameters, per-phase per-class quantiles, whole-run
     aggregates, the SLO verdict, the chaos ledger, and zero-loss evidence.
     `classes` lists the traffic classes folded into the `overall` section
     — healthy/degraded always (the SLO comparison), plus e.g. `put` when
-    the run offered write traffic (weedload --put-fraction)."""
+    the run offered write traffic (weedload --put-fraction). `corruption`
+    (weedload --corrupt) is the fault-injection ledger: every injected
+    bit-flip/truncation/deletion with its healed verdict — `ok` then also
+    demands all_healed (an unhealed injection is as disqualifying as a
+    lost byte)."""
     merged_classes = tuple(dict.fromkeys(("healthy", "degraded") + tuple(classes)))
     report = {
         "when": time.strftime("%FT%TZ", time.gmtime()),
@@ -225,7 +230,11 @@ def assemble_report(
         "counters": counters or {},
         "lost": lost or [],
     }
-    report["ok"] = not report["lost"]
+    if corruption is not None:
+        report["corruption"] = corruption
+    report["ok"] = not report["lost"] and (
+        corruption is None or bool(corruption.get("all_healed"))
+    )
     return report
 
 

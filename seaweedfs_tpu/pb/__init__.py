@@ -21,7 +21,8 @@ Services and methods (paths are /<service>/<method>):
                          VolumeCompact, VolumeStatus,
                          + the EC surface (SURVEY.md §2.4):
                          VolumeEcShardsGenerate, VolumeEcShardsCopy (stream),
-                         VolumeEcShardsRebuild, VolumeEcShardsMount,
+                         VolumeEcShardsRebuild, VolumeEcShardsVerify,
+                         VolumeEcShardsMount,
                          VolumeEcShardsUnmount, VolumeEcShardRead (stream),
                          VolumeEcBlobDelete, VolumeEcShardsToVolume,
                          VolumeEcShardsDelete

@@ -521,6 +521,82 @@ register(
 )
 
 
+# -- ec.verify ---------------------------------------------------------------
+
+
+def do_ec_verify(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """CRC-verify EC shards against their .eci records on every holder —
+    the control-plane face of the scrubber's math (VolumeEcShardsVerify).
+    Read-only by default; -quarantine pulls failing shards from serving
+    and hands them to the holders' automatic-repair queues."""
+    fl = parse_flags(args, volumeId=0, collection="", quarantine=False)
+    if fl.quarantine:
+        env.confirm_locked()  # mutates serving state on the holders
+    nodes = env.topology_nodes()
+    colls = _ec_collections(env)
+    ec_vids = sorted(
+        {int(e["volume_id"]) for n in nodes for e in n.get("ec_shards", [])}
+    )
+    if fl.volumeId:
+        if fl.volumeId not in ec_vids:
+            raise ShellError(f"ec volume {fl.volumeId} not found")
+        ec_vids = [fl.volumeId]
+    elif fl.collection:
+        ec_vids = [v for v in ec_vids if colls.get(v, "") == fl.collection]
+    bad_total = 0
+    for vid in ec_vids:
+        collection = colls.get(vid, "")
+        for n in nodes:
+            if not _node_shards_of(n, vid):
+                continue
+            try:
+                resp = env.vs_call(
+                    grpc_addr(n),
+                    "VolumeEcShardsVerify",
+                    {
+                        "volume_id": vid,
+                        "collection": collection,
+                        "quarantine": bool(fl.quarantine),
+                    },
+                    timeout=600,  # a full-volume CRC pass, not a ping
+                )
+            except Exception as e:  # noqa: BLE001 — report, keep verifying
+                w.write(f"ec.verify volume {vid} @{n['url']}: ERROR {e}\n")
+                bad_total += 1
+                continue
+            verdicts = {
+                int(s): v for s, v in (resp.get("verdicts") or {}).items()
+            }
+            bad = {s: v for s, v in verdicts.items() if v != "ok"}
+            bad_total += len(bad)
+            line = " ".join(
+                f"{s}={verdicts[s]}" for s in sorted(verdicts)
+            ) or "(no local shards)"
+            if not resp.get("has_crcs"):
+                line += " [no .eci CRC record — unverifiable]"
+            if resp.get("quarantined"):
+                line += f" [quarantined {sorted(resp['quarantined'])} for repair]"
+            w.write(f"ec.verify volume {vid} @{n['url']}: {line}\n")
+    w.write(
+        f"ec.verify: {bad_total} shard(s) failed verification\n"
+        if bad_total
+        else "ec.verify: all shards verified clean\n"
+    )
+
+
+register(
+    ShellCommand(
+        "ec.verify",
+        "ec.verify [-volumeId <id>] [-collection <name>] [-quarantine]\n"
+        "\tCRC-verify every holder's EC shards against the .eci record "
+        "(the scrub\n\tmath, on demand) and print per-shard verdicts; "
+        "-quarantine also pulls\n\tfailing shards from serving and queues "
+        "their automatic trace-repair",
+        do_ec_verify,
+    )
+)
+
+
 # -- ec.decode ---------------------------------------------------------------
 
 

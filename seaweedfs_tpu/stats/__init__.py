@@ -298,8 +298,32 @@ RebuildAdmissionWaits = REGISTRY.counter(
 DegradedReadErrors = REGISTRY.counter(
     "weedtpu_degraded_read_errors_total",
     "degraded reads failed, by typed error class (EcNoViableHolders, "
-    "EcDegradedReadTimeout, HedgeMismatch)",
+    "EcDegradedReadTimeout, EcShardCorrupt, HedgeMismatch)",
     ("class",),
+)
+ScrubBytesScanned = REGISTRY.counter(
+    "weedtpu_scrub_bytes_scanned_total",
+    "EC shard bytes CRC-verified by the background scrubber (rate-capped, "
+    "admission-gated — repair traffic, never foreground)",
+)
+ScrubCorruptionsFound = REGISTRY.counter(
+    "weedtpu_scrub_corruptions_found_total",
+    "shard integrity failures detected by scrub/verify, by class: corrupt "
+    "= CRC32 disagrees with the .eci record, truncated = file shorter "
+    "than the stripe geometry demands, missing = mounted shard whose "
+    "file vanished",
+    ("class",),
+)
+ScrubRepairs = REGISTRY.counter(
+    "weedtpu_scrub_repairs_total",
+    "automatic repairs of quarantined shards, by result (ok = rebuilt or "
+    "re-pulled, re-verified against .eci, and remounted; failed = attempt "
+    "errored and was re-queued with backoff)",
+    ("result",),
+)
+ScrubCycles = REGISTRY.counter(
+    "weedtpu_scrub_cycles_total",
+    "completed full passes of the background shard-integrity scrubber",
 )
 InlineEcRows = REGISTRY.counter(
     "weedtpu_inline_ec_rows_total",

@@ -395,6 +395,55 @@ register_env(
     "the warm full re-encode.",
 )
 register_env(
+    "WEEDTPU_SCRUB", str, "off",
+    "Background shard-integrity scrubber: `on` starts a per-volume-server "
+    "scan thread that CRC32-verifies every mounted EC shard against its "
+    ".eci record in bounded chunks (rate-capped, riding the rebuild "
+    "admission lane), quarantines failures out of serving, and triggers "
+    "automatic trace-repair; `off` (default) leaves verification to the "
+    "explicit ec.verify command.",
+    parse=_enum("on", "off"),
+)
+register_env(
+    "WEEDTPU_SCRUB_RATE_MB", float, 64.0,
+    "Scrub read-rate cap in MB/s per volume server (rolling 1 s window); "
+    "0 = unthrottled. Keeps a full-disk integrity pass from competing "
+    "with foreground reads for disk bandwidth.",
+)
+register_env(
+    "WEEDTPU_SCRUB_CHUNK", int, 4 * 1024 * 1024,
+    "Scrub chunk size in bytes — the unit of admission-gated, rate-"
+    "metered CRC folding (clamped to >= 64 KiB).",
+    parse=_clamped_int(64 * 1024),
+)
+register_env(
+    "WEEDTPU_SCRUB_INTERVAL", float, 30.0,
+    "Seconds the scrubber sleeps between full passes over the mounted EC "
+    "volumes. The persisted cursor makes an interrupted pass resume "
+    "mid-shard across restarts.",
+)
+register_env(
+    "WEEDTPU_SCRUB_CURSOR", str, "",
+    "Path of the fsync'd scrub cursor file (scan progress + pending "
+    "quarantine entries, resumed across restarts). Empty = "
+    "`.scrub_cursor.json` in the server's first storage directory.",
+)
+register_env(
+    "WEEDTPU_SCRUB_REPAIR_BACKOFF", float, 5.0,
+    "Base backoff in seconds between repair attempts for one quarantined "
+    "shard (doubles per failure, capped at 12x the base) — a stripe "
+    "missing too many survivors retries calmly instead of hammering the "
+    "master/holders.",
+)
+register_env(
+    "WEEDTPU_SCRUB_MAX_REPAIRS", int, 1,
+    "Concurrent automatic shard repairs per volume server (clamped to "
+    ">= 1). Each repair is a trace-mode rebuild (or a clean-replica "
+    "re-pull) — capping them keeps a corruption burst from becoming a "
+    "rebuild storm.",
+    parse=_clamped_int(1),
+)
+register_env(
     "WEEDTPU_LOOKUP_RETRIES", int, 2,
     "Bounded retries (with decorrelated jitter) of the single-flight "
     "master shard-location lookup leader before it fails its waiters — "

@@ -460,6 +460,65 @@ def test_resume_resolves_pending_overwrite_intent(tmp_path):
     _assert_identical(base, _warm_reference(tmp_path, bytes(final)))
 
 
+def test_resume_pending_delta_seal_crcs_match_disk(tmp_path):
+    """The stale-CRC seam: a crash-resume with a PENDING overwrite intent
+    (crc_valid=False) followed by a further post-resume delta must force
+    `_recompute_crcs()` before `seal()` writes `.eci` — asserted the
+    strong way, CRC32 of the sealed shard BYTES ON DISK == the `.eci`
+    record (a stale stream-fold here would make every later fsck/scrub
+    pass flag a healthy volume as corrupt)."""
+    import zlib
+
+    base = os.path.join(str(tmp_path), "v", str(VID))
+    data = _write_dat(base, LARGE_ROW * 3 + 123)
+    b = _builder(base)
+    b.poll()
+    b._flush_watermark()  # durable watermark carries VALID streamed CRCs
+    off = LARGE * 3 + 17
+    old = data[off : off + 200]
+    new = bytes(np.random.default_rng(5).integers(0, 256, 200, dtype=np.uint8))
+    # crash mid-overwrite: intent journaled + .dat mutated, delta never ran
+    ingest._append_record(
+        b._journal,
+        {"kind": "ow", "off": off, "old": ingest._b64(old), "new": ingest._b64(new)},
+    )
+    with open(base + ".dat", "r+b") as f:
+        f.seek(off)
+        f.write(new)
+    b._close_handles()
+    r = _resume(base)
+    assert r is not None
+    # the watermark's streamed CRCs can no longer be vouched for: the
+    # pending intent's resolution patched shard bytes in place
+    assert not r.crc_valid
+    # a further post-resume delta through the resumed builder
+    off2 = LARGE * 12 + 5
+    cur = bytearray(data)
+    cur[off : off + 200] = new
+    new2 = bytes(np.random.default_rng(6).integers(0, 256, 100, dtype=np.uint8))
+
+    def mutate():
+        with open(base + ".dat", "r+b") as f:
+            f.seek(off2)
+            f.write(new2)
+
+    patched = r.overwrite(off2, bytes(cur[off2 : off2 + 100]), new2, mutate=mutate)
+    assert patched > 0
+    r.seal()
+    info = stripe.read_ec_info(base)
+    recorded = info["shard_crc32"]
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            assert zlib.crc32(f.read()) == recorded[s], (
+                f"shard {s}: sealed .eci CRC does not match the bytes on disk"
+            )
+    # and the whole set equals the warm conversion of the final .dat
+    final = bytearray(data)
+    final[off : off + 200] = new
+    final[off2 : off2 + 100] = new2
+    _assert_identical(base, _warm_reference(tmp_path, bytes(final), "wseam"))
+
+
 def test_resume_refuses_unknown_dat_mutation(tmp_path):
     """A pending intent whose range matches NEITHER old nor new bytes
     means someone else mutated the .dat — not recoverable, warm fallback."""

@@ -1,0 +1,674 @@
+"""Scrub & self-heal tests: detection classes (bit-flip / truncation /
+deletion) against the `.eci` CRC record, the persisted resumable cursor
+(mid-shard CRC accumulator), repair backoff policy, quarantine semantics
+on EcVolume (reads route around a quarantined shard; EcShardCorrupt when
+no clean copy exists), the VolumeEcShardsVerify RPC + ec.verify shell
+command, and the tier-1 e2e smoke: injected bit-flip -> background detect
+-> quarantine -> automatic trace-repair -> re-verified remount, in-process
+and deterministic."""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.ec import scrub, stripe
+from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ec.ec_volume import (
+    EcDegradedReadError,
+    EcShardCorrupt,
+    EcVolume,
+)
+from seaweedfs_tpu.ops.rs_codec import Encoder
+from seaweedfs_tpu.pb import VOLUME_SERVICE
+from seaweedfs_tpu.utils import config
+
+ENC = Encoder(10, 4, backend="numpy")
+LARGE, SMALL = 16384, 4096
+VID = 9
+
+
+def _build_ec_volume(dirpath: str, size: int = 400_000, seed: int = 3):
+    base = os.path.join(dirpath, str(VID))
+    rng = np.random.default_rng(seed)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    with open(base + ".idx", "wb"):
+        pass
+    stripe.write_ec_files(
+        base, large_block_size=LARGE, small_block_size=SMALL, encoder=ENC
+    )
+    stripe.write_sorted_file_from_idx(base)
+    golden = {}
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            golden[s] = f.read()
+    os.unlink(base + ".dat")
+    return base, golden
+
+
+def _flip_byte(path: str, offset: int = None) -> None:
+    size = os.path.getsize(path)
+    offset = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0x40]))
+
+
+def _wait_for(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# -- detection classes ---------------------------------------------------------
+
+
+def test_expected_shard_size_matches_files(tmp_path):
+    base, golden = _build_ec_volume(str(tmp_path))
+    info = stripe.read_ec_info(base)
+    want = scrub.expected_shard_size(info)
+    for s in range(TOTAL_SHARDS_COUNT):
+        assert os.path.getsize(stripe.shard_file_name(base, s)) == want
+
+
+@pytest.mark.parametrize("klass", ["ok", "corrupt", "truncated", "missing", "oversize"])
+def test_scan_shard_file_classes(tmp_path, klass):
+    base, golden = _build_ec_volume(str(tmp_path))
+    info = stripe.read_ec_info(base)
+    want_size = scrub.expected_shard_size(info)
+    crcs = info["shard_crc32"]
+    p = stripe.shard_file_name(base, 3)
+    if klass == "corrupt":
+        _flip_byte(p)
+        expect = scrub.CORRUPT
+    elif klass == "truncated":
+        os.truncate(p, want_size - 17)
+        expect = scrub.TRUNCATED
+    elif klass == "missing":
+        os.unlink(p)
+        expect = scrub.MISSING
+    elif klass == "oversize":
+        with open(p, "ab") as f:
+            f.write(b"x")  # longer than the geometry allows: unvouchable
+        expect = scrub.CORRUPT
+    else:
+        expect = scrub.OK
+    assert scrub.scan_shard_file(p, crcs[3], want_size, chunk_bytes=8192) == expect
+
+
+def test_scan_shard_file_budget_hook_sees_every_chunk(tmp_path):
+    base, _ = _build_ec_volume(str(tmp_path))
+    info = stripe.read_ec_info(base)
+    want_size = scrub.expected_shard_size(info)
+    seen = []
+    v = scrub.scan_shard_file(
+        stripe.shard_file_name(base, 0),
+        info["shard_crc32"][0],
+        want_size,
+        chunk_bytes=10_000,
+        budget=seen.append,
+    )
+    assert v == scrub.OK
+    assert sum(seen) == want_size
+    assert max(seen) <= 10_000
+
+
+# -- cursor --------------------------------------------------------------------
+
+
+def test_cursor_roundtrip_and_garbage_tolerance(tmp_path):
+    path = str(tmp_path / "cursor.json")
+    c = scrub.ScrubCursor(path)
+    c.point(7, 3, 123456, 0xDEAD)
+    c.cycles = 4
+    c.save()
+    c2 = scrub.ScrubCursor(path)
+    assert (c2.vid, c2.shard, c2.offset, c2.crc, c2.cycles) == (7, 3, 123456, 0xDEAD, 4)
+    with open(path, "w") as f:
+        f.write("{torn garbage")
+    c3 = scrub.ScrubCursor(path)
+    assert (c3.vid, c3.shard, c3.offset, c3.crc) == (0, 0, 0, 0)
+
+
+def test_cursor_quarantine_entries_persist(tmp_path):
+    path = str(tmp_path / "cursor.json")
+    c = scrub.ScrubCursor(path)
+    c.add_quarantine(7, 3, scrub.CORRUPT)
+    c.add_quarantine(7, 3, scrub.CORRUPT)  # dedup
+    c.add_quarantine(8, 1, scrub.TRUNCATED)
+    c2 = scrub.ScrubCursor(path)
+    assert len(c2.quarantine) == 2
+    c2.remove_quarantine(7, 3)
+    c3 = scrub.ScrubCursor(path)
+    assert c3.quarantine == [{"vid": 8, "shard": 1, "reason": scrub.TRUNCATED}]
+
+
+def test_mid_shard_resume_uses_saved_crc_accumulator(tmp_path):
+    """The cursor's (offset, crc) pair makes resume EXACT: scanning the
+    suffix with the saved accumulator must reproduce the full-file
+    verdict — and a WRONG accumulator must flag a clean file, proving
+    the resume actually folds from the cursor instead of rescanning."""
+    base, golden = _build_ec_volume(str(tmp_path))
+    info = stripe.read_ec_info(base)
+    want_size = scrub.expected_shard_size(info)
+    p = stripe.shard_file_name(base, 2)
+    k = want_size // 3
+    prefix_crc = zlib.crc32(golden[2][:k])
+    assert scrub.scan_shard_file(
+        p, info["shard_crc32"][2], want_size, offset=k, crc=prefix_crc
+    ) == scrub.OK
+    assert scrub.scan_shard_file(
+        p, info["shard_crc32"][2], want_size, offset=k, crc=prefix_crc ^ 1
+    ) == scrub.CORRUPT
+
+
+# -- repair policy -------------------------------------------------------------
+
+
+def test_repair_policy_backoff_doubles_and_caps():
+    now = [0.0]
+    pol = scrub.RepairPolicy(base=2.0, max_backoff=10.0, time_fn=lambda: now[0])
+    key = (7, 3)
+    assert pol.due(key)
+    assert pol.failed(key) == 2.0
+    assert not pol.due(key)
+    assert pol.delay(key) == 2.0
+    now[0] = 2.0
+    assert pol.due(key)
+    assert pol.failed(key) == 4.0
+    assert pol.failed(key) == 8.0
+    assert pol.failed(key) == 10.0  # capped
+    assert pol.failed(key) == 10.0
+    pol.succeeded(key)
+    assert pol.due(key)
+
+
+# -- scrubber cycles -----------------------------------------------------------
+
+
+def _mounted(base) -> EcVolume:
+    return EcVolume(base, encoder=ENC, warm_on_mount=False)
+
+
+def test_run_cycle_detects_all_classes_and_reports(tmp_path):
+    base, golden = _build_ec_volume(str(tmp_path))
+    ev = _mounted(base)
+    try:
+        _flip_byte(stripe.shard_file_name(base, 1))
+        os.truncate(stripe.shard_file_name(base, 5), 100)
+        os.unlink(stripe.shard_file_name(base, 9))
+        found = []
+        c0 = {
+            k: stats.ScrubCorruptionsFound.labels(k).value
+            for k in scrub.FINDING_CLASSES
+        }
+        s = scrub.Scrubber(
+            volumes=lambda: {VID: ev},
+            on_finding=lambda vid, sh, v: found.append((vid, sh, v)),
+            cursor_path=str(tmp_path / "cursor.json"),
+            rate_mb=0.0,  # unthrottled for the test
+            chunk_bytes=64 * 1024,
+        )
+        out = s.run_cycle()
+        assert sorted(found) == [
+            (VID, 1, scrub.CORRUPT),
+            (VID, 5, scrub.TRUNCATED),
+            (VID, 9, scrub.MISSING),
+        ]
+        assert sorted(out["findings"]) == sorted(found)
+        assert out["shards_ok"] == TOTAL_SHARDS_COUNT - 3
+        assert out["scanned_bytes"] > 0
+        for k in scrub.FINDING_CLASSES:
+            assert stats.ScrubCorruptionsFound.labels(k).value == c0[k] + 1
+        # a clean second cycle (quarantine the bad ones like the server
+        # policy would) reports nothing
+        for sh, v in ((1, scrub.CORRUPT), (5, scrub.TRUNCATED), (9, scrub.MISSING)):
+            ev.quarantine_shard(sh, v)
+        out2 = s.run_cycle()
+        assert out2["findings"] == []
+        assert s.cursor.cycles == 2
+    finally:
+        ev.close()
+
+
+def test_run_cycle_skips_volumes_without_crc_record(tmp_path):
+    base, _ = _build_ec_volume(str(tmp_path))
+    info = stripe.read_ec_info(base)
+    # strip the CRCs, as a pre-PR-2 volume would look
+    stripe.write_ec_info(
+        base, info["large_block_size"], info["small_block_size"], info["dat_size"]
+    )
+    ev = _mounted(base)
+    try:
+        s = scrub.Scrubber(
+            volumes=lambda: {VID: ev},
+            on_finding=lambda *a: pytest.fail("nothing to find"),
+            cursor_path=str(tmp_path / "cursor.json"),
+            rate_mb=0.0,
+        )
+        out = s.run_cycle()
+        assert out["unverifiable"] == 1 and out["findings"] == []
+    finally:
+        ev.close()
+
+
+def test_scrub_admission_hook_yields_then_proceeds(tmp_path):
+    """A refused admit() parks the scan (bounded sleep) until the lane
+    frees — the scrubber must call it before every chunk read."""
+    base, _ = _build_ec_volume(str(tmp_path), size=120_000)
+    ev = _mounted(base)
+    calls = []
+    gate_open = threading.Event()
+
+    def admit() -> bool:
+        calls.append(1)
+        return gate_open.is_set()
+
+    try:
+        s = scrub.Scrubber(
+            volumes=lambda: {VID: ev},
+            on_finding=lambda *a: None,
+            cursor_path=str(tmp_path / "cursor.json"),
+            rate_mb=0.0,
+            chunk_bytes=64 * 1024,
+            admit=admit,
+        )
+        t = threading.Thread(target=s.run_cycle, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert calls, "admit() must gate every chunk read"
+        assert t.is_alive(), "scan must park while the lane is refused"
+        gate_open.set()
+        t.join(20)
+        assert not t.is_alive()
+    finally:
+        ev.close()
+
+
+def test_interrupted_cycle_preserves_mid_shard_cursor(tmp_path):
+    """stop() during a scan must leave the persisted cursor pointing at
+    the exact mid-shard resume point — a completed-cycle reset here would
+    make every clean restart rescan from the top."""
+    base, _ = _build_ec_volume(str(tmp_path), size=300_000)
+    ev = _mounted(base)
+    admits = [0]
+    parked = threading.Event()
+
+    def admit() -> bool:
+        admits[0] += 1
+        if admits[0] > 3:
+            parked.set()
+            return False  # park the scan mid-shard until stop()
+        return True
+
+    try:
+        s = scrub.Scrubber(
+            volumes=lambda: {VID: ev},
+            on_finding=lambda *a: None,
+            cursor_path=str(tmp_path / "cursor.json"),
+            rate_mb=0.0,
+            chunk_bytes=16 * 1024,
+            interval=3600.0,
+            admit=admit,
+        )
+        s.start()
+        assert parked.wait(10), "scan never reached the parked chunk"
+        s.stop()
+        c = scrub.ScrubCursor(str(tmp_path / "cursor.json"))
+        # the resume point may sit mid-shard (offset > 0, saved CRC
+        # accumulator) or on a shard boundary (the released chunk finished
+        # its file) — what it must NEVER be is the completed-cycle reset
+        assert c.vid == VID and (c.shard > 0 or c.offset > 0), (
+            "interrupted cycle must persist a resume point, got "
+            f"(vid={c.vid}, shard={c.shard}, offset={c.offset})"
+        )
+        assert c.cycles == 0  # the cycle did NOT complete
+    finally:
+        ev.close()
+
+
+def test_quarantine_recovered_on_restart_with_scrubber_off(tmp_path):
+    """Pending quarantine entries must be re-queued at server START even
+    when the continuous scrubber is off (ec.verify/-on-read quarantines
+    exist in that mode too): a server that died mid-repair must finish
+    the heal, not run one shard short forever."""
+    (tmp_path / "srv").mkdir()
+    base, golden = _build_ec_volume(str(tmp_path / "srv"))
+    # previous generation's state: shard 4 quarantined (file aside as
+    # .bad) with the repair still pending in the persisted ledger
+    p = stripe.shard_file_name(base, 4)
+    os.replace(p, p + ".bad")
+    cur = scrub.ScrubCursor(os.path.join(str(tmp_path / "srv"), ".scrub_cursor.json"))
+    cur.add_quarantine(VID, 4, scrub.CORRUPT)
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    ok0 = stats.ScrubRepairs.labels("ok").value
+    vs = VolumeServer([str(tmp_path / "srv")], master.address, heartbeat_interval=0.3)
+    vs.start()
+    try:
+        assert config.env("WEEDTPU_SCRUB") == "off"
+        _wait_for(
+            lambda: stats.ScrubRepairs.labels("ok").value > ok0,
+            timeout=30,
+            msg="restart-recovered repair",
+        )
+        ev = vs.store.get_ec_volume(VID)
+        _wait_for(lambda: 4 in ev.shard_ids, msg="shard remounted")
+        with open(p, "rb") as f:
+            assert f.read() == golden[4]
+        assert not os.path.exists(p + ".bad")
+        # the ledger entry cleared with the verified repair
+        cur2 = scrub.ScrubCursor(vs._scrub_cursor.path)
+        assert cur2.quarantine == []
+    finally:
+        vs.stop()
+        master.stop()
+
+
+# -- quarantine on EcVolume ----------------------------------------------------
+
+
+def test_quarantine_routes_reads_to_reconstruction(tmp_path):
+    """A quarantined shard must stop serving locally and degraded reads
+    must decode the interval from survivors instead — byte-identical."""
+    base, golden = _build_ec_volume(str(tmp_path))
+    ev = _mounted(base)
+    try:
+        want = golden[2][1000:1400]
+        assert ev._read_shard_interval(2, 1000, 400).tobytes() == want
+        # now corrupt + quarantine it: reads must NOT see the bad bytes
+        _flip_byte(stripe.shard_file_name(base, 2), 1100)
+        assert ev.quarantine_shard(2, scrub.CORRUPT)
+        assert 2 not in ev.shard_ids and ev.quarantined == {2: "corrupt"}
+        got = ev._read_shard_interval(2, 1000, 400).tobytes()
+        assert got == want, "reconstruction must serve the CLEAN bytes"
+    finally:
+        ev.close()
+
+
+def test_mount_local_shard_restores_serving_and_clears_quarantine(tmp_path):
+    base, golden = _build_ec_volume(str(tmp_path))
+    ev = _mounted(base)
+    try:
+        ev.quarantine_shard(4, scrub.TRUNCATED)
+        assert 4 not in ev.shard_ids
+        assert ev.mount_local_shard(4)
+        assert 4 in ev.shard_ids and not ev.quarantined
+        assert ev._read_local(4, 0, 64).tobytes() == golden[4][:64]
+    finally:
+        ev.close()
+
+
+def test_ec_shard_corrupt_raised_when_no_clean_copy(tmp_path):
+    base, _ = _build_ec_volume(str(tmp_path))
+    ev = _mounted(base)
+    try:
+        for s in (0, 1, 2, 3, 4):
+            ev.quarantine_shard(s, scrub.CORRUPT)
+        errs0 = stats.DegradedReadErrors.labels("EcShardCorrupt").value
+        with pytest.raises(EcShardCorrupt) as ei:
+            ev._read_shard_interval(0, 0, 128)
+        assert issubclass(EcShardCorrupt, EcDegradedReadError)  # -> HTTP 503
+        assert ei.value.quarantined == {s: "corrupt" for s in range(5)}
+        assert ei.value.retry_after == 5.0
+        assert stats.DegradedReadErrors.labels("EcShardCorrupt").value == errs0 + 1
+    finally:
+        ev.close()
+
+
+# -- knobs ---------------------------------------------------------------------
+
+
+def test_scrub_env_knobs_registered():
+    for name, want in (
+        ("WEEDTPU_SCRUB", "off"),
+        ("WEEDTPU_SCRUB_RATE_MB", 64.0),
+        ("WEEDTPU_SCRUB_CHUNK", 4 * 1024 * 1024),
+        ("WEEDTPU_SCRUB_INTERVAL", 30.0),
+        ("WEEDTPU_SCRUB_CURSOR", ""),
+        ("WEEDTPU_SCRUB_REPAIR_BACKOFF", 5.0),
+        ("WEEDTPU_SCRUB_MAX_REPAIRS", 1),
+    ):
+        assert config.env(name) == want
+
+
+# -- control plane: VolumeEcShardsVerify + ec.verify ---------------------------
+
+
+@pytest.fixture
+def mini_cluster(tmp_path):
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "srv0"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+    vs.start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_verify_rpc_report_only_then_quarantine_repair(mini_cluster, tmp_path):
+    master, vs = mini_cluster
+    d = os.path.dirname(vs._base_path_for(VID))
+    base, golden = _build_ec_volume(d)
+    with rpc.RpcClient(vs.grpc_address) as c:
+        c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+        _flip_byte(stripe.shard_file_name(base, 6))
+        resp = c.call(
+            VOLUME_SERVICE, "VolumeEcShardsVerify", {"volume_id": VID}, timeout=60
+        )
+        assert resp["has_crcs"] is True
+        assert resp["verdicts"]["6"] == "corrupt"
+        assert all(
+            v == "ok" for s, v in resp["verdicts"].items() if s != "6"
+        )
+        assert resp["quarantined"] == []  # report-only by default
+        ev = vs.store.get_ec_volume(VID)
+        assert 6 in ev.shard_ids  # still serving (operator's call)
+        # now with quarantine: the shard leaves serving and repair heals it
+        ok0 = stats.ScrubRepairs.labels("ok").value
+        resp = c.call(
+            VOLUME_SERVICE,
+            "VolumeEcShardsVerify",
+            {"volume_id": VID, "quarantine": True},
+            timeout=60,
+        )
+        assert resp["quarantined"] == [6]
+        st = c.call(VOLUME_SERVICE, "VolumeStatus", {"volume_id": VID})
+        if st.get("quarantined"):  # repair may already have healed it
+            assert st["quarantined"] == {"6": "corrupt"}
+        _wait_for(
+            lambda: stats.ScrubRepairs.labels("ok").value > ok0,
+            timeout=30,
+            msg="automatic repair of the quarantined shard",
+        )
+        ev = vs.store.get_ec_volume(VID)
+        _wait_for(lambda: 6 in ev.shard_ids, msg="shard remounted")
+        assert not ev.quarantined
+        with open(stripe.shard_file_name(base, 6), "rb") as f:
+            assert f.read() == golden[6], "repair must restore exact bytes"
+        assert not os.path.exists(stripe.shard_file_name(base, 6) + ".bad")
+        resp = c.call(
+            VOLUME_SERVICE, "VolumeEcShardsVerify", {"volume_id": VID}, timeout=60
+        )
+        assert all(v == "ok" for v in resp["verdicts"].values())
+
+
+def test_ec_verify_shell_command(mini_cluster):
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+
+    master, vs = mini_cluster
+    d = os.path.dirname(vs._base_path_for(VID))
+    base, _ = _build_ec_volume(d)
+    with rpc.RpcClient(vs.grpc_address) as c:
+        c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": VID})
+    _flip_byte(stripe.shard_file_name(base, 2))
+    env = CommandEnv(master.address)
+    try:
+        _wait_for(
+            lambda: any(
+                int(e.get("volume_id", -1)) == VID
+                for n in env.topology_nodes()
+                for e in n.get("ec_shards", [])
+            ),
+            msg="ec shards in topology",
+        )
+        out = io.StringIO()
+        run_command(env, f"ec.verify -volumeId {VID}", out)
+        text = out.getvalue()
+        assert "2=corrupt" in text
+        assert "failed verification" in text
+        # repair the flip so the volume is clean again, then verify clean
+        _flip_byte(stripe.shard_file_name(base, 2))
+        out = io.StringIO()
+        run_command(env, f"ec.verify -volumeId {VID}", out)
+        assert "all shards verified clean" in out.getvalue()
+    finally:
+        env.close()
+
+
+def test_verify_on_read_heals_corrupt_needle(mini_cluster):
+    """The second detection layer: a client read that races AHEAD of the
+    background scrubber hits the needle body crc32c, and the server must
+    identify + quarantine the corrupt shard and serve the CLEAN
+    reconstruction — corrupt bytes never reach the client, even with the
+    continuous scrubber off."""
+    master, vs = mini_cluster
+    client = MasterClient(master.address)
+    try:
+        blobs = {}
+        for _ in range(10):
+            payload = os.urandom(16_000)
+            r = client.submit(payload)
+            blobs[r.fid] = payload
+        vid = int(next(iter(blobs)).split(",", 1)[0])
+        with rpc.RpcClient(vs.grpc_address) as c:
+            c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+            c.call(
+                VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                {
+                    "volume_id": vid,
+                    "large_block_size": LARGE,
+                    "small_block_size": SMALL,
+                },
+                timeout=120,
+            )
+            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+            c.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
+        base = vs._base_path_for(vid)
+        found0 = stats.ScrubCorruptionsFound.labels("corrupt").value
+        _flip_byte(stripe.shard_file_name(base, 0), 5000)
+        # every read must return byte-exact data — the one that hits the
+        # flipped region heals inline instead of erroring or serving it
+        for fid, want in blobs.items():
+            with urllib.request.urlopen(f"http://{vs.url}/{fid}", timeout=30) as r:
+                assert r.read() == want
+        assert stats.ScrubCorruptionsFound.labels("corrupt").value > found0, (
+            "the corrupt shard should have been detected by verify-on-read"
+        )
+        ev = vs.store.get_ec_volume(vid)
+        ok0 = stats.ScrubRepairs.labels("ok").value
+        _wait_for(
+            lambda: stats.ScrubRepairs.labels("ok").value > ok0
+            or (0 in ev.shard_ids and not ev.quarantined),
+            timeout=30,
+            msg="quarantined shard repaired",
+        )
+    finally:
+        client.close()
+
+
+# -- the e2e smoke: detect -> quarantine -> trace-repair -> re-verify ----------
+
+
+def test_scrub_e2e_bitflip_detect_quarantine_repair(tmp_path, monkeypatch):
+    """The tier-1 scrub smoke (<= 20 s): a server running with the
+    background scrubber ON takes a bit-flip on a live shard; the scan
+    must detect it, quarantine the shard out of serving, trace-repair it
+    from the 13 clean survivors, re-verify against .eci, and remount —
+    with client reads byte-correct THROUGHOUT (never served the flip)."""
+    monkeypatch.setenv("WEEDTPU_SCRUB", "on")
+    monkeypatch.setenv("WEEDTPU_SCRUB_INTERVAL", "0.2")
+    monkeypatch.setenv("WEEDTPU_SCRUB_RATE_MB", "0")  # unthrottled smoke
+    monkeypatch.setenv("WEEDTPU_SCRUB_REPAIR_BACKOFF", "0.3")
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    d = tmp_path / "scrubbed"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+    vs.start()
+    client = MasterClient(master.address)
+    try:
+        # real needles through the real write path, so reads can verify
+        blobs = {}
+        for i in range(6):
+            payload = os.urandom(20_000)
+            r = client.submit(payload)
+            blobs[r.fid] = payload
+        vid = int(next(iter(blobs)).split(",", 1)[0])
+        with rpc.RpcClient(vs.grpc_address) as c:
+            c.call(VOLUME_SERVICE, "VolumeMarkReadonly", {"volume_id": vid})
+            c.call(
+                VOLUME_SERVICE, "VolumeEcShardsGenerate",
+                {
+                    "volume_id": vid,
+                    "large_block_size": LARGE,
+                    "small_block_size": SMALL,
+                },
+                timeout=120,
+            )
+            c.call(VOLUME_SERVICE, "VolumeEcShardsMount", {"volume_id": vid})
+            c.call(VOLUME_SERVICE, "VolumeDelete", {"volume_id": vid})
+        base = vs._base_path_for(vid)
+        info = stripe.read_ec_info(base)
+        golden_crcs = info["shard_crc32"]
+        found0 = stats.ScrubCorruptionsFound.labels("corrupt").value
+        ok0 = stats.ScrubRepairs.labels("ok").value
+        # let at least one clean cycle pass, then inject the flip
+        _wait_for(lambda: stats.ScrubCycles.value > 0, msg="first scrub cycle")
+        target = 1  # a data shard most needles touch
+        _flip_byte(stripe.shard_file_name(base, target))
+        _wait_for(
+            lambda: stats.ScrubCorruptionsFound.labels("corrupt").value > found0,
+            msg="scrub detects the bit-flip",
+        )
+        _wait_for(
+            lambda: stats.ScrubRepairs.labels("ok").value > ok0,
+            msg="automatic repair completes",
+        )
+        ev = vs.store.get_ec_volume(vid)
+        _wait_for(lambda: target in ev.shard_ids, msg="shard remounted")
+        assert not ev.quarantined
+        # re-verified: bytes on disk match the .eci record again
+        with open(stripe.shard_file_name(base, target), "rb") as f:
+            assert zlib.crc32(f.read()) == golden_crcs[target]
+        assert not os.path.exists(stripe.shard_file_name(base, target) + ".bad")
+        # zero corrupt bytes served: every needle reads back byte-exact
+        for fid, want in blobs.items():
+            with urllib.request.urlopen(f"http://{vs.url}/{fid}", timeout=30) as r:
+                assert r.read() == want
+        assert stats.ScrubBytesScanned.value > 0
+        # the persisted cursor survived the cycle machinery
+        assert os.path.exists(os.path.join(str(d), ".scrub_cursor.json"))
+    finally:
+        client.close()
+        vs.stop()
+        master.stop()
