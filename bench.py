@@ -1241,6 +1241,173 @@ def mode_ingest() -> None:
         _emit(_measure_ingest(td))
 
 
+def mode_convert() -> None:
+    """BENCH_MODE=convert: geometry conversion vs the decode->re-encode
+    oracle — byte identity asserted, bytes-moved accounting gated at
+    <= 0.5x the oracle's total I/O for each geometry pair."""
+    import tempfile
+
+    import jax  # noqa: F401
+
+    from seaweedfs_tpu.utils.devices import honor_platform_env
+
+    honor_platform_env()
+    with tempfile.TemporaryDirectory() as td:
+        out = _measure_convert(td)
+    out = {
+        "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kind": "bench_convert",
+        **out,
+    }
+    _emit(out)
+
+
+def _measure_convert(
+    td: str,
+    dat_bytes: int = 192 << 20,
+    large: int = 1 << 20,
+    small: int = 256 << 10,
+    buffer_size: int = 256 << 10,
+    families: tuple = ("cauchy_12_3", "merge_20_4"),
+    encoder=None,
+) -> dict:
+    """`ec.convert`'s engine vs the decode->re-encode oracle on the same
+    volume bytes, one run per target family.
+
+    Conversion: `convert_ec_files` streams the source shard set through
+    the staging-ring pipeline into the staged target (+ journal + on-disk
+    re-verify), instrumenting `bytes_read` (source bytes consumed) and
+    `bytes_written` (target bytes materialized). Oracle: write_dat_file
+    (decode) + write_ec_files on the target geometry — its I/O footprint
+    is MEASURED from the real files (read data shards + write .dat +
+    re-read .dat + write the target set) and asserted equal to the
+    deterministic `reencode_oracle_bytes` formula, so the gate cannot
+    drift from what the oracle actually does. Per family: staged output
+    byte-compared against the oracle's shard set, and
+    `bytes_written / oracle_total <= 0.5` is the committed gate."""
+    import shutil
+
+    import numpy as np
+
+    from seaweedfs_tpu.ec import convert as convert_mod
+    from seaweedfs_tpu.ec import stripe
+    from seaweedfs_tpu.ops.rs_codec import geometry_for, new_encoder
+
+    enc = encoder or new_encoder()
+    rng = np.random.default_rng(41)
+    data = rng.integers(0, 256, dat_bytes, dtype=np.uint8).tobytes()
+    base = os.path.join(td, "src", "7")
+    os.makedirs(os.path.dirname(base))
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+    t0 = time.perf_counter()
+    stripe.write_ec_files(
+        base, large_block_size=large, small_block_size=small,
+        buffer_size=buffer_size, encoder=enc,
+    )
+    src_encode_s = time.perf_counter() - t0
+    src_total = enc.total_shards
+    out: dict = {
+        "section": "ec_convert",
+        "dat_mib": round(dat_bytes / (1 << 20), 2),
+        "large_block": large,
+        "small_block": small,
+        "backend": enc.backend,
+        "src_family": "rs_10_4",
+        "src_encode_s": round(src_encode_s, 3),
+        "protocol": (
+            "convert = convert_ec_files (staged target + .ecc journal + "
+            "on-disk re-verify), bytes_written = target bytes "
+            "materialized; oracle = write_dat_file + write_ec_files on "
+            "the target geometry, oracle_total = measured read data "
+            "shards + write .dat + re-read .dat + write target set "
+            "(asserted == the deterministic reencode_oracle_bytes "
+            "formula); gate: bytes_written / oracle_total <= 0.5 AND "
+            "staged output byte-identical to the oracle's"
+        ),
+        "pairs": {},
+    }
+    ok = True
+    for fam in families:
+        geom = geometry_for(fam)
+        oracle_acct = convert_mod.reencode_oracle_bytes(base, fam)
+        t0 = time.perf_counter()
+        res = convert_mod.convert_ec_files(
+            base, fam, encoder=enc, buffer_size=buffer_size
+        )
+        convert_s = time.perf_counter() - t0
+        # real oracle run, I/O measured from the files it actually touches
+        ob = os.path.join(td, f"oracle_{fam}", "7")
+        os.makedirs(os.path.dirname(ob))
+        for s in range(src_total):
+            shutil.copy(
+                stripe.shard_file_name(base, s), stripe.shard_file_name(ob, s)
+            )
+        shutil.copy(base + ".eci", ob + ".eci")
+        t0 = time.perf_counter()
+        stripe.write_dat_file(ob)
+        decode_s = time.perf_counter() - t0
+        oracle_dat = os.path.getsize(ob + ".dat")
+        for s in range(src_total):
+            os.unlink(stripe.shard_file_name(ob, s))
+        tgt_enc = new_encoder(family=fam, backend=enc.backend)
+        t0 = time.perf_counter()
+        stripe.write_ec_files(
+            ob, large_block_size=large, small_block_size=small,
+            buffer_size=buffer_size, encoder=tgt_enc,
+        )
+        encode_s = time.perf_counter() - t0
+        oracle_tgt = sum(
+            os.path.getsize(stripe.shard_file_name(ob, s))
+            for s in range(geom.total_shards)
+        )
+        measured_total = 3 * oracle_dat + oracle_tgt
+        staged = convert_mod.stage_base(base)
+        match = all(
+            open(stripe.shard_file_name(staged, s), "rb").read()
+            == open(stripe.shard_file_name(ob, s), "rb").read()
+            for s in range(geom.total_shards)
+        )
+        ratio = (
+            round(res["bytes_written"] / oracle_acct["total"], 4)
+            if oracle_acct["total"]
+            else None
+        )
+        pair_ok = (
+            match
+            and measured_total == oracle_acct["total"]
+            and ratio is not None
+            and ratio <= 0.5
+        )
+        ok = ok and pair_ok
+        out["pairs"][fam] = {
+            "target_shards": geom.total_shards,
+            "convert_s": round(convert_s, 3),
+            "oracle_s": round(decode_s + encode_s, 3),
+            "bytes_read": res["bytes_read"],
+            "bytes_written": res["bytes_written"],
+            "reconstructed_bytes": res["reconstructed_bytes"],
+            "oracle_total_bytes": oracle_acct["total"],
+            "oracle_total_measured": measured_total,
+            "moved_over_reencode": ratio,
+            "convert_io_over_reencode": (
+                round(
+                    (res["bytes_read"] + res["bytes_written"])
+                    / oracle_acct["total"],
+                    4,
+                )
+                if oracle_acct["total"]
+                else None
+            ),
+            "match": match,
+            "ok": pair_ok,
+        }
+        convert_mod.discard_staged(base, keep_journal=False)
+    out["gate"] = "bytes_written / oracle_total <= 0.5 per pair"
+    out["ok"] = ok
+    return out
+
+
 def _measure_ingest(
     td: str,
     dat_bytes: int = 192 << 20,
@@ -2028,6 +2195,8 @@ if __name__ == "__main__":
         mode_rebuild_trace()
     elif mode == "ingest":
         mode_ingest()
+    elif mode == "convert":
+        mode_convert()
     elif mode == "dp":
         mode_dp()
     elif mode == "mesh":

@@ -42,6 +42,15 @@ sidecar), and the volume is then sealed with VolumeEcShardsGenerate
 {inline:true} — resume-or-fallback must produce a mountable shard set
 and the final read pass must verify EVERY byte.
 
+`--convert` (kill mode) adds a GEOMETRY-CONVERSION scenario: the EC
+volume's owner is SIGKILLed mid-`ec.convert` (staged target shards +
+the crash-resumable .ecc journal on disk), restarted, and proven to
+still serve every blob through the OLD geometry — staged state must be
+invisible to the read path — before a re-issued convert RESUMES from
+the journal and cuts over to the 20+4 merge layout (stale old-geometry
+shards on other nodes dropped, the shell's post-cutover discipline).
+The final read pass then demands every byte through the new geometry.
+
 `--corrupt` (kill mode) injects SILENT CORRUPTION into live EC shard
 files mid-soak — one bit-flip, truncation, or deletion (cycling) per
 chaos round — with the background scrubber running hot (WEEDTPU_SCRUB=on,
@@ -55,9 +64,10 @@ served to a client shows up as BYTES DIFFER = lost).
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency] \
-          [--inline] [--corrupt]
-Writes artifacts/SOAK_r09.json (SOAK_r10.json with --corrupt) and exits
-nonzero on any lost byte or unhealed injection.
+          [--inline] [--corrupt] [--convert]
+Writes artifacts/SOAK_r09.json (SOAK_r10.json with --corrupt,
+SOAK_r11.json with --convert) and exits nonzero on any lost byte,
+unhealed injection, or incomplete conversion.
 """
 
 from __future__ import annotations
@@ -70,6 +80,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -197,6 +208,7 @@ def main() -> int:
     latency_mode = "--latency" in sys.argv
     inline_mode = "--inline" in sys.argv
     corrupt_mode = "--corrupt" in sys.argv
+    convert_mode = "--convert" in sys.argv
     rng = random.Random(7)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if corrupt_mode:
@@ -237,6 +249,7 @@ def main() -> int:
         "mode": "wedge" if wedge_mode else "kill",
         "inline_ec": inline_mode,
         "corrupt": corrupt_mode,
+        "convert_mode": convert_mode,
         # kill-mode nodes run with this per-RPC server-side sleep on shard/
         # slab reads (the trace scenario needs rebuilds to span wall time);
         # latency quantiles below therefore include it on any degraded read
@@ -658,6 +671,160 @@ def main() -> int:
                     {"node": node.i, "vid": vid, "shard": s, "kind": kind}
                 )
 
+            def try_convert() -> bool:
+                """Geometry-conversion chaos scenario (--convert, kill
+                mode): SIGKILL the EC volume's holder mid-`ec.convert`
+                (staged .cv.* target + .ecc journal on disk), restart it,
+                prove the OLD geometry still serves every blob (staged
+                state is invisible to the read path), then re-issue the
+                convert — it must RESUME from the journal and cut over to
+                merge_20_4, after which stale old-geometry shards on
+                other nodes are dropped (the shell's post-cutover
+                discipline: a stale shard answering a new-geometry locate
+                would serve wrong bytes). The final read pass holds the
+                zero-loss bar through the 24-shard layout."""
+                if not convert_mode or wedge_mode:
+                    return True  # nothing to do in this mode: stop retrying
+                vid = report.get("ec_encoded_vid")
+                if vid is None:
+                    return True
+                if not all(n.alive for n in nodes):
+                    return False  # a dead node would resurrect stale
+                    # old-geometry shards after our cut-over: retry when
+                    # the loop bottom has everyone back up
+                holder, most = None, 0
+                spread: dict[int, list[int]] = {}
+                for n in nodes:
+                    try:
+                        with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                            st = c.call(
+                                VOLUME_SERVICE, "VolumeStatus",
+                                {"volume_id": vid}, timeout=5,
+                            )
+                        sids = list(st.get("shard_ids") or [])
+                        if st.get("kind") == "ec" and sids:
+                            spread[n.i] = sids
+                            if len(sids) > most:
+                                holder, most = n, len(sids)
+                    except Exception:  # noqa: BLE001 — no view of vid
+                        continue
+                if holder is None or most < 10:
+                    return False  # spread too thin to convert: retry
+                outcome: dict = {
+                    "vid": vid, "owner_killed": holder.i, "src_shards": most,
+                }
+
+                def _stage() -> None:
+                    try:
+                        with _rpc.RpcClient(f"127.0.0.1:{holder.grpc}") as c:
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsConvert",
+                                {
+                                    "volume_id": vid,
+                                    "target_family": "merge_20_4",
+                                    "cutover": False,
+                                    # tiny batches/watermarks: many .ecc
+                                    # records, so the kill lands BETWEEN
+                                    # journaled batches and the resume
+                                    # has real progress to pick up
+                                    "max_batch_bytes": 8192,
+                                    "journal_bytes": 8192,
+                                },
+                                timeout=120,
+                            )
+                    except Exception:  # noqa: BLE001 — expected: the
+                        pass  # owner dies mid-call
+
+                try:
+                    th = threading.Thread(target=_stage, daemon=True)
+                    th.start()
+                    # kill when the first fsync'd watermark hits the .ecc
+                    # journal — mid-conversion by construction, not a
+                    # sleep race: the resume then has real journaled
+                    # progress to pick up (and if the tiny volume finishes
+                    # staging first, the re-issued call still resumes from
+                    # the completed journal rather than re-encoding)
+                    jpath = os.path.join(holder.dir, f"{vid}.ecc")
+                    deadline = time.monotonic() + 15
+                    while time.monotonic() < deadline and th.is_alive():
+                        try:
+                            with open(jpath, "rb") as f:
+                                if b'"watermark"' in f.read():
+                                    break
+                        except OSError:
+                            pass
+                        time.sleep(0.005)
+                    holder.kill(hard=True)
+                    report["kills"] += 1
+                    th.join(10)
+                    holder.start()
+                    # the restarted process is alive well before its RPC
+                    # surface is (python + jax startup): wait until it
+                    # answers, or the resume call blames a boot race
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        try:
+                            with _rpc.RpcClient(f"127.0.0.1:{holder.grpc}") as c:
+                                c.call(
+                                    VOLUME_SERVICE, "VolumeStatus",
+                                    {"volume_id": vid}, timeout=5,
+                                )
+                            break
+                        except Exception:  # noqa: BLE001 — still booting
+                            time.sleep(0.5)
+                    # old geometry still serving after the crash
+                    stale = 0
+                    for fid, want in list(blobs.items()):
+                        if int(fid.split(",", 1)[0]) != vid:
+                            continue
+                        got = None
+                        for _ in range(6):
+                            try:
+                                got = client.read(fid)
+                                break
+                            except Exception:  # noqa: BLE001 — holder
+                                time.sleep(0.5)  # still rejoining
+                        if got != want:
+                            stale += 1
+                    outcome["old_geometry_unreadable"] = stale
+                    with _rpc.RpcClient(f"127.0.0.1:{holder.grpc}") as c:
+                        resp = c.call(
+                            VOLUME_SERVICE, "VolumeEcShardsConvert",
+                            {
+                                "volume_id": vid,
+                                "target_family": "merge_20_4",
+                                "cutover": True,
+                            },
+                            timeout=300,
+                        )
+                    for n in nodes:
+                        if n.i == holder.i or not spread.get(n.i):
+                            continue
+                        with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                            c.call(
+                                VOLUME_SERVICE, "VolumeEcShardsDelete",
+                                {"volume_id": vid, "shard_ids": spread[n.i]},
+                                timeout=30,
+                            )
+                    outcome.update(
+                        mode=resp.get("mode"),
+                        target_shards=len(resp.get("shard_ids") or []),
+                        reconstructed_bytes=int(
+                            resp.get("reconstructed_bytes") or 0
+                        ),
+                    )
+                    outcome["completed"] = (
+                        stale == 0
+                        and resp.get("mode") in ("resumed", "converted", "cutover")
+                        and len(resp.get("shard_ids") or []) == 24
+                    )
+                except Exception as e:  # noqa: BLE001 — recorded; reads
+                    # below still hold the zero-loss bar either way
+                    outcome["error"] = str(e)[:200]
+                    outcome["completed"] = False
+                report["convert"] = outcome
+                return True
+
             # the inline-ingest scenario runs BEFORE the kill loop (it
             # brings its own SIGKILL): every node is alive, so seeding a
             # fresh non-EC volume with writes is reliable — mid-loop the
@@ -671,6 +838,7 @@ def main() -> int:
             t_end = time.monotonic() + seconds
             rebuild_tried = False
             trace_tried = False
+            convert_tried = False
             while time.monotonic() < t_end:
                 if not trace_tried and rebuild_tried:
                     # run at loop TOP: every node restarted at the bottom
@@ -678,6 +846,12 @@ def main() -> int:
                     # live non-holder nodes it needs (the scenario brings
                     # its own mid-rebuild kill)
                     trace_tried = try_trace_rebuild()
+                elif convert_mode and not convert_tried and trace_tried:
+                    # after trace: the conversion may find a shard missing
+                    # on its holder (trace dropped one everywhere) — the
+                    # degraded-source path reconstructs it inline, which
+                    # is exactly the production migration posture
+                    convert_tried = try_convert()
                 victim = rng.choice(nodes)
                 if wedge_mode:
                     # wedge rather than kill: the victim stays alive but
@@ -772,13 +946,25 @@ def main() -> int:
         # with every soak run (weedload's open-loop artifact is the
         # user-facing number; this one is the floor under retries)
         report["latency"] = lat_rec.phases().get("soak", {})
-    report["ok"] = not report["lost"] and (
-        not corrupt_mode or bool(report.get("corruption", {}).get("all_healed", True))
+    report["ok"] = (
+        not report["lost"]
+        and (
+            not corrupt_mode
+            or bool(report.get("corruption", {}).get("all_healed", True))
+        )
+        and (
+            not convert_mode
+            or bool(report.get("convert", {}).get("completed", False))
+        )
     )
     os.makedirs(ART, exist_ok=True)
-    # corrupt-mode soaks are this round's artifact; plain soaks keep the
-    # r09 name so the committed inline-ingest evidence is reproducible
-    out_name = "SOAK_r10.json" if corrupt_mode else "SOAK_r09.json"
+    # convert-mode soaks are this round's artifact; corrupt/plain soaks
+    # keep their r10/r09 names so committed evidence is reproducible
+    out_name = (
+        "SOAK_r11.json"
+        if convert_mode
+        else "SOAK_r10.json" if corrupt_mode else "SOAK_r09.json"
+    )
     with open(os.path.join(ART, out_name), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))

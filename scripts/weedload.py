@@ -66,6 +66,8 @@ SCRAPED_COUNTERS = (
     "weedtpu_scrub_corruptions_found_total",
     "weedtpu_scrub_repairs_total",
     "weedtpu_scrub_cycles_total",
+    "weedtpu_ec_convert_bytes_total",
+    "weedtpu_ec_convert_seconds_count",
 )
 
 

@@ -34,6 +34,7 @@ import time
 import grpc
 
 from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.ec import convert as convert_mod
 from seaweedfs_tpu.ec import scrub as scrub_mod
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.security import Guard
@@ -724,8 +725,12 @@ class VolumeServer:
                 ok = False
             if ok:
                 self._repair_policy.succeeded(key)
-                stats.ScrubRepairs.labels("ok").inc()
+                # ledger first: the ok counter is the observable "repair
+                # finished" signal (tests and operators poll it), so the
+                # persisted quarantine entry must already be gone when it
+                # ticks
                 self._scrub_cursor.remove_quarantine(vid, shard)
+                stats.ScrubRepairs.labels("ok").inc()
             else:
                 stats.ScrubRepairs.labels("failed").inc()
                 self._repair_policy.failed(key)
@@ -748,7 +753,8 @@ class VolumeServer:
         collection = parsed[0] if parsed else ""
         info = stripe.read_ec_info(base)
         recorded = (info or {}).get("shard_crc32")
-        if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+        want_len = stripe.geometry_from_info(info).total_shards
+        if not isinstance(recorded, list) or len(recorded) != want_len:
             return False  # nothing to verify a repair against
         want_size = scrub_mod.expected_shard_size(info)
         path = stripe.shard_file_name(base, shard)
@@ -833,7 +839,7 @@ class VolumeServer:
         info = stripe.read_ec_info(ev.base)
         recorded = (info or {}).get("shard_crc32")
         found = False
-        if isinstance(recorded, list) and len(recorded) == TOTAL_SHARDS_COUNT:
+        if isinstance(recorded, list) and len(recorded) == stripe.geometry_from_info(info).total_shards:
             want_size = scrub_mod.expected_shard_size(info)
             for s in touched:
                 if s not in ev._shard_files:
@@ -948,6 +954,7 @@ class VolumeServer:
         add("VolumeEcShardsGenerate", self._rpc_ec_generate)
         add("VolumeEcShardsCopy", self._rpc_ec_copy)
         add("VolumeEcShardsRebuild", self._rpc_ec_rebuild)
+        add("VolumeEcShardsConvert", self._rpc_ec_convert)
         add("VolumeEcShardsVerify", self._rpc_ec_verify)
         add("VolumeEcShardsMount", self._rpc_ec_mount)
         add("VolumeEcShardsUnmount", self._rpc_ec_unmount)
@@ -1197,6 +1204,12 @@ class VolumeServer:
                 "quarantined": {
                     str(s): r for s, r in sorted(ev.quarantined.items())
                 },
+                # recorded geometry: ec.convert's pre-copy pulls only the
+                # <= k shards the conversion reads, and shell maintenance
+                # (ec.rebuild) scans missing shards over THIS volume's
+                # total, not the legacy 14
+                "data_shards": ev.data_shards,
+                "total_shards": ev.total_shards,
             }
         raise rpc.NotFoundFault(f"volume {vid} not found")
 
@@ -1387,8 +1400,11 @@ class VolumeServer:
             stripe.write_sorted_file_from_idx(v.base_path)
         stats.EcEncodeSeconds.observe(time.monotonic() - t0)
         stats.EcEncodeBytes.inc(os.path.getsize(v.base_path + ".dat"))
+        total = stripe.geometry_from_info(
+            stripe.read_ec_info(v.base_path)
+        ).total_shards
         return {
-            "shard_ids": list(range(TOTAL_SHARDS_COUNT)),
+            "shard_ids": list(range(total)),
             "mode": info.get("mode", "warm"),
             "inline_rows": int(info.get("rows_inline", 0)),
             "delta_updates": int(info.get("delta_updates", 0)),
@@ -1515,7 +1531,9 @@ class VolumeServer:
         base = self._base_path_for(vid, collection)
         t0 = time.monotonic()
         if not req.get("remote"):
-            rebuilt = stripe.rebuild_ec_files(base, encoder=self.store.encoder)
+            rebuilt = stripe.rebuild_ec_files(
+                base, encoder=stripe.encoder_for_base(base, self.store.encoder)
+            )
             stats.EcRebuildSeconds.observe(time.monotonic() - t0)
             return {"rebuilt_shard_ids": rebuilt}
         resp = self._ec_rebuild_remote(vid, collection, base, req)
@@ -1539,13 +1557,14 @@ class VolumeServer:
             locs = self._lookup_shard_locations(vid)
             local = set(stripe.find_local_shards(base))
             present = sorted(local | set(locs))
-            missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+            enc = stripe.encoder_for_base(base, self.store.encoder)
+            missing = [s for s in range(enc.total_shards) if s not in present]
             if not missing:
                 return {"rebuilt_shard_ids": []}
-            if len(present) < DATA_SHARDS_COUNT:
+            if len(present) < enc.data_shards:
                 raise rpc.RpcFault(
                     f"cannot rebuild volume {vid}: only {len(present)} survivors "
-                    f"reachable, need {DATA_SHARDS_COUNT}",
+                    f"reachable, need {enc.data_shards}",
                     code=grpc.StatusCode.FAILED_PRECONDITION,
                 )
             holders = sorted({a for addrs in locs.values() for a in addrs})
@@ -1560,7 +1579,7 @@ class VolumeServer:
                 tuning["max_batch_bytes"] = int(req["max_batch_bytes"])
             if int(req.get("prefetch_batches") or 0) > 0:
                 tuning["prefetch_batches"] = int(req["prefetch_batches"])
-            chosen = present[:DATA_SHARDS_COUNT]
+            chosen = present[: enc.data_shards]
             remote_needed = [s for s in chosen if s not in local]
             resp = {
                 "local_survivors": sorted(local & set(chosen)),
@@ -1583,7 +1602,7 @@ class VolumeServer:
                 # not actually move fewer bytes than the slabs it replaces
                 # (fully-spread placements with several missing shards).
                 groups, labels, plan_reason = self._plan_trace_groups(
-                    vid, base, chosen, missing, locs, holder_caps, local
+                    vid, base, chosen, missing, locs, holder_caps, local, enc
                 )
                 if groups is not None and trace_mode == "auto":
                     remote_groups = sum(1 for g in groups if g.holder != "local")
@@ -1606,7 +1625,7 @@ class VolumeServer:
                                 groups,
                                 shard_size,
                                 missing,
-                                encoder=self.store.encoder,
+                                encoder=enc,
                                 **tuning,
                             )
                             wire = sum(g.bytes_fetched for g in groups)
@@ -1661,7 +1680,7 @@ class VolumeServer:
                     base,
                     sources,
                     shard_size,
-                    encoder=self.store.encoder,
+                    encoder=enc,
                     missing=missing,
                     **tuning,
                 )
@@ -1705,6 +1724,7 @@ class VolumeServer:
         locs: dict[int, list[str]],
         holder_caps: dict[str, set],
         local: set[int],
+        enc=None,
     ):
         """Group the chosen survivors onto projection-capable holders:
         -> (groups, labels, "") on success, (None, [], reason) when trace
@@ -1730,7 +1750,7 @@ class VolumeServer:
                 f"survivors {sorted(uncovered)} have no projection-capable "
                 "holder"
             )
-        plan = self.store.encoder.repair_projection_plan(chosen, missing)
+        plan = (enc or self.store.encoder).repair_projection_plan(chosen, missing)
         rows = len(missing)
         assign: dict[str, list[int]] = {}
         remaining = set(remote_needed)
@@ -1755,7 +1775,7 @@ class VolumeServer:
                     stripe.LocalProjectionSource(
                         [stripe.shard_file_name(base, s) for s in local_chosen],
                         np.stack([plan[s] for s in local_chosen], axis=1),
-                        self.store.encoder,
+                        enc or self.store.encoder,
                     )
                 )
                 labels.append("local=" + "+".join(str(s) for s in local_chosen))
@@ -1992,6 +2012,75 @@ class VolumeServer:
             parts.append(chunk)
         return b"".join(parts)
 
+    def _rpc_ec_convert(self, req: dict, ctx) -> dict:
+        """VolumeEcShardsConvert: re-encode this node's shard set of one
+        EC volume into a different registered code family WITHOUT a
+        decode->re-encode round trip — data blocks regroup, new parity is
+        a GF projection of surviving shards, and the staged target
+        (<base>.cv.*) is built while the OLD geometry keeps serving.
+        Rides the per-volume maintenance lock (never interleaves with
+        compact/copy/generate), journals crash-resumable progress to the
+        .ecc sidecar, and — with `cutover: true` — re-verifies the staged
+        bytes on disk against the new .eci before atomically retiring the
+        old geometry and remounting."""
+        vid = int(req["volume_id"])
+        collection = req.get("collection", "")
+        base = self._base_path_for(vid, collection)
+        family = str(req.get("target_family") or "")
+        t0 = time.monotonic()
+        kwargs: dict = {}
+        if int(req.get("max_batch_bytes") or 0) > 0:
+            kwargs["max_batch_bytes"] = int(req["max_batch_bytes"])
+        if int(req.get("journal_bytes") or 0) > 0:
+            kwargs["journal_bytes"] = int(req["journal_bytes"])
+        with self.maintenance_lock(vid):
+            if not stripe.find_local_shards(base):
+                raise rpc.NotFoundFault(f"no local shards for volume {vid}")
+            try:
+                res = convert_mod.convert_ec_files(
+                    base, family, encoder=self.store.encoder, **kwargs
+                )
+                if req.get("cutover") and res["mode"] != "noop":
+                    # retire the old geometry under the same lock: the
+                    # serving handles close, the staged set swaps in
+                    # (.eci first — a crash window refuses to mount
+                    # rather than misreads), and the volume remounts as
+                    # its new geometry. Reads block only for the swap.
+                    self.store.unmount_ec_volume(vid)
+                    try:
+                        if res["mode"] != "cutover":
+                            convert_mod.cutover(base)
+                    except BaseException:
+                        # the swap did not happen (staged state torn/gone
+                        # between stage and cut-over): the intact OLD
+                        # geometry must come back into serving rather
+                        # than leave a healthy volume dark until restart
+                        try:
+                            self.store.mount_ec_volume(vid, base)
+                        except Exception:  # noqa: BLE001 — a half-swapped
+                            pass  # set refuses to mount; resume heals it
+                        raise
+                    self.store.mount_ec_volume(vid, base)
+            except (convert_mod.ConversionError, ValueError) as e:
+                raise rpc.RpcFault(
+                    f"convert volume {vid} -> {family!r}: {e}",
+                    code=grpc.StatusCode.FAILED_PRECONDITION,
+                )
+        stats.EcConvertSeconds.observe(time.monotonic() - t0)
+        try:
+            self.heartbeat_once()  # shard-id delta (e.g. 14 -> 24 shards)
+        except Exception:  # noqa: BLE001 — master down: next beat carries it
+            pass
+        return {
+            "shard_ids": res["shard_ids"],
+            "src_family": res["src_family"],
+            "target_family": res["target_family"],
+            "bytes_read": int(res["bytes_read"]),
+            "bytes_written": int(res["bytes_written"]),
+            "reconstructed_bytes": int(res["reconstructed_bytes"]),
+            "mode": res["mode"],
+        }
+
     def _rpc_ec_verify(self, req: dict, ctx) -> dict:
         """VolumeEcShardsVerify: CRC-verify this node's local shards of one
         EC volume against the `.eci` record — the orphaned
@@ -2153,7 +2242,7 @@ class VolumeServer:
             )
         rows = int(req.get("projection_rows") or 0)
         terms = req["projection"]
-        if rows <= 0 or rows > TOTAL_SHARDS_COUNT:
+        if rows <= 0 or rows > ev.total_shards:
             raise rpc.RpcFault(f"bad projection_rows {rows}")
         sids: list[int] = []
         coeff_cols: list[bytes] = []
@@ -2236,7 +2325,7 @@ class VolumeServer:
         shard_ids = [int(s) for s in req.get("shard_ids", [])]
         base = self._base_path_for(vid, req.get("collection", ""))
         self.store.unmount_ec_volume(vid)
-        for s in shard_ids or range(TOTAL_SHARDS_COUNT):
+        for s in shard_ids or stripe.find_local_shards(base):
             p = stripe.shard_file_name(base, s)
             if os.path.exists(p):
                 os.remove(p)

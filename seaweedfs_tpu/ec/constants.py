@@ -8,6 +8,14 @@ DATA_SHARDS_COUNT = 10
 PARITY_SHARDS_COUNT = 4
 TOTAL_SHARDS_COUNT = DATA_SHARDS_COUNT + PARITY_SHARDS_COUNT
 
+# Upper bound on shard ids ANY registered code geometry may use. The
+# legacy layout above stays the wire/default geometry; geometry-flexible
+# volumes (ec.convert targets such as 12+3 or the 10+4 -> 20+4 stripe
+# merge) record their own (k, m) in the .eci sidecar. Discovery scans and
+# ShardBits masks size to this bound, not to the legacy 14 — a uint32
+# shard bitmask caps it at 32.
+MAX_SHARD_COUNT = 32
+
 # Two-tier striping: large rows first, then the tail as small rows.
 ERASURE_CODING_LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1 GiB
 ERASURE_CODING_SMALL_BLOCK_SIZE = 1024 * 1024  # 1 MiB

@@ -29,10 +29,8 @@ from seaweedfs_tpu.ec import locate as locate_mod
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.ec import suspicion as suspicion_mod
 from seaweedfs_tpu.ec.constants import (
-    DATA_SHARDS_COUNT,
     ERASURE_CODING_LARGE_BLOCK_SIZE,
     ERASURE_CODING_SMALL_BLOCK_SIZE,
-    TOTAL_SHARDS_COUNT,
 )
 from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
 from seaweedfs_tpu.storage import idx as idx_mod
@@ -49,6 +47,22 @@ class NeedleNotFound(KeyError):
 
 class NeedleDeleted(Exception):
     pass
+
+
+class EcGeometryError(ValueError):
+    """The on-disk shard set contradicts the .eci-recorded geometry —
+    shard ids past the recorded total, or a shard file longer than the
+    layout allows. Mounting anyway would silently mis-map every interval
+    (before geometry validation, a wrong-geometry shard set was only
+    caught by CRC luck on the first degraded read). Typed so the volume
+    server can refuse the mount loudly and discovery can skip the volume
+    instead of serving garbage."""
+
+    def __init__(self, msg: str, base: str = "", details: Optional[dict] = None):
+        super().__init__(msg)
+        self.base = base
+        #: machine-readable mismatch description (shard ids / sizes)
+        self.details = dict(details or {})
 
 
 class EcDegradedReadError(IOError):
@@ -191,6 +205,15 @@ class EcVolume:
         else:
             self.large = large_block_size
             self.small = small_block_size
+        # code geometry: recorded in the .eci for geometry-flexible volumes
+        # (ec.convert targets), implied legacy 10+4 otherwise. The serving
+        # encoder must MATCH it — a caller-supplied encoder of a different
+        # geometry is replaced by a same-backend sibling, never trusted to
+        # decode a layout it does not describe.
+        self.geometry = stripe.geometry_from_info(info)
+        self.data_shards = self.geometry.data_shards
+        self.total_shards = self.geometry.total_shards
+        self.encoder = stripe.encoder_for_info(info, self.encoder)
 
         # mount-time journal compaction: a delete-heavy volume's .ecj is
         # folded into .ecx tombstones once it crosses the threshold, so the
@@ -216,12 +239,19 @@ class EcVolume:
         # rebuilding peers and operators see WHY the shard is gone.
         self.quarantined: dict[int, str] = {}
         self.shard_size = shard_size or 0
-        for s in range(TOTAL_SHARDS_COUNT):
-            p = stripe.shard_file_name(base_file_name, s)
-            if os.path.exists(p):
-                # weedlint: ignore[open-no-ctx] serving handles owned by the volume, closed in close()
-                self._shard_files[s] = open(p, "rb")
-                self.shard_size = max(self.shard_size, os.path.getsize(p))
+        try:
+            self._validate_geometry(info)
+            for s in range(self.total_shards):
+                p = stripe.shard_file_name(base_file_name, s)
+                if os.path.exists(p):
+                    # weedlint: ignore[open-no-ctx] serving handles owned by the volume, closed in close()
+                    self._shard_files[s] = open(p, "rb")
+                    self.shard_size = max(self.shard_size, os.path.getsize(p))
+        except BaseException:
+            for f in self._shard_files.values():
+                f.close()
+            self._shard_files.clear()
+            raise
         if self.shard_size == 0 and remote_reader is not None and len(self._index):
             # No local shard to size the volume from: large-vs-small row math
             # would silently mis-map offsets, so demand an explicit size.
@@ -235,7 +265,7 @@ class EcVolume:
         if info is not None:
             self.dat_file_size = int(info["dat_size"])
         else:
-            self.dat_file_size = self.shard_size * DATA_SHARDS_COUNT
+            self.dat_file_size = self.shard_size * self.data_shards
 
         # resident hot path (SURVEY §7.3.5): pre-build the serving-path
         # decode matrices and pre-compile the bucketed reconstruct shapes in
@@ -245,6 +275,66 @@ class EcVolume:
         if warm_on_mount:
             self.warm_thread = threading.Thread(target=self._warm, daemon=True)
             self.warm_thread.start()
+
+    def _validate_geometry(self, info: Optional[dict]) -> None:
+        """Mount-time shard-count/geometry consistency gate: the local
+        shard set must FIT the .eci-recorded (or legacy-implied) geometry.
+        Stray shard ids past the recorded total, or a shard file longer
+        than the recorded layout allows, mean the files and the sidecar
+        describe different codes — reading on would silently mis-map
+        intervals (previously only caught by CRC luck), so the mount
+        raises typed EcGeometryError instead."""
+        # a journaled-but-unfinished conversion cut-over means `.eci` and
+        # the shard files may describe DIFFERENT geometries (the .eci
+        # swaps first; the journal is unlinked last) — and when the two
+        # layouts' shard sizes coincide, neither the stray-id nor the
+        # over-length check below can tell. Refuse until the convert
+        # resume path finishes the swap.
+        from seaweedfs_tpu.ec import convert as convert_mod
+
+        if convert_mod.pending_cutover(self.base):
+            raise EcGeometryError(
+                f"{self.base}: conversion cut-over in progress (journaled "
+                "intent, swap unfinished) — resume `ec.convert` to finish "
+                "the swap before mounting",
+                base=self.base,
+                details={"pending_cutover": True},
+            )
+        stray = [
+            s
+            for s in stripe.find_local_shards(self.base)
+            if s >= self.total_shards
+        ]
+        if stray:
+            raise EcGeometryError(
+                f"{self.base}: shard files {stray} exceed the recorded "
+                f"{self.geometry.family} geometry "
+                f"({self.data_shards}+{self.geometry.parity_shards}) — "
+                "wrong-geometry shard set?",
+                base=self.base,
+                details={"stray_shards": stray, "family": self.geometry.family},
+            )
+        if info is None:
+            return  # legacy sidecar-less set: sizes are unvouchable
+        n_large, n_small = stripe.stripe_layout(
+            int(info["dat_size"]), self.large, self.small, self.data_shards
+        )
+        expected = n_large * self.large + n_small * self.small
+        over = {
+            s: os.path.getsize(stripe.shard_file_name(self.base, s))
+            for s in stripe.find_local_shards(self.base, self.total_shards)
+            if os.path.getsize(stripe.shard_file_name(self.base, s)) > expected
+        }
+        if over:
+            # over-length is a GEOMETRY contradiction (a truncated shard is
+            # bit-rot/crash damage and stays the scrub ladder's business)
+            raise EcGeometryError(
+                f"{self.base}: shard files longer than the recorded layout "
+                f"allows ({over} > {expected} bytes for "
+                f"{self.geometry.family}) — wrong-geometry shard set?",
+                base=self.base,
+                details={"over_length": over, "expected_size": expected},
+            )
 
     def _warm(self) -> None:
         try:
@@ -284,7 +374,7 @@ class EcVolume:
         recording (no shard_crc32 in the sidecar)."""
         info = stripe.read_ec_info(self.base)
         recorded = (info or {}).get("shard_crc32")
-        if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+        if not isinstance(recorded, list) or len(recorded) != self.total_shards:
             return None
         out = {}
         for s in sorted(self._shard_files):
@@ -357,7 +447,8 @@ class EcVolume:
         offset, size = self.find_needle_from_ecx(needle_id)
         whole = types.actual_size(size, self.version)
         intervals = locate_mod.locate_data(
-            self.large, self.small, self.dat_file_size, offset, whole
+            self.large, self.small, self.dat_file_size, offset, whole,
+            self.data_shards,
         )
         return offset, size, intervals
 
@@ -570,17 +661,17 @@ class EcVolume:
         """Collect >= DATA_SHARDS survivor copies of one interval (local
         first, then a parallel remote fan-out). Raises IOError when too few
         survivors are reachable."""
-        shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
+        shards: list[Optional[np.ndarray]] = [None] * self.total_shards
         have = 0
         # local shards first — remote reads cost RTTs on the p50-critical path
-        for s in range(TOTAL_SHARDS_COUNT):
-            if s == shard_id or have >= DATA_SHARDS_COUNT:
+        for s in range(self.total_shards):
+            if s == shard_id or have >= self.data_shards:
                 continue
             buf = self._read_local(s, offset, size)
             if buf is not None:
                 shards[s] = buf
                 have += 1
-        need = DATA_SHARDS_COUNT - have
+        need = self.data_shards - have
         attempted: tuple = ()
         deadline_expired = False
         if need > 0 and self.remote_reader is not None:
@@ -597,7 +688,7 @@ class EcVolume:
             # inside its backoff window would just burn a pool thread
             candidates = [
                 s
-                for s in range(TOTAL_SHARDS_COUNT)
+                for s in range(self.total_shards)
                 if s != shard_id
                 and shards[s] is None
                 and not self._holder_suspected(s)
@@ -635,7 +726,7 @@ class EcVolume:
             deadline = _time.monotonic() + self.recover_fetch_deadline
             cap = self.recover_holder_timeout
             try:
-                while pending and have < DATA_SHARDS_COUNT:
+                while pending and have < self.data_shards:
                     now = _time.monotonic()
                     for fut in list(pending):
                         sid = futs[fut]
@@ -770,10 +861,10 @@ class EcVolume:
                 # a reference to its buffer (or an unobserved error).
                 for fut in pending:
                     stripe._abandon_future(fut)
-        if have < DATA_SHARDS_COUNT:
+        if have < self.data_shards:
             suspected = tuple(
                 self._holder_key(s)
-                for s in range(TOTAL_SHARDS_COUNT)
+                for s in range(self.total_shards)
                 if s != shard_id and self._holder_suspected(s)
             )
             # the corruption class applies only when quarantine is actually
@@ -786,7 +877,7 @@ class EcVolume:
                 shard_id in self.quarantined
                 or (
                     not deadline_expired
-                    and have + len(self.quarantined) >= DATA_SHARDS_COUNT
+                    and have + len(self.quarantined) >= self.data_shards
                 )
             )
             if quarantine_blocked:
@@ -797,7 +888,7 @@ class EcVolume:
                 stats.DegradedReadErrors.labels(EcShardCorrupt.__name__).inc()
                 raise EcShardCorrupt(
                     f"shard {shard_id}: only {have} clean surviving shards "
-                    f"reachable, need {DATA_SHARDS_COUNT}; local shards "
+                    f"reachable, need {self.data_shards}; local shards "
                     f"{sorted(self.quarantined)} quarantined "
                     f"({self.quarantined}) — repair pending",
                     quarantined=self.quarantined,
@@ -809,7 +900,7 @@ class EcVolume:
             stats.DegradedReadErrors.labels(cls.__name__).inc()
             raise cls(
                 f"shard {shard_id}: only {have} surviving shards reachable, "
-                f"need {DATA_SHARDS_COUNT}"
+                f"need {self.data_shards}"
                 + (" (recover deadline expired)" if deadline_expired else ""),
                 shard_id=shard_id,
                 attempted=attempted,
@@ -943,12 +1034,12 @@ class EcVolume:
             for idx, shards in enumerate(gathered):
                 present = tuple(
                     i for i, s in enumerate(shards) if s is not None
-                )[: DATA_SHARDS_COUNT]
+                )[: self.data_shards]
                 groups.setdefault(present, []).append(idx)
             for survivors, idxs in groups.items():
                 nmax = max(items[i][1] for i in idxs)
                 stack = np.zeros(
-                    (len(idxs), DATA_SHARDS_COUNT, nmax), dtype=np.uint8
+                    (len(idxs), self.data_shards, nmax), dtype=np.uint8
                 )
                 for bi, i in enumerate(idxs):
                     for di, s in enumerate(survivors):

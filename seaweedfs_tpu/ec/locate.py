@@ -22,26 +22,31 @@ class Interval:
     size: int
     is_large_block: bool
     large_block_rows_count: int
+    #: row width in blocks — geometry-flexible volumes carry their own k
+    #: (the legacy default keeps every existing caller byte-identical)
+    data_shards: int = DATA_SHARDS_COUNT
 
     def to_shard_id_and_offset(self, large_block_size: int, small_block_size: int) -> tuple[int, int]:
         ec_file_offset = self.inner_block_offset
-        row_index = self.block_index // DATA_SHARDS_COUNT
+        row_index = self.block_index // self.data_shards
         if self.is_large_block:
             ec_file_offset += row_index * large_block_size
         else:
             ec_file_offset += (
                 self.large_block_rows_count * large_block_size + row_index * small_block_size
             )
-        shard_id = self.block_index % DATA_SHARDS_COUNT
+        shard_id = self.block_index % self.data_shards
         return shard_id, ec_file_offset
 
 
-def large_row_count(dat_size: int, large_block_length: int) -> int:
+def large_row_count(
+    dat_size: int, large_block_length: int, data_shards: int = DATA_SHARDS_COUNT
+) -> int:
     """Number of large rows the encoder emitted for a .dat of this size.
 
     Matches the encode loop's strictly-greater condition: a volume of exactly
     one large-row is encoded entirely as small rows."""
-    large_row_size = large_block_length * DATA_SHARDS_COUNT
+    large_row_size = large_block_length * data_shards
     if dat_size <= 0:
         return 0
     return (dat_size - 1) // large_row_size
@@ -52,11 +57,15 @@ def _locate_offset_within_blocks(block_length: int, offset: int) -> tuple[int, i
 
 
 def locate_offset(
-    large_block_length: int, small_block_length: int, dat_size: int, offset: int
+    large_block_length: int,
+    small_block_length: int,
+    dat_size: int,
+    offset: int,
+    data_shards: int = DATA_SHARDS_COUNT,
 ) -> tuple[int, bool, int, int]:
     """-> (block_index, is_large_block, n_large_block_rows, inner_block_offset)."""
-    large_row_size = large_block_length * DATA_SHARDS_COUNT
-    n_large_rows = large_row_count(dat_size, large_block_length)
+    large_row_size = large_block_length * data_shards
+    n_large_rows = large_row_count(dat_size, large_block_length, data_shards)
     if offset < n_large_rows * large_row_size:
         block_index, inner = _locate_offset_within_blocks(large_block_length, offset)
         return block_index, True, n_large_rows, inner
@@ -71,10 +80,11 @@ def locate_data(
     dat_size: int,
     offset: int,
     size: int,
+    data_shards: int = DATA_SHARDS_COUNT,
 ) -> list[Interval]:
     """Split a logical .dat byte range into per-block intervals."""
     block_index, is_large, n_large_rows, inner = locate_offset(
-        large_block_length, small_block_length, dat_size, offset
+        large_block_length, small_block_length, dat_size, offset, data_shards
     )
     intervals: list[Interval] = []
     while size > 0:
@@ -88,13 +98,14 @@ def locate_data(
                 size=take,
                 is_large_block=is_large,
                 large_block_rows_count=n_large_rows,
+                data_shards=data_shards,
             )
         )
         size -= take
         if size <= 0:
             break
         block_index += 1
-        if is_large and block_index == n_large_rows * DATA_SHARDS_COUNT:
+        if is_large and block_index == n_large_rows * data_shards:
             is_large = False
             block_index = 0
         inner = 0

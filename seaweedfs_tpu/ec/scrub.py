@@ -43,7 +43,7 @@ from typing import Callable, Iterable, Optional
 
 from seaweedfs_tpu import stats
 from seaweedfs_tpu.ec import stripe
-from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+
 
 #: finding classes — the detection taxonomy the counters/quarantine use
 OK = "ok"
@@ -64,6 +64,7 @@ def expected_shard_size(info: dict) -> int:
         int(info["dat_size"]),
         int(info["large_block_size"]),
         int(info["small_block_size"]),
+        stripe.geometry_from_info(info).data_shards,
     )
     return n_large * int(info["large_block_size"]) + n_small * int(
         info["small_block_size"]
@@ -369,7 +370,7 @@ class Scrubber:
             recorded = (info or {}).get("shard_crc32")
             if (
                 not isinstance(recorded, list)
-                or len(recorded) != TOTAL_SHARDS_COUNT
+                or len(recorded) != stripe.geometry_from_info(info).total_shards
             ):
                 # pre-CRC volume: nothing to verify against; counted so
                 # operators can see coverage, not silently skipped
@@ -491,7 +492,7 @@ def verify_ec_volume(
     info = stripe.read_ec_info(ev.base)
     recorded = (info or {}).get("shard_crc32")
     quarantined = dict(getattr(ev, "quarantined", {}) or {})
-    if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+    if not isinstance(recorded, list) or len(recorded) != stripe.geometry_from_info(info).total_shards:
         verdicts = {s: UNVERIFIABLE for s in ev.shard_ids}
         verdicts.update({s: str(r) for s, r in quarantined.items()})
         return verdicts, False

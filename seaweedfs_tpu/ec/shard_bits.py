@@ -1,13 +1,14 @@
 """ShardBits + EcVolumeInfo — mirror of weed/storage/erasure_coding/
-ec_volume_info.go [VERIFY: mount empty]. A uint32 bitmask of which of the 14
-shards a node holds; exchanged in heartbeats and kept in the master's
+ec_volume_info.go [VERIFY: mount empty]. A uint32 bitmask of which shards a
+node holds (sized to MAX_SHARD_COUNT so geometry-flexible volumes register
+shards past the legacy 14); exchanged in heartbeats and kept in the master's
 EcShardLocations registry."""
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ec.constants import MAX_SHARD_COUNT
 
 
 class ShardBits(int):
@@ -21,10 +22,13 @@ class ShardBits(int):
         return bool(self & (1 << shard_id))
 
     def shard_ids(self) -> list[int]:
-        return [i for i in range(TOTAL_SHARDS_COUNT) if self.has_shard_id(i)]
+        # sized to the registry-wide shard-id bound, not the legacy 14:
+        # a converted 20+4 volume heartbeats shards 14..23 through the
+        # same mask (bits above any volume's actual geometry are never set)
+        return [i for i in range(MAX_SHARD_COUNT) if self.has_shard_id(i)]
 
     def shard_id_count(self) -> int:
-        return bin(self & ((1 << TOTAL_SHARDS_COUNT) - 1)).count("1")
+        return bin(self & ((1 << MAX_SHARD_COUNT) - 1)).count("1")
 
     def plus(self, other: "ShardBits") -> "ShardBits":
         return ShardBits(self | other)

@@ -40,9 +40,16 @@ from seaweedfs_tpu.ec.constants import (
     EC_BUFFER_SIZE,
     ERASURE_CODING_LARGE_BLOCK_SIZE,
     ERASURE_CODING_SMALL_BLOCK_SIZE,
-    TOTAL_SHARDS_COUNT,
+    MAX_SHARD_COUNT,
 )
-from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
+from seaweedfs_tpu.ops.rs_codec import (
+    CodeGeometry,
+    DEFAULT_FAMILY,
+    Encoder,
+    family_of,
+    geometry_for,
+    new_encoder,
+)
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types
 from seaweedfs_tpu.storage.needle_map import MemDb
@@ -213,28 +220,29 @@ def _encode_rows(
         raise ValueError(f"block size {block_size} not a multiple of buffer {buffer_size}")
     depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
     align = int(getattr(enc, "width_align", 1) or 1)
+    k = enc.data_shards  # geometry-flexible: the encoder owns (k, m)
     segs_per_row = block_size // buffer_size
-    # how many (10 x buffer) segments fit the device-batch budget
-    batch_cap = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
+    # how many (k x buffer) segments fit the device-batch budget
+    batch_cap = max(1, max_batch_bytes // (k * buffer_size))
     span = _aligned(batch_cap * buffer_size, align)
-    ring = _ring_for(ring_cache, depth + 1, (DATA_SHARDS_COUNT, span))
+    ring = _ring_for(ring_cache, depth + 1, (k, span))
     inflight: deque = deque()  # FIFO of (parity_handle, width)
 
     def drain_one() -> None:
         parity, width = inflight.popleft()
         parity_np = np.asarray(parity)  # sync point
-        if DATA_SHARDS_COUNT + parity_np.shape[0] != len(outputs):
+        if k + parity_np.shape[0] != len(outputs):
             # a geometry-mismatched encoder must fail loudly, not leave
             # trailing .ecNN files silently empty
             raise ValueError(
                 f"encoder produced {parity_np.shape[0]} parity shards; "
-                f"layout wants {len(outputs) - DATA_SHARDS_COUNT}"
+                f"layout wants {len(outputs) - k}"
             )
         for p in range(parity_np.shape[0]):
             row = np.ascontiguousarray(parity_np[p, :width])
-            outputs[DATA_SHARDS_COUNT + p].write(row)
+            outputs[k + p].write(row)
             if crcs is not None:
-                crcs[DATA_SHARDS_COUNT + p] = zlib.crc32(row, crcs[DATA_SHARDS_COUNT + p])
+                crcs[k + p] = zlib.crc32(row, crcs[k + p])
 
     def flush(batch: list) -> None:
         if not batch:
@@ -244,7 +252,7 @@ def _encode_rows(
             drain_one()
         staging = ring.take()
         # read runs of consecutive segments as one contiguous slab per shard
-        # (10 large sequential reads per row-run instead of one seek per
+        # (k large sequential reads per row-run instead of one seek per
         # segment x shard — keeps readahead alive at 1 GiB block strides)
         i = 0
         while i < len(batch):
@@ -252,8 +260,8 @@ def _encode_rows(
             j = i
             while j + 1 < len(batch) and batch[j + 1] == (row, batch[j][1] + 1):
                 j += 1
-            row_start = start_offset + row * block_size * DATA_SHARDS_COUNT
-            for d in range(DATA_SHARDS_COUNT):
+            row_start = start_offset + row * block_size * k
+            for d in range(k):
                 read_padded_into(
                     f,
                     row_start + d * block_size + seg0 * buffer_size,
@@ -261,7 +269,7 @@ def _encode_rows(
                 )
             i = j + 1
         view = staging[:, :width]
-        for d in range(DATA_SHARDS_COUNT):
+        for d in range(k):
             outputs[d].write(view[d])
             if crcs is not None:
                 crcs[d] = zlib.crc32(view[d], crcs[d])
@@ -288,16 +296,20 @@ def _encode_rows(
 
 
 def stripe_layout(
-    dat_size: int, large_block_size: int, small_block_size: int
+    dat_size: int,
+    large_block_size: int,
+    small_block_size: int,
+    data_shards: int = DATA_SHARDS_COUNT,
 ) -> tuple[int, int]:
     """(n_large, n_small) rows for a .dat of `dat_size` bytes — THE layout
     rule (WriteEcFiles semantics): while strictly more than one full large
     row remains, rows are large; the tail becomes small rows, the last one
-    zero-padded past EOF. The ONE definition shared by the warm converter
-    and the inline-ingest builder: their byte-identity contract is exactly
-    this function agreeing with itself."""
-    large_row = large_block_size * DATA_SHARDS_COUNT
-    small_row = small_block_size * DATA_SHARDS_COUNT
+    zero-padded past EOF. The ONE definition shared by the warm converter,
+    the inline-ingest builder, and the geometry converter: their
+    byte-identity contract is exactly this function agreeing with itself.
+    `data_shards` is the row width in blocks (legacy default 10)."""
+    large_row = large_block_size * data_shards
+    small_row = small_block_size * data_shards
     n_large = 0
     remaining = dat_size
     while remaining > large_row:
@@ -330,16 +342,18 @@ def write_ec_files(
     enc = encoder or new_encoder()
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
-    large_row = large_block_size * DATA_SHARDS_COUNT
-    n_large, n_small = stripe_layout(dat_size, large_block_size, small_block_size)
+    large_row = large_block_size * enc.data_shards
+    n_large, n_small = stripe_layout(
+        dat_size, large_block_size, small_block_size, enc.data_shards
+    )
 
-    crcs = [0] * TOTAL_SHARDS_COUNT
+    crcs = [0] * enc.total_shards
     try:
         with ExitStack() as stack:
             f = stack.enter_context(open(dat_path, "rb"))
             outputs = [
                 stack.enter_context(open(shard_file_name(base_file_name, s), "wb"))
-                for s in range(TOTAL_SHARDS_COUNT)
+                for s in range(enc.total_shards)
             ]
             _encode_rows(
                 f, enc, outputs, 0, large_block_size, n_large, buffer_size,
@@ -358,15 +372,89 @@ def write_ec_files(
                 crcs,
             )
     except BaseException:
-        for s in range(TOTAL_SHARDS_COUNT):
+        for s in range(enc.total_shards):
             try:
                 os.unlink(shard_file_name(base_file_name, s))
             except OSError:
                 pass
         raise
     write_ec_info(
-        base_file_name, large_block_size, small_block_size, dat_size, shard_crcs=crcs
+        base_file_name, large_block_size, small_block_size, dat_size,
+        shard_crcs=crcs, geometry=geometry_of(enc),
     )
+
+
+def geometry_of(enc: Encoder) -> CodeGeometry:
+    """The encoder's geometry as a CodeGeometry record (family name from
+    the registry when the triple matches one, else a `custom_K_M` tag)."""
+    fam = enc.family or f"custom_{enc.data_shards}_{enc.parity_shards}"
+    return CodeGeometry(
+        fam, enc.data_shards, enc.parity_shards, enc.matrix_kind
+    )
+
+
+_LEGACY_GEOMETRY = geometry_for(DEFAULT_FAMILY)
+
+
+def geometry_from_info(info: Optional[dict]) -> CodeGeometry:
+    """The code geometry an .eci sidecar records — the LEGACY default
+    (10+4 Vandermonde) when the sidecar is absent or predates geometry
+    recording, so every pre-conversion shard set keeps reading exactly as
+    before. Malformed geometry keys raise rather than silently misread."""
+    if not info or "data_shards" not in info:
+        return _LEGACY_GEOMETRY
+    k = int(info["data_shards"])
+    m = int(info["parity_shards"])
+    kind = str(info.get("matrix_kind", "vandermonde"))
+    if k <= 0 or m <= 0 or k + m > MAX_SHARD_COUNT:
+        raise ValueError(
+            f".eci records an unusable geometry: {k}+{m} (max total "
+            f"{MAX_SHARD_COUNT})"
+        )
+    fam = str(info.get("family") or family_of(k, m, kind) or f"custom_{k}_{m}")
+    return CodeGeometry(fam, k, m, kind)
+
+
+def encoder_for_info(
+    info: Optional[dict], default: Optional[Encoder] = None
+) -> Encoder:
+    """An encoder matching the .eci-recorded geometry. The supplied
+    `default` (typically the server's shared encoder) is returned when its
+    geometry already matches; otherwise a same-backend sibling is built so
+    geometry-flexible volumes keep riding whatever kernel/mesh selection
+    the factory measured fastest."""
+    geom = geometry_from_info(info)
+    if default is not None:
+        if (
+            default.data_shards == geom.data_shards
+            and default.parity_shards == geom.parity_shards
+            and default.matrix_kind == geom.matrix_kind
+        ):
+            return default
+        enc = Encoder(
+            geom.data_shards,
+            geom.parity_shards,
+            matrix_kind=geom.matrix_kind,
+            backend=default.backend,
+            pallas_mxu=default.pallas_mxu,
+            pallas_tile=default.pallas_tile,
+            mesh_shape=default.mesh_shape,
+            mesh_rebuild=default.mesh_rebuild,
+        )
+        enc.selection = dict(
+            default.selection, geometry=geom.family, source="geometry-sibling"
+        )
+        return enc
+    return new_encoder(
+        geom.data_shards, geom.parity_shards, matrix_kind=geom.matrix_kind
+    )
+
+
+def encoder_for_base(
+    base_file_name: str, default: Optional[Encoder] = None
+) -> Encoder:
+    """`encoder_for_info` keyed by shard-set base path."""
+    return encoder_for_info(read_ec_info(base_file_name), default)
 
 
 def write_ec_info(
@@ -375,6 +463,7 @@ def write_ec_info(
     small_block_size: int,
     dat_size: int,
     shard_crcs: Optional[Sequence[int]] = None,
+    geometry: Optional[CodeGeometry] = None,
 ) -> None:
     """Record the stripe geometry + true .dat size in an .eci sidecar.
 
@@ -384,12 +473,32 @@ def write_ec_info(
     intervals. Shard sets written by stock tooling (no .eci) still open fine
     with the default constants. `shard_crcs` (one CRC32 per shard file,
     computed inline by the streaming encode) rides along when available so
-    rebuilds and fsck can verify shard integrity without a golden copy."""
+    rebuilds and fsck can verify shard integrity without a golden copy.
+
+    `geometry` records the code family/(k, m)/matrix kind for
+    geometry-flexible volumes; the LEGACY default geometry is left implicit
+    (absent keys read as 10+4 Vandermonde) so default-geometry sidecars stay
+    byte-identical across every writer — warm, inline, rebuild, convert."""
     info = {
         "large_block_size": large_block_size,
         "small_block_size": small_block_size,
         "dat_size": dat_size,
     }
+    if geometry is not None and (
+        geometry.data_shards,
+        geometry.parity_shards,
+        geometry.matrix_kind,
+    ) != (
+        _LEGACY_GEOMETRY.data_shards,
+        _LEGACY_GEOMETRY.parity_shards,
+        _LEGACY_GEOMETRY.matrix_kind,
+    ):
+        info.update(
+            data_shards=geometry.data_shards,
+            parity_shards=geometry.parity_shards,
+            matrix_kind=geometry.matrix_kind,
+            family=geometry.family,
+        )
     if shard_crcs is not None:
         info["shard_crc32"] = [int(c) for c in shard_crcs]
     tmp = base_file_name + ".eci.tmp"
@@ -431,23 +540,31 @@ def generate_ec_files(
     write_sorted_file_from_idx(base_file_name)
 
 
-def find_local_shards(base_file_name: str) -> list[int]:
+def find_local_shards(base_file_name: str, total: Optional[int] = None) -> list[int]:
+    """Shard ids with a local .ecNN file. The scan covers the registry-wide
+    MAX_SHARD_COUNT bound by default so geometry-flexible shard sets (e.g.
+    a converted 20+4 volume's .ec14-.ec23) are discovered; pass `total` to
+    pin a known geometry."""
     return [
-        s for s in range(TOTAL_SHARDS_COUNT) if os.path.exists(shard_file_name(base_file_name, s))
+        s
+        for s in range(total if total is not None else MAX_SHARD_COUNT)
+        if os.path.exists(shard_file_name(base_file_name, s))
     ]
 
 
-def _check_rebuild_geometry(base_file_name: str) -> tuple[list[int], list[int], int]:
+def _check_rebuild_geometry(
+    base_file_name: str, enc: Encoder
+) -> tuple[list[int], list[int], int]:
     """Shared preflight for both rebuild paths: -> (present, missing,
-    shard_size). Raises when fewer than DATA_SHARDS survive or survivors
-    disagree on length (truncated shard)."""
-    present = find_local_shards(base_file_name)
-    missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+    shard_size). Raises when fewer than the geometry's data_shards survive
+    or survivors disagree on length (truncated shard)."""
+    present = find_local_shards(base_file_name, enc.total_shards)
+    missing = [s for s in range(enc.total_shards) if s not in present]
     if not missing:
         return present, missing, 0
-    if len(present) < DATA_SHARDS_COUNT:
+    if len(present) < enc.data_shards:
         raise ValueError(
-            f"cannot rebuild: only {len(present)} shards present, need {DATA_SHARDS_COUNT}"
+            f"cannot rebuild: only {len(present)} shards present, need {enc.data_shards}"
         )
     sizes = {s: os.path.getsize(shard_file_name(base_file_name, s)) for s in present}
     if len(set(sizes.values())) != 1:
@@ -850,7 +967,7 @@ def rebuild_ec_files_from_projections(
     decode matrix, split column-wise across holders); CRC32 is folded in
     as bytes stream out and checked against the .eci record; any failure
     drains inflight device work and unlinks the partial outputs."""
-    enc = encoder or new_encoder()
+    enc = encoder or encoder_for_base(base_file_name)
     missing = sorted(int(s) for s in missing)
     if not missing:
         return []
@@ -867,7 +984,7 @@ def rebuild_ec_files_from_projections(
     ahead = (
         DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
     )
-    chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
+    chunks_per_batch = max(1, max_batch_bytes // (enc.data_shards * buffer_size))
     span = chunks_per_batch * buffer_size
     combine = np.ones((1, len(groups)), dtype=np.uint8)  # GF sum == XOR
     ring = _StagingRing(depth + 1, (len(groups), rows * span))
@@ -954,26 +1071,26 @@ def rebuild_ec_files_from_sources(
     path. Rebuilt shards stream to `<base>.ecNN` with CRC32 folded in and
     verified against the .eci record when present; any failure drains
     inflight device work and unlinks the partial outputs."""
-    enc = encoder or new_encoder()
+    enc = encoder or encoder_for_base(base_file_name)
     present = sorted(sources)
     if missing is None:
-        missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in sources]
+        missing = [s for s in range(enc.total_shards) if s not in sources]
     missing = sorted(missing)
     if not missing:
         return []
-    if len(present) < DATA_SHARDS_COUNT:
+    if len(present) < enc.data_shards:
         raise ValueError(
-            f"cannot rebuild: only {len(present)} shards present, need {DATA_SHARDS_COUNT}"
+            f"cannot rebuild: only {len(present)} shards present, need {enc.data_shards}"
         )
     depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
     ahead = (
         DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
     )
-    survivors = present[:DATA_SHARDS_COUNT]
+    survivors = present[: enc.data_shards]
     align = int(getattr(enc, "width_align", 1) or 1)
-    chunks_per_batch = max(1, max_batch_bytes // (DATA_SHARDS_COUNT * buffer_size))
+    chunks_per_batch = max(1, max_batch_bytes // (enc.data_shards * buffer_size))
     span = _aligned(chunks_per_batch * buffer_size, align)
-    ring = _StagingRing(depth + 1, (DATA_SHARDS_COUNT, span))
+    ring = _StagingRing(depth + 1, (enc.data_shards, span))
     crcs = {s: 0 for s in missing}
     #: (offset, valid_bytes, staged_width) per batch, precomputed so the
     #: prefetch cursor can run `ahead` batches past the read cursor
@@ -1063,7 +1180,8 @@ def rebuild_ec_files(
     and unlinks the partial rebuilt files instead of leaking them.
 
     Returns the rebuilt shard ids."""
-    present, missing, shard_size = _check_rebuild_geometry(base_file_name)
+    enc = encoder or encoder_for_base(base_file_name)
+    present, missing, shard_size = _check_rebuild_geometry(base_file_name, enc)
     if not missing:
         return []
     with ExitStack() as stack:
@@ -1075,7 +1193,7 @@ def rebuild_ec_files(
             base_file_name,
             sources,
             shard_size,
-            encoder=encoder,
+            encoder=enc,
             missing=missing,
             buffer_size=buffer_size,
             max_batch_bytes=max_batch_bytes,
@@ -1090,7 +1208,8 @@ def _verify_rebuilt_crcs(base_file_name: str, crcs: dict) -> None:
     garbage — fail the rebuild rather than ship a wrong shard."""
     info = read_ec_info(base_file_name)
     recorded = (info or {}).get("shard_crc32")
-    if not isinstance(recorded, list) or len(recorded) != TOTAL_SHARDS_COUNT:
+    want_len = geometry_from_info(info).total_shards
+    if not isinstance(recorded, list) or len(recorded) != want_len:
         return
     bad = {s: (c, recorded[s]) for s, c in crcs.items() if c != recorded[s]}
     if bad:
@@ -1108,8 +1227,8 @@ def rebuild_ec_files_serial(
     """The pre-pipeline serial rebuild: one blocking reconstruct per chunk.
     Kept as the correctness oracle (bench golden path + byte-identity
     tests) and the shape the AVX2-baseline comparison is defined against."""
-    enc = encoder or new_encoder()
-    present, missing, shard_size = _check_rebuild_geometry(base_file_name)
+    enc = encoder or encoder_for_base(base_file_name)
+    present, missing, shard_size = _check_rebuild_geometry(base_file_name, enc)
     if not missing:
         return []
     with ExitStack() as stack:
@@ -1123,7 +1242,7 @@ def rebuild_ec_files_serial(
         }
         for off in range(0, shard_size, buffer_size):
             n = min(buffer_size, shard_size - off)
-            shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
+            shards: list[Optional[np.ndarray]] = [None] * enc.total_shards
             for s in present:
                 shards[s] = read_padded(ins[s], off, n)
             rec = enc.reconstruct(shards, wanted=missing)
@@ -1140,8 +1259,9 @@ def write_dat_file(
 ) -> None:
     """Data shards -> <base>.dat (WriteDatFile / ec.decode semantics).
 
-    Recorded .eci geometry overrides the arguments — decoding with the wrong
-    block sizes would interleave garbage silently."""
+    Recorded .eci geometry (block sizes AND shard counts) overrides the
+    arguments — decoding with the wrong layout would interleave garbage
+    silently."""
     info = read_ec_info(base_file_name)
     if info is not None:
         large_block_size = info["large_block_size"]
@@ -1150,23 +1270,21 @@ def write_dat_file(
             dat_file_size = info["dat_size"]
     if dat_file_size is None:
         raise ValueError("dat_file_size required when no .eci sidecar exists")
-    large_row = large_block_size * DATA_SHARDS_COUNT
-    n_large = 0
-    remaining = dat_file_size
-    while remaining > large_row:
-        n_large += 1
-        remaining -= large_row
+    data_shards = geometry_from_info(info).data_shards
+    n_large, _ = stripe_layout(
+        dat_file_size, large_block_size, small_block_size, data_shards
+    )
 
     with ExitStack() as stack:
         ins = [
             stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
-            for s in range(DATA_SHARDS_COUNT)
+            for s in range(data_shards)
         ]
         out = stack.enter_context(open(base_file_name + ".dat", "wb"))
         written = 0
         # large rows
         for row in range(n_large):
-            for d in range(DATA_SHARDS_COUNT):
+            for d in range(data_shards):
                 ins[d].seek(row * large_block_size)
                 out.write(ins[d].read(large_block_size))
                 written += large_block_size
@@ -1175,7 +1293,7 @@ def write_dat_file(
         row = 0
         while written < dat_file_size:
             row_progress = 0
-            for d in range(DATA_SHARDS_COUNT):
+            for d in range(data_shards):
                 if written >= dat_file_size:
                     break
                 ins[d].seek(small_start + row * small_block_size)

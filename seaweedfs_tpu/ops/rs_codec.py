@@ -18,6 +18,7 @@ elimination and cached — the role of the reference codec's inversion tree
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 import threading
@@ -42,6 +43,79 @@ EVIDENCE_MAX_AGE_DAYS = config.env("WEEDTPU_EVIDENCE_MAX_AGE_DAYS")
 FUSED_VARIANTS = ("int8", "bf16", "u8", "mplane", "dma")
 
 _BACKENDS = ("numpy", "native", "jax", "pallas", "mesh")
+
+
+# -- code-family registry (the geometry-flexible seam) ------------------------
+#
+# Geometry (k, m, generator family) is a first-class Encoder parameter, no
+# longer pinned at the legacy 10+4. Each registered family names one
+# (data_shards, parity_shards, matrix_kind) triple; the `.eci` sidecar
+# records a volume's family so mounts, rebuilds, and scrubs agree on the
+# layout, and `ec.convert` re-encodes a volume from one family to another
+# without ever materializing the .dat (see seaweedfs_tpu/ec/convert.py).
+
+
+@dataclasses.dataclass(frozen=True)
+class CodeGeometry:
+    """One registered erasure-code geometry."""
+
+    family: str
+    data_shards: int
+    parity_shards: int
+    matrix_kind: str  # gf8.generator_matrix dispatch: vandermonde | cauchy
+
+    @property
+    def total_shards(self) -> int:
+        return self.data_shards + self.parity_shards
+
+    @property
+    def overhead(self) -> float:
+        """Storage overhead factor (total/data) — the tiering cost signal
+        conversions optimize: colder data wants a smaller factor."""
+        return self.total_shards / self.data_shards
+
+
+#: the registered code families. `rs_10_4` is the legacy wire-default
+#: (klauspost-compatible Vandermonde 10+4 — what every pre-geometry .eci
+#: implies); `cauchy_12_3` is the wider, cheaper cold-tier code (overhead
+#: 1.25 vs 1.4, Cauchy parity rows are provably MDS for any k+m <= 256);
+#: `merge_20_4` is the 10+4 -> 20+4 stripe-merge layout (two source data
+#: rows regroup into one target row; overhead 1.2).
+CODE_FAMILIES: dict[str, CodeGeometry] = {
+    g.family: g
+    for g in (
+        CodeGeometry("rs_10_4", 10, 4, "vandermonde"),
+        CodeGeometry("cauchy_12_3", 12, 3, "cauchy"),
+        CodeGeometry("merge_20_4", 20, 4, "cauchy"),
+    )
+}
+
+DEFAULT_FAMILY = "rs_10_4"
+
+
+def geometry_for(family: str) -> CodeGeometry:
+    """The registered geometry behind a family name; unknown names raise
+    (a typo'd conversion target must fail loudly, not encode garbage)."""
+    geom = CODE_FAMILIES.get(str(family))
+    if geom is None:
+        raise ValueError(
+            f"unknown code family {family!r} (registered: "
+            f"{sorted(CODE_FAMILIES)})"
+        )
+    return geom
+
+
+def family_of(
+    data_shards: int, parity_shards: int, matrix_kind: str
+) -> Optional[str]:
+    """Reverse lookup: the registered family name for a geometry triple,
+    or None for an unregistered ad-hoc geometry (tests use scaled ones)."""
+    for name, g in CODE_FAMILIES.items():
+        if (g.data_shards, g.parity_shards, g.matrix_kind) == (
+            int(data_shards), int(parity_shards), str(matrix_kind),
+        ):
+            return name
+    return None
 
 #: LRU cap on cached decode matrices. A long-lived volume server whose
 #: shard-loss patterns churn (peers flapping, rolling repairs) sees an
@@ -126,6 +200,9 @@ class Encoder:
                 f"unknown backend {backend!r} (want one of {_BACKENDS})"
             )
         self.matrix_kind = matrix_kind
+        #: registered family name when the (k, m, kind) triple matches one
+        #: (None for ad-hoc geometries, e.g. tests' scaled shard counts)
+        self.family = family_of(data_shards, parity_shards, matrix_kind)
         self.backend = backend
         # fused-kernel variant config (pallas backend only): which staged
         # kernel (rs_pallas.VARIANTS) and tile the dispatches use — set by
@@ -926,8 +1003,15 @@ def new_encoder(
     parity_shards: int = 4,
     backend: str = "auto",
     matrix_kind: str = "vandermonde",
+    family: Optional[str] = None,
 ) -> Encoder:
     """Encoder factory — the backend-selection seam (SURVEY.md §1, §7.1 step 5).
+
+    `family` names a registered code geometry (CODE_FAMILIES) and overrides
+    data_shards/parity_shards/matrix_kind — the geometry-flexible entry
+    point `ec.convert` and geometry-recording `.eci` mounts use. Without
+    it the explicit shard counts apply (legacy default: the 10+4
+    Vandermonde wire geometry).
 
     backend: "auto" picks the measured-fastest device path on TPU, the XLA
     path on other accelerators, and the C++ AVX2 library (numpy if it can't
@@ -954,6 +1038,10 @@ def new_encoder(
     `WEEDTPU_MESH_SHAPE`/`WEEDTPU_MESH_REBUILD` (or evidence/default)
     config; the selection audit records the mesh shape and evidence round.
     """
+    if family is not None:
+        geom = geometry_for(family)
+        data_shards, parity_shards = geom.data_shards, geom.parity_shards
+        matrix_kind = geom.matrix_kind
     selection: dict = {"requested": backend}
     pallas_kwargs: dict = {}
     if backend == "auto":

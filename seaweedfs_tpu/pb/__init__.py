@@ -21,7 +21,8 @@ Services and methods (paths are /<service>/<method>):
                          VolumeCompact, VolumeStatus,
                          + the EC surface (SURVEY.md §2.4):
                          VolumeEcShardsGenerate, VolumeEcShardsCopy (stream),
-                         VolumeEcShardsRebuild, VolumeEcShardsVerify,
+                         VolumeEcShardsRebuild, VolumeEcShardsConvert,
+                         VolumeEcShardsVerify,
                          VolumeEcShardsMount,
                          VolumeEcShardsUnmount, VolumeEcShardRead (stream),
                          VolumeEcBlobDelete, VolumeEcShardsToVolume,

@@ -9,7 +9,7 @@ from __future__ import annotations
 import json
 import os
 from concurrent import futures
-from typing import TextIO
+from typing import Optional, TextIO
 
 from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
 from seaweedfs_tpu.ec.shard_bits import ShardBits
@@ -355,13 +355,15 @@ def _shard_holders(nodes: list[dict], vid: int) -> dict[int, list[dict]]:
 
 
 def _copy_missing_to(env: CommandEnv, node: dict, vid: int, collection: str,
-                     holders: dict[int, list[dict]]) -> list[int]:
-    """Pull every survivor shard `node` lacks onto it; returns the shard ids
-    temporarily copied (for cleanup)."""
+                     holders: dict[int, list[dict]],
+                     only: Optional[set] = None) -> list[int]:
+    """Pull every survivor shard `node` lacks onto it (restricted to the
+    `only` set when given); returns the shard ids temporarily copied (for
+    cleanup)."""
     local = set(_node_shards_of(node, vid))
     by_source: dict[str, list[int]] = {}
     for sid, hs in holders.items():
-        if sid in local:
+        if sid in local or (only is not None and sid not in only):
             continue
         src = next((h for h in hs if h["url"] != node["url"]), None)
         if src is None:
@@ -430,19 +432,30 @@ def do_ec_rebuild(args: list[str], env: CommandEnv, w: TextIO) -> None:
     for vid in ec_vids:
         collection = colls.get(vid, "")
         holders = _shard_holders(nodes, vid)
-        missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in holders]
-        if not missing:
-            continue
-        if len(holders) < DATA_SHARDS_COUNT:
-            w.write(
-                f"ec.rebuild volume {vid}: only {len(holders)} shards survive, "
-                f"need {DATA_SHARDS_COUNT} — data LOST\n"
-            )
-            continue
         # rebuilder = node already holding the most shards (fewest copies —
         # or, in -remote mode, the fewest slabs streamed over the network)
         rebuilder = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
         addr = grpc_addr(rebuilder)
+        # geometry-flexible volumes (ec.convert targets) record their own
+        # (k, k+m): missing-shard detection over the legacy 14 would never
+        # see a lost shard id >= 14 of a 20+4 volume, and the survivor
+        # gate would mis-assess 12+3. Old servers report 0 -> legacy.
+        k, total = DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+        try:
+            st = env.vs_call(addr, "VolumeStatus", {"volume_id": vid}, timeout=10)
+            k = int(st.get("data_shards") or 0) or k
+            total = int(st.get("total_shards") or 0) or total
+        except Exception:  # noqa: BLE001 — unknown geometry: legacy bounds
+            pass
+        missing = [s for s in range(total) if s not in holders]
+        if not missing:
+            continue
+        if len(holders) < k:
+            w.write(
+                f"ec.rebuild volume {vid}: only {len(holders)} shards survive, "
+                f"need {k} — data LOST\n"
+            )
+            continue
         if fl.remote:
             # distributed path: NO bulk survivor pre-copy. The rebuilder
             # streams survivor input from peer holders while decoding —
@@ -517,6 +530,152 @@ register(
         "holders support\n\tit, auto = only when it also moves fewer bytes; any "
         "failure falls back\n\tto slabs)",
         do_ec_rebuild,
+    )
+)
+
+
+# -- ec.convert --------------------------------------------------------------
+
+
+def do_ec_convert(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Re-encode an aging EC volume into a different registered code
+    family (geometry) without a decode->re-encode round trip: data blocks
+    regroup, new parity is a GF projection of surviving shards, progress
+    is journaled crash-resumable, and the old geometry serves reads until
+    the verified cut-over. The converting node needs the source data
+    shards locally, so missing survivors are pulled first (the ec.decode
+    pre-copy discipline); stale old-geometry shards on OTHER nodes are
+    deleted after cut-over, leaving the converted volume whole on the
+    converter — ec.balance re-spreads it."""
+    fl = parse_flags(
+        args,
+        volumeId=0,
+        collection="",
+        family="",
+        nocutover=False,
+    )
+    if not fl.family:
+        raise ShellError("ec.convert needs -family <registered code family>")
+    env.confirm_locked()
+    nodes = env.topology_nodes()
+    colls = _ec_collections(env)
+    ec_vids = sorted(
+        {int(e["volume_id"]) for n in nodes for e in n.get("ec_shards", [])}
+    )
+    if fl.volumeId:
+        if fl.volumeId not in ec_vids:
+            raise ShellError(f"ec volume {fl.volumeId} not found")
+        ec_vids = [fl.volumeId]
+    elif fl.collection:
+        ec_vids = [v for v in ec_vids if colls.get(v, "") == fl.collection]
+    if not ec_vids:
+        w.write("ec.convert: no matching EC volumes\n")
+        return
+    for vid in ec_vids:
+        collection = colls.get(vid, "")
+        holders = _shard_holders(nodes, vid)
+        # converter = the node already holding the most shards (fewest
+        # survivor copies before the conversion can read the full stripe)
+        converter = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
+        addr = grpc_addr(converter)
+        # the conversion reads at most k source shards (all data when
+        # healthy; parity only stands in for data shards missing
+        # everywhere) — pre-copy exactly that set, not every survivor
+        only: Optional[set] = None
+        try:
+            st = env.vs_call(addr, "VolumeStatus", {"volume_id": vid}, timeout=10)
+            k = int(st.get("data_shards") or 0)
+        except Exception:  # noqa: BLE001 — unknown geometry: copy all
+            k = 0
+        if k > 0:
+            everywhere = set(holders) | set(_node_shards_of(converter, vid))
+            data_have = sorted(s for s in everywhere if s < k)[:k]
+            only = set(data_have) | set(
+                sorted(s for s in everywhere if s >= k)[
+                    : max(0, k - len(data_have))
+                ]
+            )
+        copied = _copy_missing_to(
+            env, converter, vid, collection, holders, only=only
+        )
+        resp = env.vs_call(
+            addr,
+            "VolumeEcShardsConvert",
+            {
+                "volume_id": vid,
+                "collection": collection,
+                "target_family": fl.family,
+                "cutover": not fl.nocutover,
+            },
+            timeout=600,
+        )
+        if not fl.nocutover and resp.get("mode") != "noop":
+            # old-geometry shards elsewhere are stale after cut-over —
+            # drop them so lookups stop routing reads at dead layouts
+            for n in nodes:
+                sids = _node_shards_of(n, vid)
+                if n["url"] == converter["url"] or not sids:
+                    continue
+                env.vs_call(
+                    grpc_addr(n),
+                    "VolumeEcShardsDelete",
+                    {
+                        "volume_id": vid,
+                        "collection": collection,
+                        "shard_ids": sids,
+                    },
+                )
+        elif resp.get("mode") == "noop":
+            # a noop where the converter already holds the COMPLETE target
+            # set while other nodes still hold shards is the signature of
+            # a previous ec.convert dying between its cut-over RPC and
+            # this cleanup loop: those leftovers are old-GEOMETRY shards a
+            # new-geometry locate must never route a read to. Deleting is
+            # not safe to automate from here (a healthy resident volume
+            # plus deliberate replica copies looks the same), so surface
+            # it loudly with the exact remedy.
+            held = set(_node_shards_of(converter, vid)) | set(copied)
+            tgt_ids = {int(s) for s in resp.get("shard_ids") or []}
+            leftovers = [
+                (n["url"], _node_shards_of(n, vid))
+                for n in nodes
+                if n["url"] != converter["url"] and _node_shards_of(n, vid)
+            ]
+            if tgt_ids and tgt_ids <= held and leftovers:
+                for url, sids in leftovers:
+                    w.write(
+                        f"ec.convert volume {vid}: WARNING possible stale "
+                        f"old-geometry shards {sids} on {url} (interrupted "
+                        "post-cutover cleanup?) — verify and remove with "
+                        "ec.verify / VolumeEcShardsDelete, then ec.balance\n"
+                    )
+        w.write(
+            f"ec.convert volume {vid}: {resp.get('src_family')} -> "
+            f"{resp.get('target_family')} ({resp.get('mode')}) on "
+            f"{converter['url']}: read {resp.get('bytes_read')} wrote "
+            f"{resp.get('bytes_written')} bytes"
+            + (
+                f", reconstructed {resp['reconstructed_bytes']} degraded"
+                if resp.get("reconstructed_bytes")
+                else ""
+            )
+            + ("" if fl.nocutover else ", cut over")
+            + "\n"
+        )
+
+
+register(
+    ShellCommand(
+        "ec.convert",
+        "ec.convert -volumeId <id> | -collection <name> -family <name> "
+        "[-nocutover]\n"
+        "\tre-encode an EC volume into another registered code family "
+        "(geometry)\n\twithout decoding: data blocks regroup, new parity "
+        "is a GF projection of\n\tsurviving shards, progress journals "
+        "crash-resumable (.ecc), and the old\n\tgeometry keeps serving "
+        "until the verified cut-over; -nocutover stages the\n\tconverted "
+        "set (<base>.cv.*) and leaves retirement to a later call",
+        do_ec_convert,
     )
 )
 
@@ -617,11 +776,21 @@ def do_ec_decode(args: list[str], env: CommandEnv, w: TextIO) -> None:
     for vid in ec_vids:
         collection = colls.get(vid, "")
         holders = _shard_holders(nodes, vid)
-        if len(holders) < DATA_SHARDS_COUNT:
-            w.write(f"ec.decode volume {vid}: insufficient shards — data LOST\n")
-            continue
         target = max(nodes, key=lambda n: len(_node_shards_of(n, vid)))
         addr = grpc_addr(target)
+        # the volume's recorded geometry, not the legacy 10/14: a
+        # converted (12+3, 20+4) volume has a different survivor gate and
+        # remnant-shard range (old servers report 0 -> legacy bounds)
+        k, total = DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT
+        try:
+            st = env.vs_call(addr, "VolumeStatus", {"volume_id": vid}, timeout=10)
+            k = int(st.get("data_shards") or 0) or k
+            total = int(st.get("total_shards") or 0) or total
+        except Exception:  # noqa: BLE001 — unknown geometry: legacy bounds
+            pass
+        if len(holders) < k:
+            w.write(f"ec.decode volume {vid}: insufficient shards — data LOST\n")
+            continue
         _copy_missing_to(env, target, vid, collection, holders)
         env.vs_call(
             addr, "VolumeEcShardsToVolume", {"volume_id": vid, "collection": collection}
@@ -635,7 +804,7 @@ def do_ec_decode(args: list[str], env: CommandEnv, w: TextIO) -> None:
                     {
                         "volume_id": vid,
                         "collection": collection,
-                        "shard_ids": list(range(TOTAL_SHARDS_COUNT)),
+                        "shard_ids": list(range(total)),
                     },
                 )
         w.write(f"ec.decode volume {vid}: restored as normal volume on {target['url']}\n")

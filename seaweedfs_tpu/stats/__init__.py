@@ -352,6 +352,19 @@ InlineEcSeals = REGISTRY.counter(
     "restart then finalized, warm = full .dat re-encode fallback",
     ("mode",),
 )
+EcConvertBytes = REGISTRY.counter(
+    "weedtpu_ec_convert_bytes_total",
+    "bytes the geometry converter moved, by direction: read = source "
+    "shard bytes consumed (pass-through data + survivor reads when a "
+    "source data shard needed reconstructing), written = target shard "
+    "bytes materialized — compare written against the decode->re-encode "
+    "round trip's total I/O for the <=0.5x conversion gate",
+    ("direction",),
+)
+EcConvertSeconds = REGISTRY.histogram(
+    "weedtpu_ec_convert_seconds",
+    "wall time of whole-volume geometry conversions (ec.convert)",
+)
 EcMeshDevices = REGISTRY.gauge(
     "weedtpu_ec_mesh_devices",
     "devices in the mesh backend's dp x sp device mesh (0 = every dispatch "

@@ -13,6 +13,7 @@ from typing import Optional
 
 from seaweedfs_tpu.ec.ec_volume import EcVolume
 from seaweedfs_tpu.ec.shard_bits import EcVolumeInfo, ShardBits
+from seaweedfs_tpu.utils import glog
 from seaweedfs_tpu.ec import stripe
 from seaweedfs_tpu.ops.rs_codec import Encoder, new_encoder
 from seaweedfs_tpu.storage.needle import Needle
@@ -61,7 +62,17 @@ class DiskLocation:
             collection, vid = parsed
             base_path = os.path.join(self.directory, base)
             if vid not in self.ec_volumes and stripe.find_local_shards(base_path):
-                self.ec_volumes[vid] = EcVolume(base_path, encoder=encoder)
+                try:
+                    self.ec_volumes[vid] = EcVolume(base_path, encoder=encoder)
+                except (ValueError, KeyError) as e:
+                    # a shard set contradicting its .eci geometry (typed
+                    # EcGeometryError — e.g. a crash mid-conversion-
+                    # cutover) or a malformed/unusable .eci record (plain
+                    # ValueError/KeyError out of geometry_from_info) must
+                    # not kill server boot OR get served: skip it loudly —
+                    # the convert resume path / operator finishes the
+                    # swap, and the next load picks the healed volume up
+                    glog.warning("skipping ec volume %d: %s", vid, e)
 
 
 class Store:

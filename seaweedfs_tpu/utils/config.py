@@ -444,6 +444,29 @@ register_env(
     parse=_clamped_int(1),
 )
 register_env(
+    "WEEDTPU_CONVERT_BATCH", int, 64 * 1024 * 1024,
+    "Device-batch budget (bytes) of the geometry-conversion pipeline — "
+    "how much virtual-dat data one staging-ring dispatch covers (clamped "
+    "to >= 1 MiB). The conversion analog of the encode pipeline's "
+    "max_batch_bytes.",
+    parse=_clamped_int(1024 * 1024),
+)
+register_env(
+    "WEEDTPU_CONVERT_JOURNAL_MB", float, 64.0,
+    "How many MB of converted output the geometry converter writes "
+    "between fsync'd .ecc journal watermarks. Smaller = finer "
+    "crash-resume granularity (less re-encoded on restart), larger = "
+    "fewer fsyncs. Clamped to > 0.",
+    parse=lambda raw: max(0.001, float(raw)),
+)
+register_env(
+    "WEEDTPU_CONVERT_VERIFY", bool, True,
+    "Re-read every converted shard FROM DISK and verify it against the "
+    "staged .eci CRCs before cut-over retires the old geometry (the "
+    "scrub-grade gate: bytes on disk, not bytes in flight, are what the "
+    "new geometry will serve). Off skips the extra read pass.",
+)
+register_env(
     "WEEDTPU_LOOKUP_RETRIES", int, 2,
     "Bounded retries (with decorrelated jitter) of the single-flight "
     "master shard-location lookup leader before it fails its waiters — "

@@ -1,0 +1,658 @@
+"""Geometry-flexible codes + ec.convert — the conversion subsystem's
+tier-1 contract.
+
+Byte identity vs the decode->re-encode oracle is THE spec: for every
+geometry pair and layout shape (tile-edge, odd, tiny, degraded source),
+`convert_ec_files`'s staged output must equal `write_dat_file` +
+`write_ec_files` on the target geometry, bit for bit — while moving far
+fewer bytes (the BENCH_CONVERT gate) and never materializing a .dat.
+Crash-resume (SIGKILL mid-conversion, journal watermark replay),
+cut-over atomicity (a half-swapped volume refuses to mount, never
+misreads), multi-geometry mounts, and the cluster RPC/shell wiring ride
+along.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import convert, locate, stripe
+from seaweedfs_tpu.ec.ec_volume import EcGeometryError, EcVolume
+from seaweedfs_tpu.ops import gf8
+from seaweedfs_tpu.ops.rs_codec import (
+    CODE_FAMILIES,
+    Encoder,
+    geometry_for,
+    new_encoder,
+)
+
+L, S = 4096, 512  # scaled block geometry (the shell-test convention)
+FAMILIES = ("cauchy_12_3", "merge_20_4")
+
+
+def _enc(k=10, m=4, kind="vandermonde"):
+    return Encoder(k, m, matrix_kind=kind, backend="numpy")
+
+
+def _build_source(tmp_path, dat_bytes, seed=11, name="1"):
+    os.makedirs(str(tmp_path), exist_ok=True)
+    base = os.path.join(str(tmp_path), name)
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, dat_bytes, dtype=np.uint8).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+    stripe.write_ec_files(
+        base, large_block_size=L, small_block_size=S, buffer_size=S,
+        encoder=_enc(),
+    )
+    return base, data
+
+
+def _oracle(tmp_path, base, family, name="oracle"):
+    """decode->re-encode reference shard set for `base` at `family`."""
+    ob = os.path.join(str(tmp_path), name, "1")
+    os.makedirs(os.path.dirname(ob), exist_ok=True)
+    src_total = stripe.geometry_from_info(stripe.read_ec_info(base)).total_shards
+    for s in stripe.find_local_shards(base, src_total):
+        shutil.copy(stripe.shard_file_name(base, s), stripe.shard_file_name(ob, s))
+    shutil.copy(base + ".eci", ob + ".eci")
+    missing = [
+        s for s in range(src_total)
+        if not os.path.exists(stripe.shard_file_name(ob, s))
+    ]
+    if missing:
+        stripe.rebuild_ec_files(ob, encoder=_enc())
+    stripe.write_dat_file(ob)
+    for s in range(src_total):
+        os.unlink(stripe.shard_file_name(ob, s))
+    geom = geometry_for(family)
+    stripe.write_ec_files(
+        ob, large_block_size=L, small_block_size=S, buffer_size=S,
+        encoder=_enc(geom.data_shards, geom.parity_shards, geom.matrix_kind),
+    )
+    return ob
+
+
+def _assert_staged_matches(base, ob, family):
+    staged = convert.stage_base(base)
+    for s in range(geometry_for(family).total_shards):
+        a = open(stripe.shard_file_name(staged, s), "rb").read()
+        b = open(stripe.shard_file_name(ob, s), "rb").read()
+        assert a == b, f"{family} shard {s}: staged differs from oracle"
+
+
+# -- registry + planner -------------------------------------------------------
+
+
+def test_code_family_registry():
+    assert set(FAMILIES) <= set(CODE_FAMILIES)
+    legacy = geometry_for("rs_10_4")
+    assert (legacy.data_shards, legacy.parity_shards) == (10, 4)
+    wide = geometry_for("cauchy_12_3")
+    assert wide.overhead < legacy.overhead  # the tiering point: cheaper
+    assert geometry_for("merge_20_4").total_shards == 24
+    with pytest.raises(ValueError, match="unknown code family"):
+        geometry_for("nope_9_9")
+    enc = new_encoder(family="cauchy_12_3", backend="numpy")
+    assert (enc.data_shards, enc.parity_shards, enc.matrix_kind) == (
+        12, 3, "cauchy",
+    )
+    assert enc.family == "cauchy_12_3"
+    assert _enc().family == "rs_10_4"
+    assert Encoder(7, 2, backend="numpy").family is None  # ad-hoc geometry
+
+
+def test_conversion_matrix_maps_survivors_to_target_shards():
+    """The planner's algebra for k-preserving pairs: M = G_tgt · Dec maps
+    ANY k survivor source shards to the full target shard set — data
+    rows pass through (identity block when survivors are the data
+    shards), parity rows are projections."""
+    src = _enc(10, 4, "vandermonde")
+    tgt = _enc(10, 4, "cauchy")
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (10, 257), dtype=np.uint8)
+    src_shards = np.stack(src.encode(list(data)))
+    tgt_shards = np.stack(tgt.encode(list(data)))
+    # healthy survivors = the data shards: M's top block is the identity
+    m = convert.conversion_matrix(src, tgt)
+    assert np.array_equal(m[:10], np.eye(10, dtype=np.uint8))
+    assert np.array_equal(gf8.gf_mat_vec(m, src_shards[:10]), tgt_shards)
+    # degraded survivors (parity standing in for lost data): same output
+    survivors = [0, 1, 2, 3, 4, 5, 6, 7, 10, 13]
+    m2 = convert.conversion_matrix(src, tgt, survivors)
+    assert np.array_equal(
+        gf8.gf_mat_vec(m2, src_shards[survivors]), tgt_shards
+    )
+    # k-changing pairs have no whole-shard matrix — the streaming block
+    # regroup owns them, and the planner says so instead of mis-mapping
+    with pytest.raises(convert.ConversionError, match="k-changing"):
+        conversion = _enc(12, 3, "cauchy")
+        convert.conversion_matrix(src, conversion)
+
+
+# -- byte identity across layouts --------------------------------------------
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+@pytest.mark.parametrize(
+    "dat_bytes",
+    [
+        3 * L * 10 + 5 * S * 10 + 137,  # large + small + odd tail
+        2 * L * 10,                      # tile edge: exact large rows
+        4 * S * 10,                      # small rows only, exact
+        777,                             # tiny: single partial small row
+    ],
+    ids=["mixed-odd", "large-exact", "small-exact", "tiny"],
+)
+def test_convert_byte_identity_vs_oracle(tmp_path, family, dat_bytes):
+    base, _ = _build_source(tmp_path, dat_bytes)
+    res = convert.convert_ec_files(
+        base, family, encoder=_enc(), buffer_size=S, journal_bytes=1 << 16
+    )
+    assert res["mode"] == "converted"
+    assert res["reconstructed_bytes"] == 0
+    ob = _oracle(tmp_path, base, family)
+    _assert_staged_matches(base, ob, family)
+    # accounting: moved (written) bytes match the staged set exactly, and
+    # the oracle formula is what BASELINE.md states
+    geom = geometry_for(family)
+    n_l, n_s = stripe.stripe_layout(dat_bytes, L, S, geom.data_shards)
+    shard_len = n_l * L + n_s * S
+    assert res["bytes_written"] == geom.total_shards * shard_len
+    acct = convert.reencode_oracle_bytes(base, family)
+    assert acct["total"] == 3 * dat_bytes + geom.total_shards * shard_len
+    if dat_bytes >= L * 10:
+        # the 0.5x gate is a property of real volumes; a sub-row toy
+        # volume is all zero padding and the identity contract carries it
+        assert res["bytes_written"] <= 0.5 * acct["total"]
+
+
+def test_convert_degraded_source_projects_survivors(tmp_path):
+    """Missing source data shards reconstruct inline from survivors
+    (parity included) — the conversion never needs a whole .dat, and the
+    output is still byte-exact vs the oracle on the rebuilt volume."""
+    base, _ = _build_source(tmp_path, 2 * L * 10 + 3 * S * 10 + 99)
+    ob = _oracle(tmp_path, base, "cauchy_12_3")  # oracle BEFORE the damage
+    os.unlink(stripe.shard_file_name(base, 0))
+    os.unlink(stripe.shard_file_name(base, 7))
+    res = convert.convert_ec_files(
+        base, "cauchy_12_3", encoder=_enc(), buffer_size=S
+    )
+    assert res["reconstructed_bytes"] > 0
+    _assert_staged_matches(base, ob, "cauchy_12_3")
+    # too few survivors refuses loudly
+    for s in (1, 2, 3):
+        os.unlink(stripe.shard_file_name(base, s))
+    convert.discard_staged(base, keep_journal=False)
+    with pytest.raises(convert.ConversionError, match="cannot read source"):
+        convert.convert_ec_files(base, "merge_20_4", encoder=_enc())
+
+
+def test_convert_noop_and_unknown_family(tmp_path):
+    base, _ = _build_source(tmp_path, 3 * S * 10)
+    assert convert.convert_ec_files(base, "rs_10_4")["mode"] == "noop"
+    with pytest.raises(ValueError, match="unknown code family"):
+        convert.convert_ec_files(base, "bogus")
+    # conversion of a legacy sidecar-less set refuses (no vouched layout)
+    os.unlink(base + ".eci")
+    with pytest.raises(convert.ConversionError, match="no .eci"):
+        convert.convert_ec_files(base, "cauchy_12_3")
+
+
+# -- crash-resume -------------------------------------------------------------
+
+_CHILD = """
+import sys
+sys.path.insert(0, {root!r})
+from seaweedfs_tpu.ec import convert, stripe
+from seaweedfs_tpu.ops.rs_codec import Encoder
+orig = stripe._encode_rows
+calls = [0]
+def hooked(*a, **k):
+    calls[0] += 1
+    if calls[0] > {after}:
+        print("MIDWAY", flush=True)
+        import time
+        time.sleep(60)
+    return orig(*a, **k)
+stripe._encode_rows = hooked
+convert.convert_ec_files(
+    {base!r}, {family!r}, encoder=Encoder(10, 4, backend="numpy"),
+    buffer_size={S}, journal_bytes=4096,
+)
+"""
+
+
+def test_convert_sigkill_resume_byte_identity(tmp_path):
+    """The chaos contract, deterministically: the converting process is
+    SIGKILLed mid-stream (journal watermarks on disk, staged partials
+    torn), the source keeps serving untouched, and a re-run RESUMES from
+    the last watermark — never restarts — finishing byte-identical to
+    the oracle."""
+    base, data = _build_source(tmp_path, 6 * L * 10 + 2 * S * 10 + 55)
+    src_files = {
+        s: open(stripe.shard_file_name(base, s), "rb").read()
+        for s in range(14)
+    }
+    child = _CHILD.format(
+        root=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        base=base, family="merge_20_4", S=S, after=2,
+    )
+    p = subprocess.Popen(
+        [sys.executable, "-c", child],
+        stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert "MIDWAY" in p.stdout.readline()
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+    marks = [
+        r for r in convert._Journal.read(convert.journal_path(base))
+        if r.get("type") == "watermark"
+    ]
+    assert marks, "the kill must land after at least one journal watermark"
+    # old geometry untouched mid-conversion: still serving, bit for bit
+    for s, blob in src_files.items():
+        assert open(stripe.shard_file_name(base, s), "rb").read() == blob
+    res = convert.convert_ec_files(
+        base, "merge_20_4", encoder=_enc(), buffer_size=S, journal_bytes=4096
+    )
+    assert res["mode"] == "resumed"
+    ob = _oracle(tmp_path, base, "merge_20_4")
+    _assert_staged_matches(base, ob, "merge_20_4")
+
+
+def test_convert_torn_journal_tail_restarts_clean(tmp_path):
+    base, _ = _build_source(tmp_path, 2 * L * 10 + S * 10)
+    with open(convert.journal_path(base), "ab") as f:
+        f.write(b'{"type": "begin", "src_fam')  # torn mid-record
+    res = convert.convert_ec_files(
+        base, "cauchy_12_3", encoder=_enc(), buffer_size=S
+    )
+    assert res["mode"] == "converted"  # garbage journal = fresh start
+    _assert_staged_matches(
+        base, _oracle(tmp_path, base, "cauchy_12_3"), "cauchy_12_3"
+    )
+
+
+def test_convert_rejects_source_drift_on_resume(tmp_path):
+    """A journal from a DIFFERENT source state (the .eci CRC fingerprint
+    disagrees) must not resume over it — fresh start instead."""
+    base, _ = _build_source(tmp_path, 2 * L * 10 + S * 10)
+    res = convert.convert_ec_files(
+        base, "cauchy_12_3", encoder=_enc(), buffer_size=S, journal_bytes=512
+    )
+    assert res["mode"] == "converted"
+    # mutate the source (recorded CRCs change) and convert again: the
+    # stale journal must be discarded, not resumed
+    with open(base + ".dat", "r+b") as f:
+        f.seek(0)
+        f.write(b"\xff" * 64)
+    for s in range(14):
+        os.unlink(stripe.shard_file_name(base, s))
+    stripe.write_ec_files(
+        base, large_block_size=L, small_block_size=S, buffer_size=S,
+        encoder=_enc(),
+    )
+    res2 = convert.convert_ec_files(
+        base, "cauchy_12_3", encoder=_enc(), buffer_size=S
+    )
+    assert res2["mode"] == "converted"
+    _assert_staged_matches(
+        base, _oracle(tmp_path, base, "cauchy_12_3", name="o2"), "cauchy_12_3"
+    )
+
+
+# -- cut-over + serving -------------------------------------------------------
+
+
+def _mountable(base):
+    open(base + ".idx", "wb").close()
+    stripe.write_sorted_file_from_idx(base)
+
+
+def _read_range(ev, data, off, size):
+    ivs = locate.locate_data(ev.large, ev.small, ev.dat_file_size, off, size,
+                             ev.data_shards)
+    assert ev.read_intervals(ivs) == data[off : off + size]
+
+
+def test_cutover_serves_through_standard_ec_volume_path(tmp_path):
+    """The acceptance criterion: converted shards are readable through
+    the STANDARD EcVolume path after cut-over — healthy interval reads,
+    degraded reconstruction, CRC fsck, and rebuild all speak the new
+    geometry; the old geometry serves until the swap."""
+    base, data = _build_source(tmp_path, 3 * L * 10 + 2 * S * 10 + 201)
+    _mountable(base)
+    convert.convert_ec_files(base, "cauchy_12_3", encoder=_enc(), buffer_size=S)
+    # pre-cutover: volume still mounts and reads as the OLD geometry
+    with EcVolume(base, encoder=_enc(), warm_on_mount=False) as ev:
+        assert ev.total_shards == 14 and ev.data_shards == 10
+        _read_range(ev, data, 0, 300)
+    out = convert.cutover(base)
+    assert out["mode"] == "cutover"
+    assert sorted(stripe.find_local_shards(base)) == list(range(15))
+    assert not os.path.exists(convert.journal_path(base))
+    with EcVolume(base, encoder=_enc(), warm_on_mount=False) as ev:
+        assert ev.geometry.family == "cauchy_12_3"
+        assert (ev.data_shards, ev.total_shards) == (12, 15)
+        assert ev.encoder.data_shards == 12  # geometry sibling, not 10+4
+        for off, size in [(0, 1), (L * 10 - 7, 300), (len(data) - 99, 99)]:
+            _read_range(ev, data, off, size)
+        fsck = ev.verify_local_shards()
+        assert fsck is not None and all(fsck.values())
+    # degraded read + rebuild on the NEW geometry
+    os.unlink(stripe.shard_file_name(base, 3))
+    with EcVolume(base, encoder=_enc(), warm_on_mount=False) as ev:
+        _read_range(ev, data, L * 3, 513)  # reconstructs through 12+3
+    assert stripe.rebuild_ec_files(base) == [3]
+
+
+def test_cutover_crash_midswap_refuses_then_recovers(tmp_path):
+    """Crash between the .eci swap and the shard swaps: the volume
+    REFUSES to mount (typed EcGeometryError — old shard files are longer
+    than the new geometry's layout) instead of misreading, and
+    finish_cutover completes the swap from the journal."""
+    base, data = _build_source(tmp_path, 2 * L * 10 + 3 * S * 10)
+    _mountable(base)
+    convert.convert_ec_files(base, "merge_20_4", encoder=_enc(), buffer_size=S)
+    staged = convert.stage_base(base)
+    j = convert._Journal(convert.journal_path(base))
+    j.append({"type": "cutover"})
+    j.close()
+    os.replace(staged + ".eci", base + ".eci")  # crash right here
+    with pytest.raises(EcGeometryError):
+        EcVolume(base, encoder=_enc(), warm_on_mount=False)
+    out = convert.finish_cutover(base)
+    assert out["mode"] == "cutover"
+    with EcVolume(base, encoder=_enc(), warm_on_mount=False) as ev:
+        assert (ev.data_shards, ev.total_shards) == (20, 24)
+        _read_range(ev, data, 0, 257)
+        _read_range(ev, data, len(data) - 31, 31)
+
+
+@pytest.mark.parametrize("reissue_family", ["merge_20_4", "cauchy_12_3"])
+def test_reissued_convert_finishes_crashed_cutover(tmp_path, reissue_family):
+    """Regression: a crash AFTER the .eci rename leaves the live sidecar
+    recording the TARGET geometry. A re-issued convert_ec_files — the
+    documented remedy — must finish the journaled swap, not (same
+    family) return noop on the src==tgt comparison and strand the volume
+    un-mountable forever, nor (different family) mistake the journal for
+    source drift and discard the staged shards, which are the only
+    complete copy of the new layout."""
+    base, data = _build_source(tmp_path, 2 * L * 10 + 3 * S * 10)
+    _mountable(base)
+    convert.convert_ec_files(base, "merge_20_4", encoder=_enc(), buffer_size=S)
+    staged = convert.stage_base(base)
+    j = convert._Journal(convert.journal_path(base))
+    j.append({"type": "cutover"})
+    j.close()
+    os.replace(staged + ".eci", base + ".eci")  # crash right here
+    out = convert.convert_ec_files(base, reissue_family, encoder=_enc())
+    assert out["mode"] == "cutover"
+    assert not convert.pending_cutover(base)
+    with EcVolume(base, encoder=_enc(), warm_on_mount=False) as ev:
+        assert (ev.data_shards, ev.total_shards) == (20, 24)
+        _read_range(ev, data, 0, 257)
+        _read_range(ev, data, len(data) - 31, 31)
+
+
+def test_geometry_mismatch_raises_typed_error(tmp_path):
+    """Satellite: a wrong-geometry shard set is caught at mount by a
+    typed error, not by CRC luck."""
+    base, _ = _build_source(tmp_path, 2 * S * 10)
+    _mountable(base)
+    # stray shard id past the recorded geometry
+    open(stripe.shard_file_name(base, 17), "wb").write(b"x")
+    with pytest.raises(EcGeometryError) as ei:
+        EcVolume(base, encoder=_enc(), warm_on_mount=False)
+    assert ei.value.details["stray_shards"] == [17]
+    os.unlink(stripe.shard_file_name(base, 17))
+    # over-length shard (longer than the recorded layout allows)
+    with open(stripe.shard_file_name(base, 4), "ab") as f:
+        f.write(b"\0" * 64)
+    with pytest.raises(EcGeometryError) as ei:
+        EcVolume(base, encoder=_enc(), warm_on_mount=False)
+    assert 4 in ei.value.details["over_length"]
+    # truncation is NOT a geometry error (scrub territory): mount serves
+    with open(stripe.shard_file_name(base, 4), "r+b") as f:
+        f.truncate(os.path.getsize(stripe.shard_file_name(base, 0)) - 10)
+    with EcVolume(base, encoder=_enc(), warm_on_mount=False) as ev:
+        assert 4 in ev.shard_ids
+
+
+def test_multi_geometry_mounts_coexist(tmp_path):
+    """Two volumes of different geometry mounted side by side, each
+    decoding through its own .eci-recorded code."""
+    base_a, data_a = _build_source(tmp_path / "a", 2 * L * 10 + 3 * S * 10, seed=1)
+    base_b, data_b = _build_source(tmp_path / "b", L * 10 + 5 * S * 10, seed=2)
+    for b in (base_a, base_b):
+        _mountable(b)
+    convert.convert_ec_files(base_b, "merge_20_4", encoder=_enc(), buffer_size=S)
+    convert.cutover(base_b)
+    shared = _enc()  # ONE store-style encoder handed to both mounts
+    with EcVolume(base_a, encoder=shared, warm_on_mount=False) as ev_a, \
+         EcVolume(base_b, encoder=shared, warm_on_mount=False) as ev_b:
+        assert ev_a.total_shards == 14 and ev_b.total_shards == 24
+        assert ev_a.encoder is shared  # matching geometry: reused as-is
+        assert ev_b.encoder.data_shards == 20
+        _read_range(ev_a, data_a, 123, 456)
+        _read_range(ev_b, data_b, 123, 456)
+
+
+# -- .eci geometry record -----------------------------------------------------
+
+
+def test_eci_records_geometry_with_legacy_default(tmp_path):
+    base, _ = _build_source(tmp_path, 2 * S * 10)
+    info = stripe.read_ec_info(base)
+    # legacy default geometry stays IMPLICIT (byte-compat with every
+    # pre-geometry writer); the read path supplies it
+    assert "data_shards" not in info
+    geom = stripe.geometry_from_info(info)
+    assert (geom.family, geom.data_shards) == ("rs_10_4", 10)
+    assert stripe.geometry_from_info(None).family == "rs_10_4"
+    # non-default geometry is recorded explicitly
+    convert.convert_ec_files(base, "cauchy_12_3", encoder=_enc(), buffer_size=S)
+    staged_info = stripe.read_ec_info(convert.stage_base(base))
+    assert staged_info["family"] == "cauchy_12_3"
+    assert staged_info["data_shards"] == 12
+    assert len(staged_info["shard_crc32"]) == 15
+    # malformed geometry keys refuse rather than misread
+    with pytest.raises(ValueError, match="unusable geometry"):
+        stripe.geometry_from_info({"data_shards": 0, "parity_shards": 4})
+
+
+def test_encoder_for_info_builds_same_backend_sibling():
+    enc = _enc()
+    assert stripe.encoder_for_info(None, enc) is enc
+    sib = stripe.encoder_for_info(
+        {"data_shards": 12, "parity_shards": 3, "matrix_kind": "cauchy"}, enc
+    )
+    assert (sib.data_shards, sib.backend) == (12, "numpy")
+
+
+# -- cluster wiring -----------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.shell import CommandEnv
+
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"srv{i}"
+        d.mkdir()
+        vs = VolumeServer(
+            [str(d)], master.address, heartbeat_interval=0.3,
+            rack=f"rack{i % 2}", max_volume_count=50,
+        )
+        vs.start()
+        servers.append(vs)
+    client = MasterClient(master.address)
+    env = CommandEnv(master.address)
+    yield master, servers, client, env
+    env.close()
+    client.close()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _run_shell(env, line):
+    from seaweedfs_tpu.shell import run_command
+
+    out = io.StringIO()
+    run_command(env, line, out)
+    return out.getvalue()
+
+
+def test_ec_convert_shell_e2e(cluster):
+    """Full cluster pass: upload -> ec.encode (spread across nodes) ->
+    ec.convert -family cauchy_12_3 (survivors pulled to the converter,
+    conversion + verified cut-over, stale old-geometry shards dropped)
+    -> every blob still readable through the standard degraded path ->
+    master topology sees the 15-shard geometry."""
+    master, servers, client, env = cluster
+    payloads = []
+    for i in range(12):
+        res = client.submit(os.urandom(600 + i))
+        payloads.append((res.fid, client.read(res.fid)))
+    vid = int(payloads[0][0].split(",", 1)[0])
+    _run_shell(env, "lock")
+    out = _run_shell(
+        env, f"ec.encode -volumeId {vid} -largeBlockSize {L} -smallBlockSize {S}"
+    )
+    assert f"ec.encode volume {vid}" in out
+    out = _run_shell(env, f"ec.convert -volumeId {vid} -family cauchy_12_3")
+    assert "rs_10_4 -> cauchy_12_3" in out and "cut over" in out
+    # the master's shard map now carries the 15-shard geometry
+    spread = {}
+    for n in env.topology_nodes():
+        for e in n.get("ec_shards", []):
+            if int(e["volume_id"]) == vid:
+                from seaweedfs_tpu.ec.shard_bits import ShardBits
+
+                spread[n["url"]] = ShardBits(e.get("shard_bits", 0)).shard_ids()
+    assert sorted(s for sids in spread.values() for s in sids) == list(range(15))
+    for fid, payload in payloads:
+        assert client.read(fid) == payload, f"{fid} corrupted by conversion"
+    # geometry-aware ec.rebuild: lose shard 14 — an id the legacy
+    # range(14) scan could never see — and prove the shell detects and
+    # rebuilds it on the converted volume
+    import time as time_mod
+
+    from seaweedfs_tpu.ec.shard_bits import ShardBits
+    from seaweedfs_tpu.shell import grpc_addr
+
+    holder_url = next(u for u, sids in spread.items() if 14 in sids)
+    holder = next(n for n in env.topology_nodes() if n["url"] == holder_url)
+    env.vs_call(
+        grpc_addr(holder),
+        "VolumeEcShardsDelete",
+        {"volume_id": vid, "collection": "", "shard_ids": [14]},
+    )
+    deadline = time_mod.time() + 15
+    while time_mod.time() < deadline:
+        held = {
+            s
+            for n in env.topology_nodes()
+            for e in n.get("ec_shards", [])
+            if int(e["volume_id"]) == vid
+            for s in ShardBits(e.get("shard_bits", 0)).shard_ids()
+        }
+        if 14 not in held:
+            break
+        time_mod.sleep(0.2)
+    assert 14 not in held, "heartbeat never dropped the deleted shard"
+    out = _run_shell(env, "ec.rebuild")
+    assert "rebuilt [14]" in out, out
+    for fid, payload in payloads:
+        assert client.read(fid) == payload, f"{fid} corrupted by rebuild"
+
+
+def test_ec_convert_rpc_resume_and_counters(cluster, tmp_path):
+    """RPC-level: a staged (nocutover) conversion leaves the old geometry
+    serving; re-invoking completes cut-over from the journal; the
+    convert byte counters land at the dispatch seam."""
+    from seaweedfs_tpu import stats
+    from seaweedfs_tpu.shell import grpc_addr
+
+    master, servers, client, env = cluster
+    res = client.submit(b"x" * 5000)
+    vid = int(res.fid.split(",", 1)[0])
+    payload = client.read(res.fid)
+    _run_shell(env, "lock")
+    _run_shell(
+        env, f"ec.encode -volumeId {vid} -largeBlockSize {L} -smallBlockSize {S}"
+    )
+    before = stats.EcConvertBytes.labels("written").value
+    out = _run_shell(env, f"ec.convert -volumeId {vid} -family merge_20_4 -nocutover")
+    assert "merge_20_4 (converted)" in out
+    assert stats.EcConvertBytes.labels("written").value > before
+    assert client.read(res.fid) == payload  # old geometry still serving
+    # the staged set + journal live on the converter the shell picked —
+    # its URL is in the command output ("... (converted) on <url>: ...")
+    converter_url = re.search(r" on ([^\s:]+:\d+): read ", out).group(1)
+    holder = next(
+        n for n in env.topology_nodes() if n["url"] == converter_url
+    )
+    # second call: nothing to re-encode (journal says staged) + cutover
+    resp = env.vs_call(
+        grpc_addr(holder),
+        "VolumeEcShardsConvert",
+        {"volume_id": vid, "target_family": "merge_20_4", "cutover": True},
+        timeout=120,
+    )
+    assert resp["mode"] in ("resumed", "converted")
+    assert resp["shard_ids"] == list(range(24))
+    assert client.read(res.fid) == payload
+
+
+# -- bench smoke (the tier-1 byte-accounting gate) ----------------------------
+
+
+def test_bench_convert_smoke_gate(tmp_path):
+    """BENCH_MODE=convert at smoke scale: deterministic byte accounting,
+    ratio <= 0.5 for BOTH geometry pairs, staged output byte-identical
+    to the oracle, measured oracle I/O == the stated formula."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    out = bench._measure_convert(
+        str(tmp_path), dat_bytes=2 << 20, large=128 << 10, small=16 << 10,
+        buffer_size=16 << 10, encoder=_enc(),
+    )
+    assert out["ok"], json.dumps(out, indent=1)
+    for fam in FAMILIES:
+        pair = out["pairs"][fam]
+        assert pair["match"] is True
+        assert pair["moved_over_reencode"] <= 0.5
+        assert pair["oracle_total_measured"] == pair["oracle_total_bytes"]
+
+
+def test_convert_knobs_registered():
+    from seaweedfs_tpu.utils import config
+
+    for name in (
+        "WEEDTPU_CONVERT_BATCH",
+        "WEEDTPU_CONVERT_JOURNAL_MB",
+        "WEEDTPU_CONVERT_VERIFY",
+    ):
+        assert name in config.ENV_REGISTRY
+        assert config.env(name) is not None

@@ -123,13 +123,19 @@ def test_wire_drift_fixture_fires():
     # drift both fire
     assert "inlined" in msgs, findings
     assert "rows_inline" in msgs, findings
+    # the geometry-conversion shapes: the code-family typo and the
+    # byte-accounting response-key drift both fire
+    assert "target_familly" in msgs, findings
+    assert "bytes_wrote" in msgs, findings
     # the legitimate reads stay clean: req["volume_id"] (line 12), the
     # extended slab-read shape's projection/projection_rows (lines 17-18),
-    # and the inline mode-switch read req.get("inline") (line 31) — and
-    # the good "mode" response key on line 33 is flagged only for its BAD
-    # sibling key, never for itself
-    assert not any(f.line in (12, 17, 18, 31) for f in drift), drift
+    # the inline mode-switch read req.get("inline") (line 31), and the
+    # convert shape's target_family/cutover reads (lines 46-47) — and the
+    # good "mode" response key (lines 33/49) is flagged only for its BAD
+    # sibling keys, never for itself
+    assert not any(f.line in (12, 17, 18, 31, 46, 47) for f in drift), drift
     assert "returns key 'mode'" not in msgs, drift
+    assert "returns key 'bytes_read'" not in msgs, drift
 
 
 def test_parse_proto_oneof_fields_belong_to_message():

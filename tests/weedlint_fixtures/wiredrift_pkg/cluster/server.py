@@ -32,3 +32,22 @@ class GenServer:
         bad = req["inlined"]  # BAD: typo of the mode-switch field
         return {"mode": "warm" if not inline else "inline", "rows_inline": bad}
         # "rows_inline" BAD: the response field is inline_rows
+
+
+class ConvertServer:
+    """Geometry-conversion-shaped drift: the handler reads the code-family
+    string via a typo, and books the byte accounting under a response key
+    the schema does not have."""
+
+    def _build(self, svc):
+        svc.add("ConvertShards", self._rpc_convert_shards)
+
+    def _rpc_convert_shards(self, req, ctx):
+        fam = req.get("target_family")  # fine: in ConvertThingRequest
+        cut = req["cutover"]  # fine: the cut-over mode switch
+        bad = req["target_familly"]  # BAD: typo of the code-family field
+        return {
+            "mode": "converted" if cut else "staged",
+            "bytes_read": len(fam or ""),
+            "bytes_wrote": bad,  # BAD: the response field is bytes_written
+        }
