@@ -19,6 +19,8 @@ Checker families (rule ids in brackets):
   env-registry      [env-raw-read, env-unregistered]
   resource-safety   [open-no-ctx, tmpfile-no-unlink]
   wire-drift        [wire-drift]
+  obs-drift         [obs-metric-undeclared, obs-metric-unused,
+                     obs-span-undeclared, obs-span-unused]
 
 Suppression: a finding is intentional iff the offending line (or the
 line above it) carries a comment of the form "weedlint: ignore" plus
@@ -56,6 +58,10 @@ RULES = {
     "open-no-ctx": "open() outside a with/ExitStack context",
     "tmpfile-no-unlink": "NamedTemporaryFile(delete=False) with no unlink/replace in the same function",
     "wire-drift": "contracts.proto, contracts.desc and handler field usage disagree",
+    "obs-metric-undeclared": "a weedtpu_* metric name used in code is not declared in stats/__init__.py",
+    "obs-metric-unused": "a metric declared in stats/__init__.py is never referenced (dead telemetry)",
+    "obs-span-undeclared": "a trace span name used at a call site is missing from obs/trace.py SPAN_NAMES",
+    "obs-span-unused": "a SPAN_NAMES catalog entry has no recording call site",
     "bad-suppression": "weedlint: ignore[...] without a reason, or naming an unknown rule",
     "unused-suppression": "weedlint: ignore[...] that suppresses no finding",
     "parse-error": "source file the analysis (and CI) cannot parse",
@@ -253,5 +259,6 @@ def run(
 from seaweedfs_tpu.analysis import donation  # noqa: E402,F401
 from seaweedfs_tpu.analysis import envreg  # noqa: E402,F401
 from seaweedfs_tpu.analysis import lock_order  # noqa: E402,F401
+from seaweedfs_tpu.analysis import obs_drift  # noqa: E402,F401
 from seaweedfs_tpu.analysis import resources  # noqa: E402,F401
 from seaweedfs_tpu.analysis import wire_drift  # noqa: E402,F401

@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from seaweedfs_tpu import rpc
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.pb import MASTER_SERVICE, AssignResponse, Location
 from seaweedfs_tpu.security import tls
 from seaweedfs_tpu.security.jwt import mint_file_token
@@ -38,6 +39,14 @@ _FAILOVER_ERRORS = (
 
 class ClusterError(Exception):
     pass
+
+
+def _trace_headers() -> dict:
+    """X-Weedtpu-Trace header when a trace is active in this thread —
+    the HTTP half of cross-process propagation (the RPC half rides gRPC
+    metadata inside RpcClient)."""
+    tid = trace_mod.current_trace_id()
+    return {trace_mod.HTTP_HEADER: tid} if tid else {}
 
 
 @dataclass
@@ -211,7 +220,7 @@ class MasterClient:
         if not locations:
             raise ClusterError(f"no locations for volume {vid}")
         last_err: Optional[Exception] = None
-        headers = {}
+        headers = _trace_headers()
         if mime:
             headers["Content-Type"] = mime
         if not auth and self.signing_key:
@@ -242,7 +251,7 @@ class MasterClient:
             locations = self.lookup(vid, refresh=attempt > 0)
             if not locations and attempt > 0:
                 raise ClusterError(f"no locations for volume {vid}")
-            headers = {}
+            headers = _trace_headers()
             if self.read_signing_key:
                 headers["Authorization"] = "Bearer " + mint_file_token(
                     self.read_signing_key, fid

@@ -18,6 +18,7 @@ from typing import Optional
 import grpc
 
 from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.utils import httpd
 from seaweedfs_tpu.cluster.sequence import MemorySequencer
 from seaweedfs_tpu.security.jwt import mint_file_token
@@ -720,9 +721,31 @@ class _MasterHttpHandler(httpd.QuietHandler):
     def _json(self, code: int, obj: dict) -> None:
         import json as _json
 
-        self.send_reply(code, _json.dumps(obj).encode(), "application/json")
+        tid = trace_mod.current_trace_id()
+        self.send_reply(
+            code, _json.dumps(obj).encode(), "application/json",
+            headers={trace_mod.HTTP_HEADER: tid} if tid else None,
+        )
 
     def _route(self):
+        import urllib.parse as _up
+
+        path = _up.urlparse(self.path).path
+        if path == "/debug/traces":
+            self._json(200, trace_mod.debug_payload(self.path))
+            return
+        if path in ("/metrics", "/cluster/healthz"):
+            self._route_inner()  # scrape/probe paths must not churn the ring
+            return
+        with trace_mod.start(
+            "master.http",
+            klass="master",
+            trace_id=self.headers.get(trace_mod.HTTP_HEADER),
+        ):
+            trace_mod.annotate(path=path)
+            self._route_inner()
+
+    def _route_inner(self):
         import urllib.parse as _up
 
         u = _up.urlparse(self.path)

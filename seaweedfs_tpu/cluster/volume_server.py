@@ -34,6 +34,7 @@ import time
 import grpc
 
 from seaweedfs_tpu import rpc, stats
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.ec import convert as convert_mod
 from seaweedfs_tpu.ec import scrub as scrub_mod
 from seaweedfs_tpu.ec import stripe
@@ -281,6 +282,12 @@ class VolumeServer:
     # -- heartbeat -----------------------------------------------------------
 
     def _make_heartbeat(self) -> Heartbeat:
+        stats.VolumeServerVolumeGauge.labels("normal").set(
+            sum(len(loc.volumes) for loc in self.store.locations)
+        )
+        stats.VolumeServerVolumeGauge.labels("ec").set(
+            sum(len(loc.ec_volumes) for loc in self.store.locations)
+        )
         return Heartbeat(
             ip=self.host,
             port=self.port,
@@ -392,61 +399,65 @@ class VolumeServer:
                 else:
                     leader = False
             if not leader:
-                ev.wait(timeout=30.0)
+                with trace_mod.span("ec.lookup", volume=vid, role="waiter"):
+                    ev.wait(timeout=30.0)
                 continue  # re-check the cache; become leader if still cold
-            try:
-                # bounded retry with decorrelated jitter: ONE transient
-                # master hiccup must not fail the leader AND every waiter
-                # of the burst (each would retry the loop, elect a new
-                # leader, and hammer the recovering master in lockstep).
-                # Only TRANSIENT failures retry — an application-level
-                # fault from a healthy master is final on first answer,
-                # and re-asking would just hold the single-flight
-                # leadership while every waiter queues behind a sleep.
-                retries = int(config.env("WEEDTPU_LOOKUP_RETRIES"))
-                delay = 0.05
-                for attempt in range(retries + 1):
-                    try:
-                        resp = self._master_query(
-                            "LookupEcVolume", {"volume_id": vid}
-                        )
-                        break
-                    except grpc.RpcError as e:
-                        if attempt >= retries or e.code() not in (
-                            grpc.StatusCode.UNAVAILABLE,
-                            grpc.StatusCode.DEADLINE_EXCEEDED,
-                        ):
-                            raise
-                        delay = min(1.0, random.uniform(0.05, delay * 3.0))
-                        time.sleep(delay)
-                    except Exception:  # noqa: BLE001 — transport-level
-                        # (ConnectionError & co. from a dying channel)
-                        if attempt >= retries:
-                            raise
-                        delay = min(1.0, random.uniform(0.05, delay * 3.0))
-                        time.sleep(delay)
-                locs: dict[int, list[str]] = {}
-                for entry in resp.get("shard_id_locations", []):
-                    addrs = [
-                        f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
-                        for locd in entry["locations"]
-                        if locd["url"] != self.url  # not a remote for ourselves
-                    ]
-                    if addrs:
-                        locs[int(entry["shard_id"])] = addrs
-                with self._shard_locs_lock:
-                    # an invalidation that landed mid-lookup means this
-                    # answer may predate it: serve it to OUR callers (they
-                    # asked before the invalidation) but leave the cache
-                    # cold so the invalidator's own lookup goes to the
-                    # master fresh
-                    if self._shard_locs_gen.get(vid, 0) == gen0:
-                        self._shard_locs[vid] = (now + self.ec_lookup_ttl, locs)
-                return locs
-            finally:
-                with self._shard_locs_lock:
-                    self._shard_locs_inflight.pop(vid, None)
-                ev.set()
+            with trace_mod.span(
+                "ec.lookup", volume=vid, role="leader"
+            ):
+                try:
+                    # bounded retry with decorrelated jitter: ONE transient
+                    # master hiccup must not fail the leader AND every waiter
+                    # of the burst (each would retry the loop, elect a new
+                    # leader, and hammer the recovering master in lockstep).
+                    # Only TRANSIENT failures retry — an application-level
+                    # fault from a healthy master is final on first answer,
+                    # and re-asking would just hold the single-flight
+                    # leadership while every waiter queues behind a sleep.
+                    retries = int(config.env("WEEDTPU_LOOKUP_RETRIES"))
+                    delay = 0.05
+                    for attempt in range(retries + 1):
+                        try:
+                            resp = self._master_query(
+                                "LookupEcVolume", {"volume_id": vid}
+                            )
+                            break
+                        except grpc.RpcError as e:
+                            if attempt >= retries or e.code() not in (
+                                grpc.StatusCode.UNAVAILABLE,
+                                grpc.StatusCode.DEADLINE_EXCEEDED,
+                            ):
+                                raise
+                            delay = min(1.0, random.uniform(0.05, delay * 3.0))
+                            time.sleep(delay)
+                        except Exception:  # noqa: BLE001 — transport-level
+                            # (ConnectionError & co. from a dying channel)
+                            if attempt >= retries:
+                                raise
+                            delay = min(1.0, random.uniform(0.05, delay * 3.0))
+                            time.sleep(delay)
+                    locs: dict[int, list[str]] = {}
+                    for entry in resp.get("shard_id_locations", []):
+                        addrs = [
+                            f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
+                            for locd in entry["locations"]
+                            if locd["url"] != self.url  # not a remote for ourselves
+                        ]
+                        if addrs:
+                            locs[int(entry["shard_id"])] = addrs
+                    with self._shard_locs_lock:
+                        # an invalidation that landed mid-lookup means this
+                        # answer may predate it: serve it to OUR callers (they
+                        # asked before the invalidation) but leave the cache
+                        # cold so the invalidator's own lookup goes to the
+                        # master fresh
+                        if self._shard_locs_gen.get(vid, 0) == gen0:
+                            self._shard_locs[vid] = (now + self.ec_lookup_ttl, locs)
+                    return locs
+                finally:
+                    with self._shard_locs_lock:
+                        self._shard_locs_inflight.pop(vid, None)
+                    ev.set()
 
     def _invalidate_shard_locations(self, vid: int) -> None:
         with self._shard_locs_lock:
@@ -491,34 +502,39 @@ class VolumeServer:
                 for addr in locs.get(shard_id, ()):
                     t0 = time.monotonic()
                     attempts[token] = (shard_id, addr, t0)
-                    try:
-                        chunks = self._peer_pool.get(addr).stream(
-                            VOLUME_SERVICE,
-                            "VolumeEcShardRead",
-                            {
-                                "volume_id": vid,
-                                "shard_id": shard_id,
-                                "offset": offset,
-                                "size": size,
-                            },
-                            # one interval, not a bulk copy: a hung holder
-                            # must not pin a degraded read for the 600s
-                            # bulk-stream default — the recover fan-out
-                            # treats a timeout as a miss and uses another
-                            # survivor
-                            timeout=EC_SHARD_READ_TIMEOUT,
-                        )
-                        buf = b"".join(chunks)
-                        if len(buf) == size:
-                            return buf
-                        failed = True  # holder answered short: stale layout
-                    except Exception:  # noqa: BLE001 — try next holder
-                        self._peer_pool.invalidate(addr)
-                        failed = True
-                    finally:
-                        dur = time.monotonic() - t0
-                        if dur > slow_dur:
-                            slow_addr, slow_dur = addr, dur
+                    with trace_mod.span(
+                        "ec.fetch.holder", addr=addr, shard=shard_id
+                    ):
+                        try:
+                            chunks = self._peer_pool.get(addr).stream(
+                                VOLUME_SERVICE,
+                                "VolumeEcShardRead",
+                                {
+                                    "volume_id": vid,
+                                    "shard_id": shard_id,
+                                    "offset": offset,
+                                    "size": size,
+                                },
+                                # one interval, not a bulk copy: a hung holder
+                                # must not pin a degraded read for the 600s
+                                # bulk-stream default — the recover fan-out
+                                # treats a timeout as a miss and uses another
+                                # survivor
+                                timeout=EC_SHARD_READ_TIMEOUT,
+                            )
+                            buf = b"".join(chunks)
+                            if len(buf) == size:
+                                return buf
+                            failed = True  # holder answered short: stale layout
+                            trace_mod.annotate(short=len(buf))
+                        except Exception:  # noqa: BLE001 — try next holder
+                            self._peer_pool.invalidate(addr)
+                            failed = True
+                            trace_mod.annotate(failed=True)
+                        finally:
+                            dur = time.monotonic() - t0
+                            if dur > slow_dur:
+                                slow_addr, slow_dur = addr, dur
                 return None
             finally:
                 attempts.pop(token, None)
@@ -584,18 +600,19 @@ class VolumeServer:
             token = object()
             attempts[token] = (shard_id, addr, time.monotonic())
             try:
-                chunks = self._peer_pool.get(addr).stream(
-                    VOLUME_SERVICE,
-                    "VolumeEcShardRead",
-                    {
-                        "volume_id": vid,
-                        "shard_id": shard_id,
-                        "offset": offset,
-                        "size": size,
-                    },
-                    timeout=EC_SHARD_READ_TIMEOUT,
-                )
-                buf = b"".join(chunks)
+                with trace_mod.span("ec.fetch.holder", addr=addr, shard=shard_id):
+                    chunks = self._peer_pool.get(addr).stream(
+                        VOLUME_SERVICE,
+                        "VolumeEcShardRead",
+                        {
+                            "volume_id": vid,
+                            "shard_id": shard_id,
+                            "offset": offset,
+                            "size": size,
+                        },
+                        timeout=EC_SHARD_READ_TIMEOUT,
+                    )
+                    buf = b"".join(chunks)
                 return buf if len(buf) == size else None
             except Exception:  # noqa: BLE001 — a failed backup is a miss
                 self._peer_pool.invalidate(addr)
@@ -743,6 +760,11 @@ class VolumeServer:
         `_ec_rebuild_remote`); either way the bytes ON DISK are
         re-verified against the `.eci` CRC before the shard re-enters
         serving. True = repaired (or nothing left to repair)."""
+        with trace_mod.ensure("scrub.repair", klass="scrub"):
+            trace_mod.annotate(volume=vid, shard=shard)
+            return self._repair_shard_inner(vid, shard)
+
+    def _repair_shard_inner(self, vid: int, shard: int) -> bool:
         ev = self.store.get_ec_volume(vid)
         if ev is None:
             return True  # volume unmounted/deleted since: nothing to heal
@@ -829,6 +851,11 @@ class VolumeServer:
             return self._heal_needle_read_locked(vid, needle_id, cookie)
 
     def _heal_needle_read_locked(self, vid: int, needle_id: int, cookie=None):
+        with trace_mod.ensure("heal.verify", klass="scrub"):
+            trace_mod.annotate(volume=vid, needle=needle_id)
+            return self._heal_needle_read_hunt(vid, needle_id, cookie)
+
+    def _heal_needle_read_hunt(self, vid: int, needle_id: int, cookie=None):
         ev = self._open_ec_volume(vid)
         if ev is None:
             raise IOError(f"needle {needle_id:x}: body crc mismatch")
@@ -1530,13 +1557,18 @@ class VolumeServer:
         collection = req.get("collection", "")
         base = self._base_path_for(vid, collection)
         t0 = time.monotonic()
-        if not req.get("remote"):
-            rebuilt = stripe.rebuild_ec_files(
-                base, encoder=stripe.encoder_for_base(base, self.store.encoder)
+        with trace_mod.ensure("rebuild.run", klass="maint"):
+            trace_mod.annotate(volume=vid, remote=bool(req.get("remote")))
+            if not req.get("remote"):
+                rebuilt = stripe.rebuild_ec_files(
+                    base, encoder=stripe.encoder_for_base(base, self.store.encoder)
+                )
+                stats.EcRebuildSeconds.observe(time.monotonic() - t0)
+                return {"rebuilt_shard_ids": rebuilt}
+            resp = self._ec_rebuild_remote(vid, collection, base, req)
+            trace_mod.annotate(
+                mode=resp.get("mode"), wire_bytes=resp.get("wire_bytes")
             )
-            stats.EcRebuildSeconds.observe(time.monotonic() - t0)
-            return {"rebuilt_shard_ids": rebuilt}
-        resp = self._ec_rebuild_remote(vid, collection, base, req)
         stats.EcRebuildSeconds.observe(time.monotonic() - t0)
         return resp
 
@@ -2033,7 +2065,10 @@ class VolumeServer:
             kwargs["max_batch_bytes"] = int(req["max_batch_bytes"])
         if int(req.get("journal_bytes") or 0) > 0:
             kwargs["journal_bytes"] = int(req["journal_bytes"])
-        with self.maintenance_lock(vid):
+        with self.maintenance_lock(vid), trace_mod.ensure(
+            "convert.run", klass="maint"
+        ):
+            trace_mod.annotate(volume=vid, family=family)
             if not stripe.find_local_shards(base):
                 raise rpc.NotFoundFault(f"no local shards for volume {vid}")
             try:
@@ -2376,6 +2411,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         headers: Optional[dict] = None,
     ) -> None:
         self.send_response(code)
+        # the trace id rides back on EVERY reply of a traced request, so
+        # a client can correlate its latency with the server-side span
+        # tree (/debug/traces, glog grep) without guessing
+        tid = trace_mod.current_trace_id()
+        if tid:
+            self.send_header(trace_mod.HTTP_HEADER, tid)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         for k, v in (headers or {}).items():
@@ -2397,6 +2438,32 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         )
 
     def _serve_get(self, head: bool) -> None:
+        path = urllib.parse.urlparse(self.path).path
+        if path == "/debug/traces":
+            self._reply(
+                200,
+                json.dumps(trace_mod.debug_payload(self.path)).encode(),
+                "application/json",
+                head=head,
+            )
+            return
+        if path not in ("/metrics", "/status", "/ui", "/ui/index.html"):
+            # needle reads are the traced serving path; debug/status
+            # surfaces must not churn the ring
+            t0 = time.monotonic()
+            with trace_mod.start(
+                "http.read",
+                klass="healthy",
+                trace_id=self.headers.get(trace_mod.HTTP_HEADER),
+            ):
+                self._serve_get_inner(head)
+            stats.VolumeServerRequestHistogram.labels("get").observe(
+                time.monotonic() - t0
+            )
+            return
+        self._serve_get_inner(head)
+
+    def _serve_get_inner(self, head: bool) -> None:
         if urllib.parse.urlparse(self.path).path == "/metrics":
             self._reply(
                 200,
@@ -2589,6 +2656,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         return "; ".join(errs) or None
 
     def do_POST(self) -> None:
+        t0 = time.monotonic()
+        with trace_mod.start(
+            "http.write",
+            klass="put",
+            trace_id=self.headers.get(trace_mod.HTTP_HEADER),
+        ):
+            self._do_post_inner()
+        stats.VolumeServerRequestHistogram.labels("post").observe(
+            time.monotonic() - t0
+        )
+
+    def _do_post_inner(self) -> None:
         stats.VolumeServerRequestCounter.labels("post").inc()
         fid = self._parse_fid()
         if fid is None:

@@ -47,6 +47,7 @@ import numpy as np
 
 from seaweedfs_tpu.ec import locate
 from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.ops import gf8
 from seaweedfs_tpu.ops.rs_codec import (
     CodeGeometry,
@@ -494,24 +495,30 @@ def convert_ec_files(
                 row = done
                 while row < n_rows:
                     n = min(rows_per_chunk, n_rows - row)
-                    stripe._encode_rows(
-                        vdat,
-                        enc_tgt,
-                        outputs,
-                        region_start + row * block * k_t,
-                        block,
-                        n,
-                        min(buffer_size, block),
-                        batch,
-                        pipeline_depth,
-                        crcs,
-                        ring_cache=ring_cache,
-                    )
-                    row += n
-                    written_since_mark += n * row_bytes
-                    if written_since_mark >= jbytes or row >= n_rows:
-                        mark(*((row, 0) if is_large else (n_large, row)))
-                        written_since_mark = 0
+                    with trace_mod.span(
+                        "convert.chunk",
+                        tier="large" if is_large else "small",
+                        row=row,
+                        rows=n,
+                    ):
+                        stripe._encode_rows(
+                            vdat,
+                            enc_tgt,
+                            outputs,
+                            region_start + row * block * k_t,
+                            block,
+                            n,
+                            min(buffer_size, block),
+                            batch,
+                            pipeline_depth,
+                            crcs,
+                            ring_cache=ring_cache,
+                        )
+                        row += n
+                        written_since_mark += n * row_bytes
+                        if written_since_mark >= jbytes or row >= n_rows:
+                            mark(*((row, 0) if is_large else (n_large, row)))
+                            written_since_mark = 0
 
             if done_small == 0:
                 run_phase(large, n_large, done_large, 0, True)

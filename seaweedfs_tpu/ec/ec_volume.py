@@ -24,6 +24,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from seaweedfs_tpu import stats
+from seaweedfs_tpu.obs import trace as trace_mod
 
 from seaweedfs_tpu.ec import locate as locate_mod
 from seaweedfs_tpu.ec import stripe
@@ -518,10 +519,14 @@ class EcVolume:
         if self.remote_reader is None or self._holder_suspected(shard_id):
             return None
         started: list[float] = []
+        parent = trace_mod.current()
 
         def _call():
             started.append(_time.monotonic())
-            return self.remote_reader(shard_id, offset, size)
+            with trace_mod.attach(parent), trace_mod.span(
+                "ec.fetch", shard=shard_id
+            ):
+                return self.remote_reader(shard_id, offset, size)
 
         cap = self.recover_holder_timeout
         fut = self._fetch_executor().submit(_call)
@@ -590,10 +595,12 @@ class EcVolume:
         a hot needle on a lost shard costs one survivor fan-out + decode,
         with every waiter handed a byte-identical copy."""
         t0 = _time.monotonic()
+        trace_mod.set_class("degraded")
         try:
-            if not config.env("WEEDTPU_COALESCE_READS"):
-                return self._recover_interval_inner(shard_id, offset, size)
-            return self._recover_interval_coalesced(shard_id, offset, size)
+            with trace_mod.span("ec.recover", shard=shard_id, size=size):
+                if not config.env("WEEDTPU_COALESCE_READS"):
+                    return self._recover_interval_inner(shard_id, offset, size)
+                return self._recover_interval_coalesced(shard_id, offset, size)
         finally:
             # DegradedReadSeconds is the CLIENT-facing latency (waiters
             # included); EcReconstructSeconds counts actual decodes and is
@@ -616,7 +623,11 @@ class EcVolume:
             # fetch deadline + one holder cap; a vanished leader (killed
             # thread) must not strand waiters forever
             budget = self.recover_fetch_deadline + self.recover_holder_timeout + 5.0
-            if slot.event.wait(timeout=budget):
+            with trace_mod.span("ec.coalesce.wait", shard=shard_id) as sp:
+                won = slot.event.wait(timeout=budget)
+                if sp is not None:
+                    sp.annotate(served_by_leader=won)
+            if won:
                 if slot.error is not None:
                     raise slot.error
                 assert slot.result is not None
@@ -650,7 +661,12 @@ class EcVolume:
         t0 = _time.monotonic()
         try:
             shards = self._gather_survivors(shard_id, offset, size)
-            rec = self.encoder.reconstruct(shards, wanted=[shard_id])
+            with trace_mod.span(
+                "ec.decode",
+                backend=getattr(self.encoder, "backend", "?"),
+                width=size,
+            ):
+                rec = self.encoder.reconstruct(shards, wanted=[shard_id])
             return rec[shard_id]
         finally:
             stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
@@ -661,6 +677,12 @@ class EcVolume:
         """Collect >= DATA_SHARDS survivor copies of one interval (local
         first, then a parallel remote fan-out). Raises IOError when too few
         survivors are reachable."""
+        with trace_mod.span("ec.gather", shard=shard_id):
+            return self._gather_survivors_fanout(shard_id, offset, size)
+
+    def _gather_survivors_fanout(
+        self, shard_id: int, offset: int, size: int
+    ) -> list[Optional[np.ndarray]]:
         shards: list[Optional[np.ndarray]] = [None] * self.total_shards
         have = 0
         # local shards first — remote reads cost RTTs on the p50-critical path
@@ -686,13 +708,21 @@ class EcVolume:
             # suspected-wedged holders are skipped outright: the fan-out
             # needs only `need` of the remaining survivors, and a holder
             # inside its backoff window would just burn a pool thread
-            candidates = [
-                s
-                for s in range(self.total_shards)
-                if s != shard_id
-                and shards[s] is None
-                and not self._holder_suspected(s)
-            ]
+            candidates = []
+            skipped_suspected = []
+            for s in range(self.total_shards):
+                if s == shard_id or shards[s] is not None:
+                    continue
+                if self._holder_suspected(s):
+                    skipped_suspected.append(s)
+                else:
+                    candidates.append(s)
+            trace_mod.annotate(
+                local=have, need=need,
+                **({"skipped_suspected": skipped_suspected}
+                   if skipped_suspected else {}),
+            )
+            fan_parent = trace_mod.current()
             pool = self._fetch_executor()
             # per-holder cap is measured from each call's ACTUAL start (a
             # queued attempt waiting for a pool slot is not the holder's
@@ -705,7 +735,10 @@ class EcVolume:
 
             def _attempt(s: int):
                 started[s] = _time.monotonic()
-                return self.remote_reader(s, offset, size)
+                with trace_mod.attach(fan_parent), trace_mod.span(
+                    "ec.fetch", shard=s
+                ):
+                    return self.remote_reader(s, offset, size)
 
             futs = {pool.submit(_attempt, s): s for s in candidates}
             primaries = {sid: fut for fut, sid in futs.items()}
@@ -722,6 +755,7 @@ class EcVolume:
             hedges: dict[int, object] = {}
             hedge_targets: dict[int, Optional[str]] = {}
             hedge_futs: set = set()
+            hedge_wins: list[int] = []
             winners: dict[int, bytes] = {}
             deadline = _time.monotonic() + self.recover_fetch_deadline
             cap = self.recover_holder_timeout
@@ -831,6 +865,7 @@ class EcVolume:
                             have += 1
                             if is_hedge:
                                 stats.HedgeWon.inc()
+                                hedge_wins.append(sid)
                             other = (
                                 primaries.get(sid) if is_hedge else hedges.get(sid)
                             )
@@ -854,6 +889,13 @@ class EcVolume:
                                 else:
                                     self._mark_holder_suspect(sid)
             finally:
+                fired = sorted(s for s, f in hedges.items() if f is not None)
+                trace_mod.annotate(
+                    gathered=have,
+                    **({"hedges_fired": fired} if fired else {}),
+                    **({"hedges_won": hedge_wins} if hedge_wins else {}),
+                    **({"deadline_expired": True} if deadline_expired else {}),
+                )
                 # EVERY exit (normal, deadline, or an exception raised
                 # mid-loop) cancels what never started and drains what did:
                 # the discard callback drops a late result/exception on the
@@ -967,13 +1009,17 @@ class EcVolume:
                 return None
             target = alts[0]
         hedge_targets[shard_id] = target
+        parent = trace_mod.current()
 
         def _backup():
             hedge_started[shard_id] = _time.monotonic()
             stats.HedgeFired.inc()
-            if target is not None:
-                return via(target, shard_id, offset, size)
-            return reader(shard_id, offset, size)
+            with trace_mod.attach(parent), trace_mod.span(
+                "ec.hedge", shard=shard_id, **({"addr": target} if target else {})
+            ):
+                if target is not None:
+                    return via(target, shard_id, offset, size)
+                return reader(shard_id, offset, size)
 
         return pool.submit(_backup)
 
@@ -1023,40 +1069,55 @@ class EcVolume:
             off, size = items[0]
             return [self._recover_interval(shard_id, off, size)]
         t0 = _time.monotonic()
+        trace_mod.set_class("degraded")
         try:
-            gathered = [
-                self._gather_survivors(shard_id, off, size) for off, size in items
-            ]
-            results: list[Optional[np.ndarray]] = [None] * len(items)
-            # distinct survivor sets decode with distinct matrices; in the
-            # common case (stable shard availability) there is ONE group
-            groups: dict[tuple, list[int]] = {}
-            for idx, shards in enumerate(gathered):
-                present = tuple(
-                    i for i, s in enumerate(shards) if s is not None
-                )[: self.data_shards]
-                groups.setdefault(present, []).append(idx)
-            for survivors, idxs in groups.items():
-                nmax = max(items[i][1] for i in idxs)
-                stack = np.zeros(
-                    (len(idxs), self.data_shards, nmax), dtype=np.uint8
-                )
-                for bi, i in enumerate(idxs):
-                    for di, s in enumerate(survivors):
-                        arr = gathered[i][s]
-                        stack[bi, di, : arr.shape[0]] = arr
-                # bucketed: the encoder's own serving-path shape buckets,
-                # so odd interval sizes never pay a fresh XLA compile
-                out = self.encoder.reconstruct_batch(
-                    stack, survivors, [shard_id], bucketed=True
-                )
-                for bi, i in enumerate(idxs):
-                    results[i] = np.ascontiguousarray(out[bi, 0, : items[i][1]])
-            return results
+            with trace_mod.span(
+                "ec.recover", shard=shard_id, batch=len(items)
+            ):
+                return self._recover_intervals_batch_inner(shard_id, items)
         finally:
             dt = _time.monotonic() - t0
             stats.EcReconstructSeconds.observe(dt)
             stats.DegradedReadSeconds.observe(dt)
+
+    def _recover_intervals_batch_inner(
+        self, shard_id: int, items: list[tuple[int, int]]
+    ) -> list[np.ndarray]:
+        gathered = [
+            self._gather_survivors(shard_id, off, size) for off, size in items
+        ]
+        results: list[Optional[np.ndarray]] = [None] * len(items)
+        # distinct survivor sets decode with distinct matrices; in the
+        # common case (stable shard availability) there is ONE group
+        groups: dict[tuple, list[int]] = {}
+        for idx, shards in enumerate(gathered):
+            present = tuple(
+                i for i, s in enumerate(shards) if s is not None
+            )[: self.data_shards]
+            groups.setdefault(present, []).append(idx)
+        for survivors, idxs in groups.items():
+            nmax = max(items[i][1] for i in idxs)
+            stack = np.zeros(
+                (len(idxs), self.data_shards, nmax), dtype=np.uint8
+            )
+            for bi, i in enumerate(idxs):
+                for di, s in enumerate(survivors):
+                    arr = gathered[i][s]
+                    stack[bi, di, : arr.shape[0]] = arr
+            # bucketed: the encoder's own serving-path shape buckets,
+            # so odd interval sizes never pay a fresh XLA compile
+            with trace_mod.span(
+                "ec.decode",
+                backend=getattr(self.encoder, "backend", "?"),
+                batch=len(idxs),
+                width=nmax,
+            ):
+                out = self.encoder.reconstruct_batch(
+                    stack, survivors, [shard_id], bucketed=True
+                )
+            for bi, i in enumerate(idxs):
+                results[i] = np.ascontiguousarray(out[bi, 0, : items[i][1]])
+        return results
 
     def read_intervals(self, intervals: list[locate_mod.Interval]) -> bytes:
         """Read every interval, batching the ones that need reconstruction:
@@ -1084,6 +1145,10 @@ class EcVolume:
     def read_needle_blob(self, needle_id: int) -> bytes:
         """The raw on-disk needle record (ReadEcShardNeedle minus parsing)."""
         _, _, intervals = self.locate_needle(needle_id)
+        # an EC-volume read starts as intact; a reconstructing interval
+        # upgrades the trace class to "degraded" inside the recover path
+        if trace_mod.current_class() == "healthy":
+            trace_mod.set_class("ec_intact")
         return self.read_intervals(intervals)
 
     # -- deletes -------------------------------------------------------------

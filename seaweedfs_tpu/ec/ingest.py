@@ -51,6 +51,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.ec.constants import (
     DATA_SHARDS_COUNT,
     EC_BUFFER_SIZE,
@@ -334,6 +335,26 @@ class InlineStripeBuilder:
         f = self._dat_handle()
         for h in self._parts:
             h.seek(self.rows_done * self.large)
+        with trace_mod.start("ingest.encode", klass="ingest") as sp:
+            if sp is not None:
+                sp.annotate(rows=n_rows, row_start=self.rows_done)
+            self._encode_large_rows(f, n_rows)
+        self.rows_done += n_rows
+        undurable = self.rows_done - max(self._durable_rows, self._flush_submitted_rows)
+        if undurable * self._large_row >= self._durable_batch:
+            # async: the encode lane keeps rolling while the flusher
+            # thread makes the batch durable (fsync-before-record
+            # ordering preserved inside the job)
+            self._flush_watermark(wait=False)
+        try:
+            from seaweedfs_tpu import stats
+
+            stats.InlineEcRows.inc(n_rows)
+            stats.InlineEcBytes.inc(n_rows * self._large_row)
+        except Exception:  # noqa: BLE001 — metrics must never break ingest
+            pass
+
+    def _encode_large_rows(self, f, n_rows: int) -> None:
         stripe._encode_rows(
             f,
             self._enc,
@@ -353,20 +374,6 @@ class InlineStripeBuilder:
             self.crcs,
             ring_cache=self._ring_cache,
         )
-        self.rows_done += n_rows
-        undurable = self.rows_done - max(self._durable_rows, self._flush_submitted_rows)
-        if undurable * self._large_row >= self._durable_batch:
-            # async: the encode lane keeps rolling while the flusher
-            # thread makes the batch durable (fsync-before-record
-            # ordering preserved inside the job)
-            self._flush_watermark(wait=False)
-        try:
-            from seaweedfs_tpu import stats
-
-            stats.InlineEcRows.inc(n_rows)
-            stats.InlineEcBytes.inc(n_rows * self._large_row)
-        except Exception:  # noqa: BLE001 — metrics must never break ingest
-            pass
 
     def _journal_append(self, record: dict) -> None:
         with self._journal_lock:
@@ -602,7 +609,8 @@ class InlineStripeBuilder:
         large rows and the small-row tail, recompute shard CRCs when a
         delta invalidated the streamed ones, fsync, and rename the
         partials into place. Returns the amortization accounting."""
-        with self._lock:
+        with trace_mod.ensure("ingest.seal", klass="ingest"), self._lock:
+            trace_mod.annotate(rows_inline=self.rows_done)
             if self.broken or self.closed:
                 raise IOError(f"{self.base}: inline stripe state unusable")
             dat_size = os.path.getsize(self.base + ".dat")

@@ -43,6 +43,7 @@ from typing import Callable, Iterable, Optional
 
 from seaweedfs_tpu import stats
 from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.obs import trace as trace_mod
 
 
 #: finding classes — the detection taxonomy the counters/quarantine use
@@ -357,6 +358,17 @@ class Scrubber:
         "unverifiable"} — the findings were already delivered to the
         callback one by one, as found (repair should not wait for the
         cycle to finish)."""
+        with trace_mod.start("scrub.cycle", klass="scrub") as sp:
+            out = self._run_cycle_inner()
+            if sp is not None:
+                sp.annotate(
+                    scanned_bytes=out["scanned_bytes"],
+                    shards_ok=out["shards_ok"],
+                    findings=len(out["findings"]),
+                )
+            return out
+
+    def _run_cycle_inner(self) -> dict:
         out = {
             "scanned_bytes": 0,
             "shards_ok": 0,

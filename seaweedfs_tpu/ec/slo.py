@@ -246,6 +246,99 @@ REPORT_SCHEMA_KEYS = (
 )
 
 
+# -- per-stage tail attribution (weedtrace aggregation) -----------------------
+
+#: keys every TRACE_ATTRIB_r*.json must carry
+TRACE_ATTRIB_SCHEMA_KEYS = (
+    "when", "kind", "trace_count", "classes", "slowest",
+)
+
+
+def assemble_trace_attribution(
+    traces: list,
+    classes: tuple = ("healthy", "ec_intact", "degraded", "put"),
+    kinds: tuple = ("http.read", "http.write"),
+    slowest_n: int = 5,
+) -> dict:
+    """Fold scraped `/debug/traces` span trees into per-stage tail
+    attribution: for each traffic class, the p50/p99 of the seconds each
+    STAGE (span name) contributed to its requests' end-to-end latency.
+
+    Stage seconds come from `obs.trace.attribute_stages`, which assigns
+    every span its self-time and scales parallel children down to the
+    wall time that actually passed — so per trace the stage seconds sum
+    EXACTLY to the end-to-end duration, and per class
+    `sum(stages[*].total_s) == e2e_total_s` (reported as
+    `stage_coverage`, 1.0 by construction; the consistency gate the
+    artifact is committed under). The `slowest` section carries the
+    `slowest_n` slowest full traces (span trees included) across the
+    selected classes — the exemplars behind the quantiles."""
+    from seaweedfs_tpu.obs import trace as trace_mod
+
+    picked = [
+        t for t in traces
+        if t.get("kind") in kinds and t.get("class") in classes
+    ]
+    e2e: dict[str, _Cell] = {}
+    stage_cells: dict[str, dict[str, _Cell]] = {}
+    stage_totals: dict[str, dict[str, float]] = {}
+    e2e_totals: dict[str, float] = {}
+    for t in picked:
+        klass = t["class"]
+        e2e.setdefault(klass, _Cell()).observe(t["duration_s"])
+        e2e_totals[klass] = e2e_totals.get(klass, 0.0) + t["duration_s"]
+        for stage, secs in trace_mod.attribute_stages(t).items():
+            stage_cells.setdefault(klass, {}).setdefault(
+                stage, _Cell()
+            ).observe(secs)
+            tot = stage_totals.setdefault(klass, {})
+            tot[stage] = tot.get(stage, 0.0) + secs
+    out_classes: dict[str, dict] = {}
+    for klass, cell in sorted(e2e.items()):
+        e2e_total = e2e_totals.get(klass, 0.0)
+        stages = {}
+        for stage, scell in sorted((stage_cells.get(klass) or {}).items()):
+            s = scell.summary()
+            total = stage_totals[klass][stage]
+            stages[stage] = {
+                "count": s["count"],
+                "p50": s["p50"],
+                "p99": s["p99"],
+                "mean": s["mean"],
+                "total_s": round(total, 6),
+                # which stage OWNS the class's latency, in one number
+                "share": round(total / e2e_total, 4) if e2e_total else 0.0,
+            }
+        stage_sum = sum(v["total_s"] for v in stages.values())
+        out_classes[klass] = {
+            "count": cell.summary()["count"],
+            "e2e": cell.summary(),
+            "stages": stages,
+            "e2e_total_s": round(e2e_total, 6),
+            "stage_total_s": round(stage_sum, 6),
+            "stage_coverage": (
+                round(stage_sum / e2e_total, 4) if e2e_total else 1.0
+            ),
+        }
+    slowest = sorted(picked, key=lambda t: t["duration_s"], reverse=True)
+    return {
+        "when": time.strftime("%FT%TZ", time.gmtime()),
+        "kind": "trace_attrib",
+        "trace_count": len(picked),
+        "classes": out_classes,
+        "slowest": slowest[: max(0, int(slowest_n))],
+    }
+
+
+def write_trace_attribution(path: str, attrib: dict) -> None:
+    for key in TRACE_ATTRIB_SCHEMA_KEYS:
+        if key not in attrib:
+            raise ValueError(f"trace attribution missing required key {key!r}")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(attrib, f, indent=1)
+
+
 def write_report(path: str, report: dict) -> None:
     for key in REPORT_SCHEMA_KEYS:
         if key not in report:

@@ -50,6 +50,7 @@ from seaweedfs_tpu.ops.rs_codec import (
     geometry_for,
     new_encoder,
 )
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types
 from seaweedfs_tpu.storage.needle_map import MemDb
@@ -230,7 +231,8 @@ def _encode_rows(
 
     def drain_one() -> None:
         parity, width = inflight.popleft()
-        parity_np = np.asarray(parity)  # sync point
+        with trace_mod.span("encode.drain", width=width):
+            parity_np = np.asarray(parity)  # sync point
         if k + parity_np.shape[0] != len(outputs):
             # a geometry-mismatched encoder must fail loudly, not leave
             # trailing .ecNN files silently empty
@@ -250,32 +252,34 @@ def _encode_rows(
         width = len(batch) * buffer_size
         while len(inflight) >= depth:
             drain_one()
-        staging = ring.take()
-        # read runs of consecutive segments as one contiguous slab per shard
-        # (k large sequential reads per row-run instead of one seek per
-        # segment x shard — keeps readahead alive at 1 GiB block strides)
-        i = 0
-        while i < len(batch):
-            row, seg0 = batch[i]
-            j = i
-            while j + 1 < len(batch) and batch[j + 1] == (row, batch[j][1] + 1):
-                j += 1
-            row_start = start_offset + row * block_size * k
+        with trace_mod.span("encode.stage", width=width):
+            staging = ring.take()
+            # read runs of consecutive segments as one contiguous slab per
+            # shard (k large sequential reads per row-run instead of one
+            # seek per segment x shard — keeps readahead alive at 1 GiB
+            # block strides)
+            i = 0
+            while i < len(batch):
+                row, seg0 = batch[i]
+                j = i
+                while j + 1 < len(batch) and batch[j + 1] == (row, batch[j][1] + 1):
+                    j += 1
+                row_start = start_offset + row * block_size * k
+                for d in range(k):
+                    read_padded_into(
+                        f,
+                        row_start + d * block_size + seg0 * buffer_size,
+                        staging[d, i * buffer_size : (j + 1) * buffer_size],
+                    )
+                i = j + 1
+            view = staging[:, :width]
             for d in range(k):
-                read_padded_into(
-                    f,
-                    row_start + d * block_size + seg0 * buffer_size,
-                    staging[d, i * buffer_size : (j + 1) * buffer_size],
-                )
-            i = j + 1
-        view = staging[:, :width]
-        for d in range(k):
-            outputs[d].write(view[d])
-            if crcs is not None:
-                crcs[d] = zlib.crc32(view[d], crcs[d])
-        aw = _aligned(width, align)  # <= span: roundup is monotone
-        if aw > width:
-            staging[:, width:aw] = 0  # tail batch: pad columns are zeros
+                outputs[d].write(view[d])
+                if crcs is not None:
+                    crcs[d] = zlib.crc32(view[d], crcs[d])
+            aw = _aligned(width, align)  # <= span: roundup is monotone
+            if aw > width:
+                staging[:, width:aw] = 0  # tail batch: pad columns are zeros
         inflight.append((enc.encode_parity_lazy(staging[:, :aw], donate=True), width))
 
     try:
@@ -667,6 +671,11 @@ class RemoteSlabSource(SlabSource):
         self._stripe = max(64 * 1024, int(stripe_bytes))
         self._deadline = fetch_deadline
         self._lock = threading.Lock()
+        # the rebuild's ambient span, captured at construction: fetches
+        # run on pool threads, and the holder-bound RPCs must carry the
+        # rebuild's trace id across the wire (ContextVars don't cross
+        # executor submission)
+        self._trace_parent = trace_mod.current()
         self._fanout = DEFAULT_SLAB_FANOUT if fanout is None else max(1, int(fanout))
         #: holder -> fetches currently running against it (striping load)
         self._inflight: dict[str, int] = {}
@@ -706,6 +715,10 @@ class RemoteSlabSource(SlabSource):
             return addr
 
     def _fetch_range(self, offset: int, size: int) -> bytes:
+        with trace_mod.attach(self._trace_parent):
+            return self._fetch_range_inner(offset, size)
+
+    def _fetch_range_inner(self, offset: int, size: int) -> bytes:
         while True:
             live = self._live_holders()
             if not live:
@@ -831,6 +844,9 @@ class TraceSlabSource(SlabSource):
             int(config.env("WEEDTPU_TRACE_CHUNK") if chunk_bytes is None else chunk_bytes),
         )
         self._lock = threading.Lock()
+        # same bridge as RemoteSlabSource: projection fetches run on pool
+        # threads but must ride the rebuild's trace id over the wire
+        self._trace_parent = trace_mod.current()
         self._own_executor = executor is None
         workers = DEFAULT_SLAB_FANOUT if fanout is None else max(1, int(fanout))
         self._ex = executor or ThreadPoolExecutor(
@@ -840,6 +856,10 @@ class TraceSlabSource(SlabSource):
         self._pending: dict[int, tuple[int, list]] = {}
 
     def _fetch_counted(self, offset: int, size: int) -> bytes:
+        with trace_mod.attach(self._trace_parent):
+            return self._fetch_counted_inner(offset, size)
+
+    def _fetch_counted_inner(self, offset: int, size: int) -> bytes:
         data = self._fetch(offset, size)
         if len(data) % self.rows:
             raise IOError(
@@ -1005,11 +1025,12 @@ def rebuild_ec_files_from_projections(
 
             def drain_one() -> None:
                 lazy, valid, width = inflight.popleft()
-                out = np.asarray(lazy).reshape(rows, width)  # sync point
-                for k, s in enumerate(missing):
-                    row = np.ascontiguousarray(out[k, :valid])
-                    outs[s].write(row)
-                    crcs[s] = zlib.crc32(row, crcs[s])
+                with trace_mod.span("rebuild.drain", width=width):
+                    out = np.asarray(lazy).reshape(rows, width)  # sync point
+                    for k, s in enumerate(missing):
+                        row = np.ascontiguousarray(out[k, :valid])
+                        outs[s].write(row)
+                        crcs[s] = zlib.crc32(row, crcs[s])
 
             def issue_prefetch(bi: int) -> None:
                 if bi < len(batches):
@@ -1024,9 +1045,10 @@ def rebuild_ec_files_from_projections(
                     issue_prefetch(bi + ahead)  # network runs ahead of reads
                     while len(inflight) >= depth:
                         drain_one()
-                    staging = ring.take()
-                    for i, g in enumerate(groups):
-                        g.read_into(off, staging[i, : rows * width])
+                    with trace_mod.span("rebuild.stage", batch=bi, width=width):
+                        staging = ring.take()
+                        for i, g in enumerate(groups):
+                            g.read_into(off, staging[i, : rows * width])
                     combined = enc.project_lazy(
                         combine, staging[:, : rows * width], donate=True
                     )  # async
@@ -1110,11 +1132,12 @@ def rebuild_ec_files_from_sources(
 
             def drain_one() -> None:
                 lazy, valid = inflight.popleft()
-                out = np.asarray(lazy)  # (len(missing), width) — sync point
-                for k, s in enumerate(missing):
-                    row = out[k, :valid]
-                    outs[s].write(row)
-                    crcs[s] = zlib.crc32(row, crcs[s])
+                with trace_mod.span("rebuild.drain", width=valid):
+                    out = np.asarray(lazy)  # (len(missing), width) — sync point
+                    for k, s in enumerate(missing):
+                        row = out[k, :valid]
+                        outs[s].write(row)
+                        crcs[s] = zlib.crc32(row, crcs[s])
 
             def issue_prefetch(bi: int) -> None:
                 if bi < len(batches):
@@ -1129,12 +1152,13 @@ def rebuild_ec_files_from_sources(
                     issue_prefetch(bi + ahead)  # network runs ahead of reads
                     while len(inflight) >= depth:
                         drain_one()
-                    staging = ring.take()
-                    for i, s in enumerate(survivors):
-                        sources[s].read_into(off, staging[i, :width])
-                    aw = _aligned(width, align)  # <= span: roundup is monotone
-                    if aw > width:
-                        staging[:, width:aw] = 0  # tail: pad columns are zeros
+                    with trace_mod.span("rebuild.stage", batch=bi, width=width):
+                        staging = ring.take()
+                        for i, s in enumerate(survivors):
+                            sources[s].read_into(off, staging[i, :width])
+                        aw = _aligned(width, align)  # <= span: roundup is monotone
+                        if aw > width:
+                            staging[:, width:aw] = 0  # tail: pad columns are zeros
                     decoded = enc.reconstruct_lazy(
                         staging[:, :aw], survivors, missing, donate=True
                     )  # async

@@ -1,0 +1,13 @@
+"""weedtrace — zero-dependency observability for seaweedfs_tpu.
+
+`obs.trace` is the end-to-end request-tracing layer: context-local
+spans threaded through every hot path (degraded reads, rebuild
+pipelines, scrub/repair, inline ingest, geometry conversion), trace-id
+propagation across the RPC and HTTP seams, and a per-process bounded
+ring of completed traces with tail-biased retention. Surfaces:
+`/debug/traces` on the volume-server/master HTTP fronts, the `ec.trace`
+shell command, and `slo.assemble_trace_attribution` (the per-stage
+tail-attribution artifact weedload commits).
+"""
+
+from seaweedfs_tpu.obs import trace  # noqa: F401 — the package's one module

@@ -22,13 +22,17 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import zlib
 from concurrent import futures
 from typing import Any, Callable, Iterator, Optional
 
 import grpc
 
+from seaweedfs_tpu import stats
+from seaweedfs_tpu.obs import trace as trace_mod
 from seaweedfs_tpu.security import tls
+from seaweedfs_tpu.utils import glog
 
 
 def _json_ser(obj: Any) -> bytes:
@@ -167,26 +171,65 @@ class Service:
         self.methods[name] = Method(fn, **kw)
 
 
-def _wrap_unary(fn):
+def _inbound_trace_id(context) -> Optional[str]:
+    """Propagated trace id from gRPC invocation metadata, if any — the
+    one reserved metadata field tracing rides, so the pinned proto
+    contracts (and every JSON/bytes payload) stay untouched."""
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == trace_mod.MD_KEY:
+                return v if isinstance(v, str) else None
+    except Exception:  # noqa: BLE001 — metadata is best-effort context
+        pass
+    return None
+
+
+def _wrap_unary(fn, method: str = ""):
     def handler(request, context):
+        stats.RpcInflight.labels(method).inc()
+        t0 = time.monotonic()
         try:
-            return fn(request, context)
-        except RpcFault as e:
-            context.abort(e.code, e.detail)
-        except Exception as e:  # noqa: BLE001 — map to INTERNAL for the peer
-            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+            with trace_mod.continue_trace(
+                "rpc.server", _inbound_trace_id(context)
+            ) as sp:
+                if sp is not None:
+                    sp.annotate(method=method)
+                try:
+                    return fn(request, context)
+                except RpcFault as e:
+                    glog.V(1).infof("rpc %s fault: %s", method, e.detail)
+                    context.abort(e.code, e.detail)
+                except Exception as e:  # noqa: BLE001 — map to INTERNAL for the peer
+                    glog.error("rpc %s failed: %s: %s", method, type(e).__name__, e)
+                    context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        finally:
+            stats.RpcInflight.labels(method).dec()
+            stats.RpcServerSeconds.labels(method).observe(time.monotonic() - t0)
 
     return handler
 
 
-def _wrap_stream(fn):
+def _wrap_stream(fn, method: str = ""):
     def handler(request, context):
+        stats.RpcInflight.labels(method).inc()
+        t0 = time.monotonic()
         try:
-            yield from fn(request, context)
-        except RpcFault as e:
-            context.abort(e.code, e.detail)
-        except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+            with trace_mod.continue_trace(
+                "rpc.server", _inbound_trace_id(context)
+            ) as sp:
+                if sp is not None:
+                    sp.annotate(method=method)
+                try:
+                    yield from fn(request, context)
+                except RpcFault as e:
+                    glog.V(1).infof("rpc %s fault: %s", method, e.detail)
+                    context.abort(e.code, e.detail)
+                except Exception as e:  # noqa: BLE001
+                    glog.error("rpc %s failed: %s: %s", method, type(e).__name__, e)
+                    context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        finally:
+            stats.RpcInflight.labels(method).dec()
+            stats.RpcServerSeconds.labels(method).observe(time.monotonic() - t0)
 
     return handler
 
@@ -209,18 +252,18 @@ class _GenericHandler(grpc.GenericRpcHandler):
         )
         if m.kind == "unary_unary":
             return grpc.unary_unary_rpc_method_handler(
-                _wrap_unary(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+                _wrap_unary(m.fn, m_name), request_deserializer=req_de, response_serializer=resp_ser
             )
         if m.kind == "unary_stream":
             return grpc.unary_stream_rpc_method_handler(
-                _wrap_stream(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+                _wrap_stream(m.fn, m_name), request_deserializer=req_de, response_serializer=resp_ser
             )
         if m.kind == "stream_unary":
             return grpc.stream_unary_rpc_method_handler(
-                _wrap_unary(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+                _wrap_unary(m.fn, m_name), request_deserializer=req_de, response_serializer=resp_ser
             )
         return grpc.stream_stream_rpc_method_handler(
-            _wrap_stream(m.fn), request_deserializer=req_de, response_serializer=resp_ser
+            _wrap_stream(m.fn, m_name), request_deserializer=req_de, response_serializer=resp_ser
         )
 
 
@@ -301,10 +344,21 @@ class RpcClient:
                 self._stubs[key] = stub
         return stub
 
+    @staticmethod
+    def _trace_metadata():
+        """Invocation metadata carrying the ambient trace id, when one is
+        active in this thread — the client half of cross-process trace
+        propagation. None (no metadata at all) otherwise."""
+        tid = trace_mod.current_trace_id()
+        return ((trace_mod.MD_KEY, tid),) if tid else None
+
     def call(self, service: str, method: str, request: Any = None, timeout: float = 30.0) -> Any:
         """Unary-unary JSON call."""
         stub = self._stub(service, method, "unary_unary", "json", "json")
-        return stub(request if request is not None else {}, timeout=timeout)
+        return stub(
+            request if request is not None else {}, timeout=timeout,
+            metadata=self._trace_metadata(),
+        )
 
     def stream(
         self, service: str, method: str, request: Any = None, timeout: float = 600.0,
@@ -312,7 +366,10 @@ class RpcClient:
     ) -> Iterator:
         """Unary-stream call; defaults to raw byte frames (bulk transfer)."""
         stub = self._stub(service, method, "unary_stream", "json", resp_format)
-        return stub(request if request is not None else {}, timeout=timeout)
+        return stub(
+            request if request is not None else {}, timeout=timeout,
+            metadata=self._trace_metadata(),
+        )
 
 
 class ClientPool:

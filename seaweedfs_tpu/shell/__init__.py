@@ -290,7 +290,14 @@ def run_command(env: CommandEnv, line: str, writer: TextIO) -> None:
     cmd = cmds.get(name)
     if cmd is None:
         raise ShellError(f"unknown command {name!r} (try `help`)")
-    cmd.do(args, env, writer)
+    # the shell is a trace ROOT: every RPC a command fans out carries
+    # this id in its metadata, so one ec.rebuild/ec.convert run can be
+    # reconstructed across every server it touched (ec.trace, glog grep)
+    from seaweedfs_tpu.obs import trace as _trace
+
+    with _trace.start("shell.command", klass="shell"):
+        _trace.annotate(command=name)
+        cmd.do(args, env, writer)
 
 
 def run_script(env: CommandEnv, script: str, writer: TextIO) -> None:

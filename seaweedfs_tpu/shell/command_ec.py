@@ -935,6 +935,198 @@ register(
 )
 
 
+# -- ec.trace ----------------------------------------------------------------
+
+
+def _fetch_json(url: str, timeout: float = 10.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def do_ec_trace(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """Pull retained weedtrace span trees from every volume server's
+    `/debug/traces` ring and render them slowest-first — the operator
+    answer to "WHY was that read slow": per-stage wall times (lookup vs
+    fetch vs hedge vs coalesce wait vs decode) for the tail requests the
+    ring always keeps. Read-only; no cluster lock."""
+    fl = parse_flags(
+        args,
+        server="",      # substring filter on the node url
+        klass="",       # healthy | ec_intact | degraded | put | ...
+        kind="",        # http.read | http.write | rpc.server | ...
+        minMs=0.0,      # only traces at least this slow
+        limit=5,        # per server
+        traceId="",     # one specific id (post-incident grep)
+    )
+    # the master's ring too (master.http roots, its rpc.server
+    # continuations) — "cluster-wide" must include every process that
+    # retains traces, not just the volume servers
+    nodes = [{"url": env.master_address}] + env.topology_nodes()
+    if fl.server:
+        nodes = [n for n in nodes if fl.server in n["url"]]
+    if not nodes:
+        raise ShellError("no matching servers")
+    from seaweedfs_tpu.obs import trace as trace_obs
+
+    shown = 0
+    for n in sorted(nodes, key=lambda n: n["url"]):
+        q = f"?limit={1000000 if fl.traceId else int(fl.limit)}"
+        if fl.klass:
+            q += f"&class={fl.klass}"
+        if fl.kind:
+            q += f"&kind={fl.kind}"
+        if fl.minMs:
+            q += f"&min_ms={fl.minMs}"
+        try:
+            payload = _fetch_json(f"http://{n['url']}/debug/traces{q}")
+        except Exception as e:  # noqa: BLE001 — a dead node has no ring
+            w.write(f"# {n['url']}: unreachable ({e})\n")
+            continue
+        traces = payload.get("traces", [])
+        if fl.traceId:
+            traces = [t for t in traces if t.get("trace_id") == fl.traceId]
+        st = payload.get("stats", {})
+        w.write(
+            f"# {n['url']}: {len(traces)} shown "
+            f"(ring kept {st.get('kept', '?')}/{st.get('offered', '?')} "
+            f"offered; tracing "
+            f"{'on' if payload.get('enabled') else 'OFF'})\n"
+        )
+        for t in traces:
+            w.write(trace_obs.render_trace(t) + "\n")
+            shown += 1
+    if not shown:
+        w.write("ec.trace: no retained traces matched\n")
+
+
+register(
+    ShellCommand(
+        "ec.trace",
+        "ec.trace [-server <url-substr>] [-klass <class>] [-kind <kind>] "
+        "[-minMs <ms>] [-limit <n>] [-traceId <id>]\n"
+        "\trender retained weedtrace span trees from the volume servers' "
+        "/debug/traces\n\trings, slowest first — per-stage wall times "
+        "(lookup/fetch/hedge/coalesce/\n\tdecode) for tail requests; "
+        "-traceId finds one specific request cluster-wide",
+        do_ec_trace,
+    )
+)
+
+
+# -- ec.status ---------------------------------------------------------------
+
+
+def _scrape_metrics(url: str, timeout: float = 5.0) -> list[tuple[str, dict, float]]:
+    """Parse one node's Prometheus /metrics text into
+    [(bare_name, labels, value)] — just enough of the exposition format
+    for the health summary (no external client on this image)."""
+    import re as _re
+    import urllib.request
+
+    out: list[tuple[str, dict, float]] = []
+    with urllib.request.urlopen(f"http://{url}/metrics", timeout=timeout) as r:
+        text = r.read().decode()
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name_part, _, value = line.rpartition(" ")
+        m = _re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?$", name_part)
+        if not m:
+            continue
+        labels = {}
+        if m.group(2):
+            for pair in _re.findall(r'(\w+)="([^"]*)"', m.group(2)):
+                labels[pair[0]] = pair[1]
+        try:
+            out.append((m.group(1), labels, float(value)))
+        except ValueError:
+            continue
+    return out
+
+
+def _metric_sum(rows, name: str, **match) -> float:
+    return sum(
+        v for n, labels, v in rows
+        if n == name and all(labels.get(k) == str(val) for k, val in match.items())
+    )
+
+
+def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
+    """One-screen cluster health summary: per-server quarantined shards
+    (with reasons, from VolumeStatus), scrub progress, rebuild/convert
+    inflight (live weedtpu_rpc_inflight gauges), and the codec backend
+    each server selected — the four surfaces that previously required
+    reading VolumeStatus, /metrics, ec.verify output, and ec.backend
+    separately. Read-only; no cluster lock."""
+    parse_flags(args)
+    nodes = env.topology_nodes()
+    if not nodes:
+        raise ShellError("no volume servers")
+    for n in sorted(nodes, key=lambda n: n["url"]):
+        url = n["url"]
+        ec_vids = sorted(
+            int(e["volume_id"]) for e in n.get("ec_shards", [])
+        )
+        quarantined: list[str] = []
+        for vid in ec_vids:
+            try:
+                st = env.vs_call(
+                    grpc_addr(n), "VolumeStatus", {"volume_id": vid}, timeout=10
+                )
+            except Exception:  # noqa: BLE001 — racing unmount: skip
+                continue
+            for s, reason in sorted((st.get("quarantined") or {}).items()):
+                quarantined.append(f"{vid}.{int(s):02d}={reason}")
+        try:
+            rows = _scrape_metrics(url)
+        except Exception as e:  # noqa: BLE001 — node HTTP down
+            w.write(f"{url}: UNREACHABLE ({e})\n")
+            continue
+        scrub_mb = _metric_sum(rows, "weedtpu_scrub_bytes_scanned_total") / 1e6
+        cycles = int(_metric_sum(rows, "weedtpu_scrub_cycles_total"))
+        found = int(_metric_sum(rows, "weedtpu_scrub_corruptions_found_total"))
+        repairs_ok = int(_metric_sum(rows, "weedtpu_scrub_repairs_total", result="ok"))
+        repairs_fail = int(
+            _metric_sum(rows, "weedtpu_scrub_repairs_total", result="failed")
+        )
+        rebuild_inflight = int(
+            _metric_sum(rows, "weedtpu_rpc_inflight", method="VolumeEcShardsRebuild")
+        )
+        convert_inflight = int(
+            _metric_sum(rows, "weedtpu_rpc_inflight", method="VolumeEcShardsConvert")
+        )
+        rebuilds_done = int(_metric_sum(rows, "weedtpu_ec_rebuild_seconds_count"))
+        converts_done = int(_metric_sum(rows, "weedtpu_ec_convert_seconds_count"))
+        backends = sorted(
+            f"{labels.get('backend')}({labels.get('source')})"
+            for name, labels, v in rows
+            if name == "weedtpu_ec_backend_selected" and v == 1.0
+        )
+        w.write(
+            f"{url}: ec_volumes={len(ec_vids)} "
+            f"quarantined=[{' '.join(quarantined) or '-'}] "
+            f"scrub={scrub_mb:.1f}MB/{cycles}cyc found={found} "
+            f"repairs={repairs_ok}ok/{repairs_fail}failed "
+            f"rebuild={rebuild_inflight}inflight/{rebuilds_done}done "
+            f"convert={convert_inflight}inflight/{converts_done}done "
+            f"backend={','.join(backends) or '?'}\n"
+        )
+
+
+register(
+    ShellCommand(
+        "ec.status",
+        "ec.status\n\tone-screen cluster health: per-server quarantined "
+        "shards (+reasons),\n\tscrub progress, live rebuild/convert "
+        "inflight, repair outcomes, and the\n\tselected codec backend — "
+        "VolumeStatus + /metrics folded into one view",
+        do_ec_status,
+    )
+)
+
+
 # -- ec.backend --------------------------------------------------------------
 
 

@@ -382,6 +382,20 @@ EcBackendSelected = REGISTRY.gauge(
     "says why: on-chip-evidence, platform, env:WEEDTPU_BACKEND, explicit)",
     ("backend", "source"),
 )
+RpcServerSeconds = REGISTRY.histogram(
+    "weedtpu_rpc_server_seconds",
+    "server-side wall time of one gRPC method execution, by method — "
+    "recorded at the generic dispatch seam, so every registered RPC is "
+    "covered without per-handler wiring",
+    ("method",),
+)
+RpcInflight = REGISTRY.gauge(
+    "weedtpu_rpc_inflight",
+    "gRPC method executions currently on a server worker thread, by "
+    "method (a saturated worker pool shows up here before it shows up "
+    "as tail latency)",
+    ("method",),
+)
 VolumeServerVolumeGauge = REGISTRY.gauge(
     "weedtpu_volume_server_volumes", "volumes hosted", ("type",)
 )

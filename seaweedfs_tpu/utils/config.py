@@ -467,6 +467,43 @@ register_env(
     "new geometry will serve). Off skips the extra read pass.",
 )
 register_env(
+    "WEEDTPU_TRACE", str, "on",
+    "weedtrace request tracing: `on` (default — designed to be safe to "
+    "leave on: allocation-light spans, no I/O, bounded ring) records "
+    "context-local span trees on every hot path, propagates trace ids "
+    "across RPC metadata / the X-Weedtpu-Trace HTTP header, and serves "
+    "them at /debug/traces + `ec.trace`; `off` collapses every trace "
+    "call site to a no-op.",
+    parse=_enum("on", "off"),
+)
+register_env(
+    "WEEDTPU_TRACE_SAMPLE", float, 1.0,
+    "Probability a completed NON-tail trace enters the sampled ring "
+    "(error traces and the N slowest per (kind, class) are always "
+    "retained regardless). Clamped to [0, 1]; lower it on very hot "
+    "fronts to bound serialization-free ring churn.",
+    parse=lambda raw: min(1.0, max(0.0, float(raw))),
+)
+register_env(
+    "WEEDTPU_TRACE_RING", int, 256,
+    "Capacity of the per-process sampled-trace FIFO (tail-retained "
+    "error/slowest traces live in their own bounded structures on top). "
+    "Clamped to >= 8.",
+    parse=_clamped_int(8),
+)
+register_env(
+    "WEEDTPU_TRACE_SLOWEST", int, 5,
+    "How many slowest traces per (kind, class) the ring always retains, "
+    "independent of sampling — the tail the p99 is about (clamped to "
+    ">= 1).",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_TRACE_SEED", int, 0,
+    "Seed for the trace-sampling RNG (deterministic retention for "
+    "tests/replays); 0 = OS entropy.",
+)
+register_env(
     "WEEDTPU_LOOKUP_RETRIES", int, 2,
     "Bounded retries (with decorrelated jitter) of the single-flight "
     "master shard-location lookup leader before it fails its waiters — "

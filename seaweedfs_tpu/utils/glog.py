@@ -1,7 +1,14 @@
 """Leveled logging — weed/glog analog [VERIFY: mount empty; SURVEY.md
 §2.1 "Logging" row]: `V(n)`-style verbosity gating on top of stdlib
 logging, so call sites read like the reference (`glog.V(3).infof(...)`).
-Verbosity comes from set_verbosity() or the WEEDTPU_V env var."""
+Verbosity comes from set_verbosity() or the WEEDTPU_V env var.
+
+Every emitted line carries structured key=value context: the ambient
+weedtrace id is appended automatically (` trace=<id>`) whenever a trace
+is active in the calling thread, so `grep trace=<id>` over the
+cluster's stderr reconstructs one request's cross-process log lines —
+the glog half of end-to-end tracing. `kv(...)` formats extra context
+pairs in the same grep-stable shape."""
 
 from __future__ import annotations
 
@@ -28,13 +35,42 @@ def set_verbosity(v: int) -> None:
     _verbosity = v
 
 
+def kv(**pairs) -> str:
+    """key=value context in the grep-stable shape log lines use —
+    append to a message: glog.info("repair done %s", glog.kv(vid=3))."""
+    return " ".join(f"{k}={v}" for k, v in pairs.items())
+
+
+def _ctx_suffix() -> str:
+    """` trace=<id>` when a weedtrace is active in this thread. Lazy
+    import: glog is a leaf module and obs.trace must stay importable
+    from anywhere without cycles."""
+    try:
+        from seaweedfs_tpu.obs import trace as _trace
+
+        tid = _trace.current_trace_id()
+    except Exception:  # noqa: BLE001 — logging must never raise
+        return ""
+    return f" trace={tid}" if tid else ""
+
+
+def _with_ctx(msg):
+    """Suffix the trace context onto string messages (non-str messages —
+    exceptions handed straight to the logger — pass through untouched
+    so their %-free formatting stays valid)."""
+    if not isinstance(msg, str):
+        return msg
+    suffix = _ctx_suffix()
+    return msg + suffix if suffix else msg
+
+
 class _Verbose:
     def __init__(self, enabled: bool):
         self.enabled = enabled
 
     def info(self, msg: str, *args) -> None:
         if self.enabled:
-            _logger.info(msg, *args)
+            _logger.info(_with_ctx(msg), *args)
 
     infof = info
 
@@ -44,15 +80,15 @@ def V(level: int) -> _Verbose:  # noqa: N802 — glog's exact API shape
 
 
 def info(msg: str, *args) -> None:
-    _logger.info(msg, *args)
+    _logger.info(_with_ctx(msg), *args)
 
 
 def warning(msg: str, *args) -> None:
-    _logger.warning(msg, *args)
+    _logger.warning(_with_ctx(msg), *args)
 
 
 def error(msg: str, *args) -> None:
-    _logger.error(msg, *args)
+    _logger.error(_with_ctx(msg), *args)
 
 
 infof = info

@@ -1,0 +1,627 @@
+"""weedtrace tests: the context-local span recorder and tail-biased
+trace ring (seaweedfs_tpu/obs/trace.py), per-stage attribution math,
+the /debug/traces surface, the `ec.trace`/`ec.status` shell commands —
+and the acceptance e2e: one trace id round-tripping a full distributed
+degraded read (client -> master -> volume server -> remote holders and
+back) including the hedge, coalesce, and rebuild slab/trace branches."""
+
+import io
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cluster.client import MasterClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.obs import trace
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.utils import glog
+
+LARGE, SMALL = 4096, 512
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _mk(dur, kind="http.read", klass="healthy", error=None, tid=None):
+    """A completed trace with a pinned duration (the ring orders and
+    evicts on `dur`, never on wall time — so tests can fabricate it)."""
+    st = trace._TraceState(tid or trace.new_trace_id(), kind, klass)
+    root = trace.Span(kind, None, st)
+    root.dur = dur
+    return trace._Completed(root, st, error)
+
+
+@pytest.fixture
+def on(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_TRACE", "on")
+    trace.RING.clear()
+    yield
+    trace.RING.clear()
+
+
+# -- recording primitives -----------------------------------------------------
+
+
+def test_disabled_tracing_is_total_noop(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_TRACE", "off")
+    assert not trace.enabled()
+    ctx = trace.start("http.read")
+    assert ctx is trace._NULL  # shared singleton, no per-call allocation
+    with ctx as root:
+        assert root is None
+        with trace.span("ec.recover", shard=1) as sp:
+            assert sp is None  # no ambient trace -> span is a no-op
+        assert trace.current_trace_id() is None
+        trace.annotate(x=1)  # must not raise outside a trace
+        trace.set_class("degraded")
+
+
+def test_span_tree_records_nesting_attrs_and_errors(on):
+    ring = trace.TraceRing(capacity=8, slowest_n=1, sample=1.0, seed=1)
+    with trace.start("http.read", klass="degraded", ring=ring) as root:
+        tid = root.trace.trace_id
+        with trace.span("ec.recover", shard=3):
+            with trace.span("ec.gather", shard=3) as g:
+                g.annotate(have=9)
+            with pytest.raises(ValueError):
+                with trace.span("ec.decode", backend="numpy"):
+                    raise ValueError("boom")
+    [t] = ring.snapshot()
+    assert t["trace_id"] == tid and t["class"] == "degraded"
+    assert t["error"] is None  # the root exited clean: only the SPAN errored
+    (recover,) = t["root"]["spans"]
+    assert recover["name"] == "ec.recover" and recover["attrs"] == {"shard": 3}
+    gather, decode = recover["spans"]
+    assert gather["attrs"] == {"shard": 3, "have": 9}
+    assert decode["error"] == "ValueError"
+    assert t["duration_s"] >= recover["dur_ms"] / 1e3 >= 0
+
+
+def test_root_error_always_retained(on):
+    ring = trace.TraceRing(capacity=8, slowest_n=1, sample=0.0, seed=1)
+    with pytest.raises(IOError):
+        with trace.start("http.read", ring=ring):
+            raise IOError("disk gone")
+    snap = ring.snapshot()
+    errs = [t for t in snap if t["error"]]
+    assert len(errs) == 1 and "disk gone" in errs[0]["error"]
+
+
+def test_continue_trace_only_roots_with_propagated_id(on):
+    ring = trace.TraceRing(capacity=8, slowest_n=1, sample=1.0, seed=1)
+    assert trace.continue_trace("rpc.server", None, ring=ring) is trace._NULL
+    assert trace.continue_trace("rpc.server", "<script>", ring=ring) is trace._NULL
+    with trace.continue_trace("rpc.server", "AbC123", ring=ring) as root:
+        assert root.trace.trace_id == "abc123"  # sanitized lowercase
+    assert ring.snapshot()[0]["trace_id"] == "abc123"
+
+
+def test_valid_id_rejects_wire_junk():
+    assert trace.valid_id("deadbeef01") == "deadbeef01"
+    assert trace.valid_id("DEAD-BEEF") == "dead-beef"
+    for bad in (None, 7, "", "-leading", "zz not hex start" * 8, "x" * 80,
+                "inj\nected", "a b"):
+        assert trace.valid_id(bad) is None, bad
+
+
+def test_ensure_nests_under_ambient_else_roots(on):
+    ring = trace.TraceRing(capacity=8, slowest_n=1, sample=1.0, seed=1)
+    # no ambient trace: ensure() roots a fresh maintenance trace
+    with trace.start("rebuild.run", klass="maint", ring=ring):
+        pass
+    assert ring.snapshot()[0]["kind"] == "rebuild.run"
+    ring.clear()
+    # ambient trace active: ensure() nests a span, no second root
+    with trace.start("shell.command", klass="shell", ring=ring) as root:
+        tid = root.trace.trace_id
+        with trace.ensure("rebuild.run"):
+            pass
+    [t] = ring.snapshot()
+    assert t["trace_id"] == tid
+    assert [s["name"] for s in t["root"]["spans"]] == ["rebuild.run"]
+
+
+def test_attach_bridges_worker_threads(on):
+    ring = trace.TraceRing(capacity=8, slowest_n=1, sample=1.0, seed=1)
+    with trace.start("http.read", ring=ring) as root:
+        parent = trace.current()
+
+        def worker():
+            # a bare thread has no ambient span; attach adopts the parent
+            assert trace.current() is None
+            with trace.attach(parent), trace.span("ec.fetch", shard=2):
+                assert trace.current_trace_id() == root.trace.trace_id
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(10)
+    [tr] = ring.snapshot()
+    assert [s["name"] for s in tr["root"]["spans"]] == ["ec.fetch"]
+
+
+# -- the ring: tail-biased retention ------------------------------------------
+
+
+def test_ring_keeps_errors_and_slowest_drops_the_rest_at_sample_zero():
+    ring = trace.TraceRing(capacity=16, slowest_n=2, sample=0.0, seed=7)
+    # descending durations: the first two fill the slowest row, every
+    # later (faster) trace must be dropped outright at sample=0
+    for i in range(50):
+        ring.offer(_mk(dur=0.001 * (50 - i), tid=f"aa{i:04x}"))
+    ring.offer(_mk(dur=0.0005, error="IOError: x", tid="ee01"))
+    snap = ring.snapshot()
+    # 2 slowest + 1 error survived; the 48 fast healthy traces did not
+    assert len(snap) == 3
+    assert snap[0]["duration_s"] >= snap[1]["duration_s"]
+    assert {t["trace_id"] for t in snap} == {"aa0000", "aa0001", "ee01"}
+    st = ring.stats()
+    assert st["offered"] == 51 and st["kept"] == 3
+    assert st["sampled"] == 0 and st["errors"] == 1
+
+
+def test_ring_slowest_is_per_kind_class_key():
+    ring = trace.TraceRing(capacity=4, slowest_n=1, sample=0.0, seed=7)
+    ring.offer(_mk(0.9, klass="healthy", tid="aa01"))
+    ring.offer(_mk(0.1, klass="degraded", tid="aa02"))
+    ring.offer(_mk(0.2, kind="http.write", klass="put", tid="aa03"))
+    # each (kind, class) keeps its own slowest: the 0.1s degraded trace
+    # survives even though a 0.9s healthy one exists
+    assert {t["trace_id"] for t in ring.snapshot()} == {"aa01", "aa02", "aa03"}
+
+
+def test_ring_sampled_fifo_is_bounded():
+    ring = trace.TraceRing(capacity=10, slowest_n=1, sample=1.0, seed=7)
+    for i in range(200):
+        ring.offer(_mk(dur=0.001, tid=f"bb{i:04x}"))
+    st = ring.stats()
+    assert st["sampled"] == 10  # FIFO capped
+    snap = ring.snapshot(limit=1000)
+    assert len(snap) <= 10 + 1  # FIFO + at most one distinct slowest
+
+
+def test_sampling_is_deterministic_under_seed():
+    def kept_ids(seed):
+        ring = trace.TraceRing(capacity=64, slowest_n=1, sample=0.5, seed=seed)
+        for i in range(64):
+            ring.offer(_mk(dur=0.001, tid=f"cc{i:04x}"))
+        return [t["trace_id"] for t in ring.snapshot(limit=100)]
+
+    assert kept_ids(42) == kept_ids(42)
+    assert kept_ids(42) != kept_ids(43)  # 2^-64 flake odds, effectively zero
+
+
+def test_snapshot_filters_and_debug_payload(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_TRACE", "on")
+    ring = trace.TraceRing(capacity=32, slowest_n=1, sample=1.0, seed=1)
+    ring.offer(_mk(0.500, klass="degraded", tid="dd01"))
+    ring.offer(_mk(0.010, klass="healthy", tid="dd02"))
+    ring.offer(_mk(0.020, kind="http.write", klass="put", tid="dd03"))
+    assert {t["trace_id"] for t in ring.snapshot(klass="degraded")} == {"dd01"}
+    assert {t["trace_id"] for t in ring.snapshot(kind="http.write")} == {"dd03"}
+    assert {t["trace_id"] for t in ring.snapshot(min_duration=0.1)} == {"dd01"}
+    assert len(ring.snapshot(limit=2)) == 2
+    # slowest-first ordering
+    assert [t["trace_id"] for t in ring.snapshot()][0] == "dd01"
+    payload = trace.debug_payload(
+        "/debug/traces?class=degraded&min_ms=100&limit=5", ring=ring
+    )
+    assert payload["enabled"] is True
+    assert [t["trace_id"] for t in payload["traces"]] == ["dd01"]
+    # junk query values fall back to defaults instead of raising
+    junk = trace.debug_payload("/debug/traces?min_ms=zap&limit=zap", ring=ring)
+    assert len(junk["traces"]) == 3
+
+
+# -- render + attribution -----------------------------------------------------
+
+
+def _fake_trace():
+    return {
+        "trace_id": "4f1d0000", "kind": "http.read", "class": "degraded",
+        "start": 0.0, "duration_s": 1.0, "error": None,
+        "root": {
+            "name": "http.read", "t_ms": 0.0, "dur_ms": 1000.0,
+            "spans": [
+                {
+                    "name": "ec.recover", "t_ms": 50.0, "dur_ms": 900.0,
+                    "attrs": {"shard": 3},
+                    "spans": [
+                        # parallel fan-out: child durations sum to 1.2s
+                        # inside a 0.9s parent -> must be scaled, never
+                        # attributed more wall time than passed
+                        {"name": "ec.fetch", "t_ms": 51.0, "dur_ms": 600.0},
+                        {"name": "ec.fetch", "t_ms": 51.0, "dur_ms": 600.0},
+                    ],
+                },
+            ],
+        },
+    }
+
+
+def test_render_trace_shows_tree_attrs_and_times():
+    out = trace.render_trace(_fake_trace())
+    lines = out.splitlines()
+    assert lines[0] == "trace=4f1d0000 http.read class=degraded 1000.0ms"
+    assert "ec.recover" in lines[1] and "shard=3" in lines[1]
+    assert lines[2].startswith("|  +-") and "ec.fetch" in lines[2]
+    err = dict(_fake_trace(), error="IOError: x")
+    assert "ERROR=IOError: x" in trace.render_trace(err).splitlines()[0]
+
+
+def test_attribute_stages_sums_exactly_to_e2e():
+    stages = trace.attribute_stages(_fake_trace())
+    assert abs(sum(stages.values()) - 1.0) < 1e-9
+    # parallel fetches scaled to the recover span's 0.9s wall budget
+    assert abs(stages["ec.fetch"] - 0.9) < 1e-9
+    assert abs(stages["ec.recover"] - 0.0) < 1e-9  # no self-time left
+    assert abs(stages["other"] - 0.1) < 1e-9  # root self-time
+    # a trivial single-span trace: all self-time on the stage
+    t = {
+        "duration_s": 0.5,
+        "root": {"name": "r", "dur_ms": 500.0, "spans": [
+            {"name": "ec.decode", "t_ms": 0.0, "dur_ms": 200.0},
+        ]},
+    }
+    s = trace.attribute_stages(dict(_fake_trace(), **t))
+    assert abs(s["ec.decode"] - 0.2) < 1e-9 and abs(s["other"] - 0.3) < 1e-9
+
+
+def test_attribution_aggregation_consistency(on):
+    """assemble_trace_attribution: per-class stage totals must equal the
+    summed end-to-end latencies (stage_coverage == 1.0) — the artifact's
+    committed consistency gate."""
+    from seaweedfs_tpu.ec import slo
+
+    traces = [_fake_trace() for _ in range(10)]
+    for i, t in enumerate(traces):
+        t["trace_id"] = f"ab{i:02x}"
+        t["duration_s"] = 0.1 * (i + 1)
+    attrib = slo.assemble_trace_attribution(traces)
+    cls = attrib["classes"]["degraded"]
+    assert cls["count"] == 10
+    assert abs(cls["stage_coverage"] - 1.0) < 1e-6
+    assert abs(cls["e2e_total_s"] - sum(0.1 * (i + 1) for i in range(10))) < 1e-6
+    assert len(attrib["slowest"]) == 5
+    assert attrib["slowest"][0]["duration_s"] == pytest.approx(1.0)
+    shares = sum(s["share"] for s in cls["stages"].values())
+    assert abs(shares - 1.0) < 1e-3
+
+
+# -- glog context -------------------------------------------------------------
+
+
+def test_glog_lines_carry_the_active_trace_id(on):
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("seaweedfs_tpu")
+    h = _Capture(level=logging.INFO)
+    logger.addHandler(h)
+    try:
+        ring = trace.TraceRing(capacity=8, slowest_n=1, sample=1.0, seed=1)
+        glog.info("outside any trace")
+        with trace.start("http.read", trace_id="feed0001", ring=ring):
+            glog.info("inside span %s", glog.kv(vid=7))
+    finally:
+        logger.removeHandler(h)
+    assert records[-2] == "outside any trace"
+    assert records[-1] == "inside span vid=7 trace=feed0001"
+
+
+def test_disabled_span_path_is_cheap(monkeypatch):
+    """Overhead microbench (loose): with tracing off, 50k span call
+    sites must cost well under a second total — the 'safe to leave the
+    call sites in every hot loop' floor. The real 5% e2e gate lives in
+    the weedload smoke (test_slo_harness)."""
+    monkeypatch.setenv("WEEDTPU_TRACE", "off")
+    t0 = time.monotonic()
+    for _ in range(50_000):
+        with trace.span("ec.decode"):
+            pass
+    assert time.monotonic() - t0 < 1.0
+
+
+# -- live cluster e2e ---------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("WEEDTPU_TRACE", "on")
+    monkeypatch.setenv("WEEDTPU_TRACE_SAMPLE", "1.0")
+    # deterministic hedging: the bench RPC delay makes every remote
+    # shard fetch run ~20 ms (a modeled RTT), and a 5 ms hedge delay
+    # guarantees the backup launches while the primary is still pending
+    # wherever a second holder exists
+    monkeypatch.setenv("WEEDTPU_BENCH_RPC_DELAY_MS", "20")
+    monkeypatch.setenv("WEEDTPU_HEDGE_DELAY_MS", "5")
+    trace.RING.clear()
+    master = MasterServer(port=0, reap_interval=3600)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"srv{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, heartbeat_interval=0.3)
+        vs.start()
+        servers.append(vs)
+    client = MasterClient(master.address)
+    env = CommandEnv(master.address)
+    yield master, servers, client, env
+    env.close()
+    client.close()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+    trace.RING.clear()
+
+
+def _shell(env, line):
+    out = io.StringIO()
+    run_command(env, line, out)
+    return out.getvalue()
+
+
+def _ec_spread_volume(client, env, n=16, size=3000):
+    """Upload n blobs, EC-encode their volume spread across the cluster
+    (the shell path operators use), return (vid, [(fid, payload)])."""
+    fids = []
+    for _ in range(n):
+        import os as _os
+
+        payload = _os.urandom(size)
+        r = client.submit(payload)
+        fids.append((r.fid, payload))
+    vid = int(fids[0][0].split(",", 1)[0])
+    _shell(env, "lock")
+    _shell(
+        env,
+        f"ec.encode -volumeId {vid} -largeBlockSize {LARGE} "
+        f"-smallBlockSize {SMALL}",
+    )
+    return vid, fids
+
+
+def _holders_of(env, vid):
+    """{shard_id: [node dict]} from the live topology."""
+    out = {}
+    for n in env.topology_nodes():
+        for e in n.get("ec_shards", []):
+            if int(e["volume_id"]) != vid:
+                continue
+            from seaweedfs_tpu.ec.shard_bits import ShardBits
+
+            for s in ShardBits(e["shard_bits"]).shard_ids():
+                out.setdefault(s, []).append(n)
+    return out
+
+
+def _grpc_of(node, servers):
+    return next(s for s in servers if s.url == node["url"]).grpc_address
+
+
+def _traced_get(url, fid, payload):
+    tid = trace.new_trace_id()
+    req = urllib.request.Request(
+        f"http://{url}/{fid}", headers={trace.HTTP_HEADER: tid}
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = r.read()
+        echo = r.headers.get(trace.HTTP_HEADER)
+    assert body == payload, f"bytes differ for {fid}"
+    assert echo == tid, "traced reply must echo the request's trace id"
+    return tid
+
+
+def test_trace_id_round_trips_distributed_degraded_read(cluster):
+    """The acceptance e2e: ids minted at the client survive the full
+    degraded read — serving VS (http.read root), its master lookup
+    (rpc.server LookupEcVolume), remote holder fetches (rpc.server
+    VolumeEcShardRead), the hedge branch, and the coalesce branch — and
+    come back on the HTTP reply. In-process servers share one trace
+    ring, so cross-process assertions reduce to: every leg's root landed
+    in the ring under the SAME propagated id."""
+    master, servers, client, env = cluster
+    vid, fids = _ec_spread_volume(client, env)
+    holders = _holders_of(env, vid)
+
+    # drop two data shards cluster-wide -> needles there reconstruct
+    lost = [2, 3]
+    for s in lost:
+        for node in holders[s]:
+            env.vs_call(
+                _grpc_of(node, servers), "VolumeEcShardsDelete",
+                {"volume_id": vid, "shard_ids": [s]},
+            )
+    # give one surviving shard a SECOND holder so hedges have an
+    # alternate to race (shell ec.encode places each shard once). The
+    # duplicated shard must be REMOTE to the serving front, or its
+    # fan-out never fetches it at all
+    import shutil
+
+    from seaweedfs_tpu.ec import stripe as stripe_mod
+
+    front = servers[0]
+    donor_shard, donor = next(
+        (s, holders[s][0]) for s in sorted(holders)
+        if s not in lost and holders[s][0]["url"] != front.url
+    )
+    donor = next(s for s in servers if s.url == donor["url"])
+    recip = next(s for s in servers if s.url not in (front.url, donor.url))
+    src = stripe_mod.shard_file_name(donor._base_path_for(vid), donor_shard)
+    dst_base = recip._base_path_for(vid)
+    shutil.copy(src, stripe_mod.shard_file_name(dst_base, donor_shard))
+    for ext in (".ecx", ".eci"):
+        shutil.copy(donor._base_path_for(vid) + ext, dst_base + ext)
+    env.vs_call(recip.grpc_address, "VolumeEcShardsMount", {"volume_id": vid})
+
+    # read everything through one serving VS with a fresh id per request
+    tid_of = {fid: _traced_get(front.url, fid, payload) for fid, payload in fids}
+    ids = set(tid_of.values())
+
+    snap = trace.RING.snapshot(limit=100000)
+    degraded = [
+        t for t in snap
+        if t["kind"] == "http.read" and t["class"] == "degraded"
+        and t["trace_id"] in ids
+    ]
+    assert degraded, "no degraded read landed in the ring"
+    names = {s["name"] for t in degraded for s in trace.iter_spans(t)}
+    assert {"ec.recover", "ec.gather", "ec.fetch", "ec.decode"} <= names, names
+
+    # the remote-holder leg: VolumeEcShardRead rpc.server roots under the
+    # same ids the client minted
+    fetch_legs = [
+        t for t in snap
+        if t["kind"] == "rpc.server" and t["trace_id"] in ids
+        and t["root"].get("attrs", {}).get("method") == "VolumeEcShardRead"
+    ]
+    assert fetch_legs, "remote shard fetches did not continue the trace id"
+
+    # the master leg: the serving VS's shard-location lookup carried the
+    # id of whichever traced read was first to need it
+    master_legs = [
+        t for t in snap
+        if t["kind"] == "rpc.server" and t["trace_id"] in ids
+        and t["root"].get("attrs", {}).get("method") == "LookupEcVolume"
+    ]
+    assert master_legs, "master lookup did not continue the trace id"
+
+    # the fids whose first read reconstructed (their id landed in the
+    # ring classed degraded) — the needles the branch probes re-read
+    degraded_ids = {t["trace_id"] for t in degraded}
+    d_fids = [
+        (fid, p) for fid, p in fids if tid_of[fid] in degraded_ids
+    ]
+    assert d_fids, "no fid classified degraded"
+
+    # hedge branch: reads of a degraded needle re-issued until a backup
+    # fetch span shows up under one of our ids (delay pinned to 1 ms, a
+    # second holder exists -> fires almost every fan-out)
+    hedge_seen = any("ec.hedge" in {s["name"] for s in trace.iter_spans(t)}
+                     for t in degraded)
+    tries = 0
+    while not hedge_seen and tries < 40:
+        tries += 1
+        fid, p = d_fids[tries % len(d_fids)]
+        tid = _traced_get(front.url, fid, p)
+        for t in trace.RING.snapshot(limit=100000):
+            if t["trace_id"] == tid and any(
+                s["name"] == "ec.hedge" for s in trace.iter_spans(t)
+            ):
+                hedge_seen = True
+                break
+    assert hedge_seen, "hedge branch never recorded under a propagated id"
+
+    # coalesce branch: concurrent readers of ONE degraded needle, each
+    # with its own id — waiters must record ec.coalesce.wait under THEIR
+    # id (ids never bleed across coalesced requests)
+    deg_fid, deg_payload = d_fids[0]
+    coalesce_tid = None
+    for _ in range(10):
+        tids, threads = [], []
+
+        def rd():
+            tids.append(_traced_get(front.url, deg_fid, deg_payload))
+
+        for _ in range(12):
+            threads.append(threading.Thread(target=rd))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for tr in trace.RING.snapshot(limit=100000):
+            if tr["trace_id"] in tids and any(
+                s["name"] == "ec.coalesce.wait"
+                for s in trace.iter_spans(tr)
+            ):
+                coalesce_tid = tr["trace_id"]
+                break
+        if coalesce_tid:
+            break
+    assert coalesce_tid, "coalesce waiter never recorded under its own id"
+
+    # -- the /debug/traces surface, live --------------------------------------
+    def dbg(query):
+        with urllib.request.urlopen(
+            f"http://{front.url}/debug/traces?{query}", timeout=10
+        ) as r:
+            return json.loads(r.read().decode())
+
+    p = dbg("class=degraded&limit=3")
+    assert p["enabled"] and len(p["traces"]) <= 3
+    assert all(t["class"] == "degraded" for t in p["traces"])
+    durs = [t["duration_s"] for t in p["traces"]]
+    assert durs == sorted(durs, reverse=True), "slowest-first ordering"
+    assert dbg("min_ms=10000000")["traces"] == []
+    assert {t["kind"] for t in dbg("kind=rpc.server&limit=5")["traces"]} <= {
+        "rpc.server"
+    }
+
+    # -- operator surfaces: ec.trace + ec.status ------------------------------
+    out = _shell(env, "ec.trace -klass degraded -limit 2")
+    assert "trace=" in out and "ec.recover" in out
+    one = _shell(env, f"ec.trace -traceId {coalesce_tid}")
+    assert f"trace={coalesce_tid}" in one
+    status = _shell(env, "ec.status")
+    for n in env.topology_nodes():
+        assert n["url"] in status
+    assert "ec_volumes=" in status and "scrub=" in status
+    assert "backend=" in status and "rebuild=" in status
+
+
+def test_trace_id_round_trips_shell_rebuild_trace_and_slab(cluster):
+    """The rebuild branches: `ec.rebuild -remote` under the shell's
+    trace root must land the rebuild RPC (and the rebuild.run pipeline
+    under it) in the ring with the SHELL's id — in projection (trace)
+    mode AND forced-slab mode."""
+    master, servers, client, env = cluster
+    vid, fids = _ec_spread_volume(client, env)
+    holders = _holders_of(env, vid)
+
+    for mode, lost_shard in (("on", 12), ("off", 13)):
+        for node in holders[lost_shard]:
+            env.vs_call(
+                _grpc_of(node, servers), "VolumeEcShardsDelete",
+                {"volume_id": vid, "shard_ids": [lost_shard]},
+            )
+        trace.RING.clear()
+        out = _shell(env, f"ec.rebuild -remote -trace {mode}")
+        assert "rebuilt" in out
+        snap = trace.RING.snapshot(limit=100000)
+        shells = [
+            t for t in snap
+            if t["kind"] == "shell.command"
+            and t["root"].get("attrs", {}).get("command") == "ec.rebuild"
+        ]
+        assert len(shells) == 1, "shell must root exactly one trace"
+        tid = shells[0]["trace_id"]
+        legs = [
+            t for t in snap
+            if t["kind"] == "rpc.server" and t["trace_id"] == tid
+            and t["root"].get("attrs", {}).get("method")
+            == "VolumeEcShardsRebuild"
+        ]
+        assert legs, f"-trace {mode}: rebuild RPC did not continue the id"
+        names = {s["name"] for t in legs for s in trace.iter_spans(t)}
+        assert "rebuild.run" in names, (mode, names)
+        assert "rebuild.drain" in names, (mode, names)
+        # holder-side slab/projection streams continued the same id too
+        holder_methods = {
+            t["root"].get("attrs", {}).get("method")
+            for t in snap
+            if t["kind"] == "rpc.server" and t["trace_id"] == tid
+        }
+        assert holder_methods & {
+            "VolumeEcShardSlabRead", "VolumeEcShardSlabProject"
+        }, holder_methods
+
+    for fid, payload in fids:
+        assert client.read(fid) == payload

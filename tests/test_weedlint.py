@@ -163,6 +163,52 @@ def test_parse_proto_oneof_fields_belong_to_message():
     }
 
 
+def test_obs_drift_fixture_fires():
+    pkg = fixture("obsdrift_pkg")
+    findings = run(
+        paths=[os.path.join(pkg, "cluster", "server.py")], root=pkg
+    )
+    got = {(f.rule, os.path.basename(f.path), f.line) for f in findings}
+    # planted: a metric-suffixed literal the fixture registry never
+    # declared, and a span call site missing from the catalog
+    assert ("obs-metric-undeclared", "server.py", 9) in got, findings
+    assert ("obs-span-undeclared", "server.py", 18) in got, findings
+    # planted: dead telemetry + a stale catalog entry, reported AT their
+    # declaration sites in the registry/catalog files
+    assert any(
+        f.rule == "obs-metric-unused" and "weedtpu_orphan_total" in f.message
+        and f.path.endswith(os.path.join("stats", "__init__.py"))
+        for f in findings
+    ), findings
+    assert any(
+        f.rule == "obs-span-unused" and "stale.span" in f.message
+        for f in findings
+    ), findings
+    # the clean usages stay clean: the declared metric scraped by string
+    # (line 8), the binding-name histogram use, the registered span, and
+    # the suffix-less native symbol name (line 10, NOT a metric)
+    msgs = " | ".join(f.message for f in findings)
+    assert "weedtpu_good_total" not in msgs
+    assert "weedtpu_bound_seconds" not in msgs
+    assert "good.span" not in msgs
+    assert "weedtpu_gf_native_symbol" not in msgs
+    obs_rules = {f.rule for f in findings if f.rule.startswith("obs-")}
+    assert obs_rules == {
+        "obs-metric-undeclared", "obs-metric-unused",
+        "obs-span-undeclared", "obs-span-unused",
+    }
+
+
+def test_obs_drift_real_tree_is_clean():
+    """The real package's metric + span catalogs are drift-free — the
+    same assertion CI makes, scoped to the obs-drift family."""
+    findings = run()
+    assert not [f for f in findings if f.rule.startswith("obs-")], [
+        (f.path, f.line, f.message)
+        for f in findings if f.rule.startswith("obs-")
+    ]
+
+
 # -- suppression semantics ----------------------------------------------------
 
 

@@ -1,0 +1,19 @@
+"""obs-drift fixture call sites: line numbers are asserted by
+tests/test_weedlint.py — keep the planted violations where they are."""
+
+from obsdrift_pkg import stats
+from obsdrift_pkg.obs import trace as trace_mod
+
+SCRAPED = (
+    "weedtpu_good_total",        # declared: clean usage
+    "weedtpu_missing_total",     # planted: obs-metric-undeclared (line 9)
+    "weedtpu_gf_native_symbol",  # no metric suffix: NOT a metric, ignored
+)
+
+
+def serve():
+    stats.BoundHistogram  # binding-name usage of weedtpu_bound_seconds
+    with trace_mod.span("good.span", shard=1):
+        pass
+    with trace_mod.span("bad.span"):  # planted: obs-span-undeclared (line 18)
+        pass

@@ -1,0 +1,11 @@
+"""obs-drift fixture span catalog: one used entry, one stale entry
+(planted obs-span-unused)."""
+
+SPAN_NAMES = {
+    "good.span": "recorded by the fixture server",
+    "stale.span": "registered but never recorded — planted violation",
+}
+
+
+def span(name, **attrs):  # the real contextmanager shape, body irrelevant
+    return None

@@ -1,0 +1,24 @@
+"""obs-drift fixture registry: one metric used by string, one used by
+binding, one declared-and-never-touched (planted obs-metric-unused)."""
+
+
+class _R:
+    def counter(self, name, help_="", labels=()):
+        return object()
+
+    def gauge(self, name, help_="", labels=()):
+        return object()
+
+    def histogram(self, name, help_="", labels=()):
+        return object()
+
+
+REGISTRY = _R()
+
+GoodCounter = REGISTRY.counter("weedtpu_good_total", "used via its string name")
+BoundHistogram = REGISTRY.histogram(
+    "weedtpu_bound_seconds", "used via its binding name"
+)
+OrphanCounter = REGISTRY.counter(
+    "weedtpu_orphan_total", "declared but never referenced — planted violation"
+)
