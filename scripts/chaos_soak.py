@@ -61,13 +61,21 @@ while the kill loop keeps running; the run FAILS unless every injection
 ends healed AND every byte still reads back exactly (a corrupt byte
 served to a client shows up as BYTES DIFFER = lost).
 
+`--rack` runs the FLEET-REPAIR acceptance scenario instead of the kill
+loop (see run_rack_mode): 7 rack-labeled servers, four domain-spread EC
+volumes, open-loop read traffic, SIGKILL one node and then an entire
+two-node rack, with the master's WEEDTPU_REPAIR scheduler required to
+repair 2-missing stripes strictly before 1-missing ones, converge back
+to full coverage, and leave zero failure-domain violations.
+
 Usage:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency] \
-          [--inline] [--corrupt] [--convert]
+          [--inline] [--corrupt] [--convert] [--rack]
 Writes artifacts/SOAK_r09.json (SOAK_r10.json with --corrupt,
-SOAK_r11.json with --convert) and exits nonzero on any lost byte,
-unhealed injection, or incomplete conversion.
+SOAK_r11.json with --convert, SOAK_r12.json with --rack) and exits
+nonzero on any lost byte, unhealed injection, incomplete conversion, or
+a fleet-repair gate failure (ordering / coverage / placement audit).
 """
 
 from __future__ import annotations
@@ -145,10 +153,11 @@ def _free_port() -> int:
 
 
 class Node:
-    def __init__(self, i: int, dirpath: str, master: str):
+    def __init__(self, i: int, dirpath: str, master: str, rack: str = ""):
         self.i = i
         self.dir = dirpath
         self.master = master
+        self.rack = rack
         self.http = _free_port()
         self.grpc = _free_port()
         self.proc: subprocess.Popen | None = None
@@ -165,7 +174,8 @@ class Node:
                 sys.executable, "-m", "seaweedfs_tpu", "volume",
                 "-port", str(self.http), "-grpcPort", str(self.grpc),
                 "-dir", self.dir, "-mserver", self.master, "-max", "30",
-            ],
+            ]
+            + (["-rack", self.rack] if self.rack else []),
             cwd=os.path.dirname(ART),
             env=env,
             stdout=self.log,
@@ -200,10 +210,366 @@ class Node:
         return self.proc is not None and self.proc.poll() is None
 
 
+def run_rack_mode(seconds: int) -> int:
+    """`--rack`: survive a node, then a rack — the fleet-repair
+    acceptance scenario. Topology: 7 volume servers in 6 racks (rack rk0
+    holds TWO nodes, rk1..rk5 one each). Four EC volumes are spread with
+    the failure-domain discipline, shaped so rack rk0 holds ONE shard of
+    the A-type volumes and TWO shards of the B-type volumes. Under
+    continuous open-loop read traffic:
+
+      phase 1 (a node):  SIGKILL the rk5 node — A volumes go 2-missing,
+                         B volumes 1-missing; the master scheduler must
+                         dispatch every 2-missing repair before any
+                         1-missing one, batch them to one target, and
+                         converge the registry back to full coverage.
+      phase 2 (a rack):  SIGKILL BOTH rk0 nodes back to back — now the
+                         B volumes are 2-missing and the A volumes
+                         1-missing (the mirror image), same ordering
+                         gate, same convergence gate.
+
+    The run FAILS on any lost byte, any out-of-order dispatch, residual
+    placement violations after healing, or incomplete coverage. Writes
+    artifacts/SOAK_r12.json."""
+    # scheduler + detection tuning must land BEFORE the master/server
+    # processes exist (Node.start copies os.environ; the in-process
+    # master reads the registry at construction)
+    os.environ.setdefault("WEEDTPU_REPAIR", "on")
+    os.environ.setdefault("WEEDTPU_REPAIR_MAX_INFLIGHT", "1")
+    os.environ.setdefault("WEEDTPU_REPAIR_SETTLE_S", "6.0")
+    os.environ.setdefault("WEEDTPU_REPAIR_SCAN_S", "1.0")
+    os.environ.setdefault("WEEDTPU_REPAIR_DEAD_S", "8.0")
+    os.environ.setdefault("WEEDTPU_REPAIR_REPORT_FAILURES", "2")
+
+    from seaweedfs_tpu.cluster import topology as topo_mod
+    from seaweedfs_tpu.cluster.client import MasterClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu import rpc as _rpc
+    from seaweedfs_tpu.ec import placement, slo
+    from seaweedfs_tpu.pb import VOLUME_SERVICE
+
+    # the killed rack's holders are parity-only, so no read ever touches
+    # them post-kill and the peer-report fast path stays quiet — death
+    # detection in this harness rides the reaper, tightened to soak scale
+    topo_mod.DEAD_NODE_SECONDS = 20
+
+    rng = random.Random(12)
+    racks = ["rk0", "rk0", "rk1", "rk2", "rk3", "rk4", "rk5"]
+    report: dict = {
+        "when": time.strftime("%FT%TZ", time.gmtime()),
+        "mode": "rack",
+        "seconds": seconds,
+        "racks": {f"n{i}": r for i, r in enumerate(racks)},
+        "kills": 0,
+        "writes": 0,
+        "write_failures": 0,
+        "reads": 0,
+        "read_failures_transient": 0,
+        "lost": [],
+    }
+    lat_rec = slo.LatencyRecorder()
+    with tempfile.TemporaryDirectory() as td:
+        master = MasterServer(port=0, reap_interval=3.0)
+        master.start()
+        nodes: list[Node] = []
+        for i, rack in enumerate(racks):
+            d = os.path.join(td, f"n{i}")
+            os.makedirs(d)
+            n = Node(i, d, master.address, rack=rack)
+            n.start()
+            nodes.append(n)
+        client = None
+        stop_traffic = threading.Event()
+        traffic_threads: list[threading.Thread] = []
+        try:
+            client = MasterClient(master.address)
+            deadline0 = time.monotonic() + 120
+            while time.monotonic() < deadline0:
+                if len(master.topology.nodes) == len(nodes):
+                    break
+                time.sleep(0.5)
+            assert len(master.topology.nodes) == len(nodes), "cluster did not form"
+
+            # -- volumes + blobs (single-copy: EC is the only redundancy,
+            # so the zero-loss bar is carried entirely by the stripes) ----
+            master._rpc_volume_grow({"count": 4, "replication": "000"}, None)
+            blobs: dict[str, bytes] = {}
+            for _ in range(40):
+                size = rng.randrange(4_000, 20_000)
+                payload = rng.getrandbits(8 * size).to_bytes(size, "little")
+                for _attempt in range(10):
+                    try:
+                        a = client.assign(replication="000")
+                        client.upload(a.fid, payload)
+                        blobs[a.fid] = payload
+                        report["writes"] += 1
+                        break
+                    except Exception:  # noqa: BLE001
+                        time.sleep(0.5)
+                else:
+                    report["write_failures"] += 1
+            by_vid: dict[int, list[str]] = {}
+            for fid in blobs:
+                by_vid.setdefault(int(fid.split(",", 1)[0]), []).append(fid)
+            vids = sorted(by_vid)[:4]
+            assert len(vids) >= 2, f"need >=2 blob-bearing volumes, got {vids}"
+            # A-type: rk0 holds ONE shard; B-type: rk0 holds TWO
+            plans = {
+                "A": {2: [0, 1, 2], 3: [3, 4, 5], 4: [6, 7, 8],
+                      5: [9, 10], 6: [11, 12], 0: [13]},
+                "B": {2: [0, 1, 2], 3: [3, 4, 5], 4: [6, 7, 8],
+                      5: [9, 10], 6: [11], 0: [12], 1: [13]},
+            }
+            vtypes = {vid: ("A" if i % 2 == 0 else "B") for i, vid in enumerate(vids)}
+            report["volumes"] = {str(v): vtypes[v] for v in vids}
+
+            def vs_call(n: Node, method: str, req: dict, timeout=120):
+                with _rpc.RpcClient(f"127.0.0.1:{n.grpc}") as c:
+                    return c.call(VOLUME_SERVICE, method, req, timeout=timeout)
+
+            def owner_of(vid: int) -> Node:
+                for n in nodes:
+                    try:
+                        st = vs_call(n, "VolumeStatus", {"volume_id": vid}, timeout=5)
+                        if st.get("kind") == "normal":
+                            return n
+                    except Exception:  # noqa: BLE001
+                        continue
+                raise AssertionError(f"no owner for volume {vid}")
+
+            for vid in vids:
+                owner = owner_of(vid)
+                plan = plans[vtypes[vid]]
+                vs_call(owner, "VolumeMarkReadonly", {"volume_id": vid})
+                vs_call(
+                    owner, "VolumeEcShardsGenerate",
+                    {"volume_id": vid, "large_block_size": 16384,
+                     "small_block_size": 4096},
+                )
+                src = f"127.0.0.1:{owner.grpc}"
+                for idx, sids in plan.items():
+                    n = nodes[idx]
+                    if n is owner:
+                        continue
+                    vs_call(
+                        n, "VolumeEcShardsCopy",
+                        {"volume_id": vid, "shard_ids": sids,
+                         "source_data_node": src, "copy_ecx_file": True},
+                    )
+                    vs_call(
+                        n, "VolumeEcShardsMount",
+                        {"volume_id": vid, "shard_ids": sids},
+                    )
+                kept = plan.get(owner.i, [])
+                moved = [s for s in range(14) if s not in kept]
+                if moved:
+                    vs_call(
+                        owner, "VolumeEcShardsDelete",
+                        {"volume_id": vid, "shard_ids": moved},
+                    )
+                if kept:
+                    vs_call(
+                        owner, "VolumeEcShardsMount",
+                        {"volume_id": vid, "shard_ids": kept},
+                    )
+                vs_call(owner, "VolumeDelete", {"volume_id": vid})
+
+            def coverage(vid: int) -> list[int]:
+                return sorted(master.topology.lookup_ec_shards(vid))
+
+            deadline0 = time.monotonic() + 60
+            while time.monotonic() < deadline0:
+                if all(coverage(v) == list(range(14)) for v in vids):
+                    break
+                time.sleep(0.5)
+            assert all(coverage(v) == list(range(14)) for v in vids), {
+                v: coverage(v) for v in vids
+            }
+
+            # -- open-loop read traffic (Poisson arrivals, latency from
+            # SCHEDULED time so repair-storm stalls surface as tail) ------
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=16)
+            fids = list(blobs)
+            offered = [0]
+            failed = [0]
+
+            def one_read(scheduled: float, fid: str) -> None:
+                try:
+                    got = client.read(fid)
+                    lat_rec.observe("rack", "read", time.monotonic() - scheduled)
+                    if got != blobs[fid]:
+                        report["lost"].append({"fid": fid, "why": "BYTES DIFFER"})
+                except Exception:  # noqa: BLE001 — holders mid-kill
+                    failed[0] += 1
+                report["reads"] += 1
+
+            def generator() -> None:
+                rps = 20.0
+                nxt = time.monotonic()
+                lrng = random.Random(99)
+                while not stop_traffic.is_set():
+                    nxt += lrng.expovariate(rps)
+                    delay = nxt - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    offered[0] += 1
+                    pool.submit(one_read, nxt, lrng.choice(fids))
+
+            t = threading.Thread(target=generator, daemon=True)
+            t.start()
+            traffic_threads.append(t)
+
+            # -- phases ---------------------------------------------------
+            def repair_events_after(seq0: int) -> list[dict]:
+                return [
+                    e for e in master.repair.status()["events"]
+                    if e["seq"] > seq0
+                ]
+
+            def priority_ok(events: list[dict]) -> bool:
+                """Every >=2-missing dispatch strictly precedes every
+                1-missing dispatch — the acceptance ordering gate."""
+                dispatched = [e for e in events if e["state"] == "dispatched"]
+                two = [e["seq"] for e in dispatched if e["missing"] >= 2]
+                one = [e["seq"] for e in dispatched if e["missing"] == 1]
+                if not two or not one:
+                    return False  # the scenario must produce BOTH classes
+                return max(two) < min(one)
+
+            def run_phase(name: str, victims: list[Node], budget: float) -> dict:
+                seq0 = max(
+                    (e["seq"] for e in master.repair.status()["events"]),
+                    default=0,
+                )
+                for v in victims:
+                    v.kill(hard=True)
+                    report["kills"] += 1
+                t0 = time.monotonic()
+                deadline = t0 + budget
+                # the registry keeps the dead holders until detection
+                # lands: coverage must first DROP (the loss is real and
+                # visible) before "complete again" means anything
+                saw_loss = False
+                while time.monotonic() < deadline:
+                    complete = all(coverage(v) == list(range(14)) for v in vids)
+                    if not complete:
+                        saw_loss = True
+                    elif saw_loss:
+                        st = master.repair.status()
+                        if st["queue_depth"] == 0 and st["inflight"] == 0:
+                            break
+                    time.sleep(1.0)
+                events = repair_events_after(seq0)
+                phase = {
+                    "victims": [v.i for v in victims],
+                    "heal_seconds": round(time.monotonic() - t0, 1),
+                    "coverage_complete": all(
+                        coverage(v) == list(range(14)) for v in vids
+                    ),
+                    "priority_ok": priority_ok(events),
+                    "events": [
+                        {k: e[k] for k in
+                         ("seq", "volume_id", "missing", "state", "target")}
+                        for e in events
+                    ],
+                }
+                return phase
+
+            report["phase1_node"] = run_phase("node", [nodes[6]], 150.0)
+            nodes[6].start()  # stale shards re-register as duplicates
+            time.sleep(8.0)
+            report["phase2_rack"] = run_phase("rack", [nodes[0], nodes[1]], 200.0)
+
+            # -- post-heal placement audit --------------------------------
+            with master.topology._lock:
+                domains = {
+                    u: (n.data_center, n.rack)
+                    for u, n in master.topology.nodes.items()
+                }
+            violations: list[str] = []
+            for vid in vids:
+                holders = {
+                    sid: [n.url for n in hs]
+                    for sid, hs in master.topology.lookup_ec_shards(vid).items()
+                }
+                for dom, sids in placement.stripe_violations(holders, domains, 4):
+                    violations.append(
+                        f"vid={vid} rack={dom[1]} holds {len(sids)} shards {sids}"
+                    )
+            report["placement_violations"] = violations
+
+            # -- wind down: everyone back, every byte read ----------------
+            stop_traffic.set()
+            pool.shutdown(wait=True, cancel_futures=False)
+            for n in (nodes[0], nodes[1]):
+                n.start()
+            time.sleep(8.0)
+            for fid, want in list(blobs.items()):
+                got = None
+                for _attempt in range(12):
+                    try:
+                        got = client.read(fid)
+                        break
+                    except Exception:  # noqa: BLE001
+                        report["read_failures_transient"] += 1
+                        time.sleep(1.0)
+                report["reads"] += 1
+                if got is None:
+                    report["lost"].append({"fid": fid, "why": "unreadable at end"})
+                elif got != want:
+                    report["lost"].append({"fid": fid, "why": "BYTES DIFFER"})
+            report["traffic"] = {
+                "offered": offered[0],
+                "failed_transient": failed[0],
+                "rps": 20.0,
+                "latency": lat_rec.phases().get("rack", {}),
+            }
+            from seaweedfs_tpu import stats as _stats
+
+            report["repair_counters"] = {
+                "dispatch_by_missing": {
+                    # per-class dispatch counts straight off the master's
+                    # in-process registry
+                    k[0]: c.value
+                    for k, c in _stats.RepairDispatch._children.items()
+                },
+                "backoffs": _stats.RepairBackoff.value,
+            }
+        finally:
+            stop_traffic.set()
+            if client is not None:
+                client.close()
+            for n in nodes:
+                try:
+                    n.kill(hard=False)
+                except Exception:  # noqa: BLE001
+                    pass
+            master.stop()
+
+    report["files"] = len(blobs)
+    report["ok"] = (
+        not report["lost"]
+        and report.get("phase1_node", {}).get("coverage_complete", False)
+        and report.get("phase1_node", {}).get("priority_ok", False)
+        and report.get("phase2_rack", {}).get("coverage_complete", False)
+        and report.get("phase2_rack", {}).get("priority_ok", False)
+        and not report.get("placement_violations")
+    )
+    os.makedirs(ART, exist_ok=True)
+    with open(os.path.join(ART, "SOAK_r12.json"), "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
 def main() -> int:
     seconds = 300
     if "--seconds" in sys.argv:
         seconds = int(sys.argv[sys.argv.index("--seconds") + 1])
+    if "--rack" in sys.argv:
+        return run_rack_mode(seconds)
     wedge_mode = "--wedge" in sys.argv
     latency_mode = "--latency" in sys.argv
     inline_mode = "--inline" in sys.argv

@@ -68,6 +68,12 @@ SCRAPED_COUNTERS = (
     "weedtpu_scrub_cycles_total",
     "weedtpu_ec_convert_bytes_total",
     "weedtpu_ec_convert_seconds_count",
+    # fleet repair scheduler (master-side: the master's /metrics is
+    # scraped too on subprocess runs) + inline parity spreading
+    "weedtpu_repair_dispatch_total",
+    "weedtpu_repair_backoff_total",
+    "weedtpu_inline_ec_spread_bytes_total",
+    "weedtpu_inline_ec_spread_commits_total",
 )
 
 
@@ -904,6 +910,11 @@ def main(argv=None) -> int:
             # /metrics already holds the whole process's counters
             for n in (nodes[:1] if args.smoke else nodes):
                 scraper.scrape(n.http)
+            if not args.smoke:
+                # the in-process master's registry carries the fleet
+                # repair scheduler counters (weedtpu_repair_*); smoke
+                # runs share ONE process registry already scraped above
+                scraper.scrape(master.http_port)
             for n in (nodes[:1] if args.smoke else nodes):
                 tracer.scrape(n.http)
             counters = scraper.totals

@@ -19,7 +19,7 @@ import grpc
 
 from seaweedfs_tpu import rpc, stats
 from seaweedfs_tpu.obs import trace as trace_mod
-from seaweedfs_tpu.utils import httpd
+from seaweedfs_tpu.utils import config, httpd
 from seaweedfs_tpu.cluster.sequence import MemorySequencer
 from seaweedfs_tpu.security.jwt import mint_file_token
 from seaweedfs_tpu.cluster.topology import Topology, VolumeLayout
@@ -85,6 +85,17 @@ class MasterServer:
         self._stop = threading.Event()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._vacuumer = threading.Thread(target=self._vacuum_loop, daemon=True)
+        # fleet repair scheduler (WEEDTPU_REPAIR=on): mass-rebuild brain
+        # that ranks under-replicated stripes by remaining redundancy and
+        # drives batched rebuilds through the admission lane. Soft state,
+        # like the topology — every master keeps a queue; only the leader
+        # dispatches.
+        self.repair = None
+        if config.env("WEEDTPU_REPAIR") == "on":
+            from seaweedfs_tpu.ec.fleet import RepairScheduler
+
+            self.repair = RepairScheduler(self)
+            self.topology.on_ec_shrink = self.repair.kick
         # raft HA (reference: master quorum; single-master when no peers)
         self.raft = None
         if peers:
@@ -191,9 +202,13 @@ class MasterServer:
             self.raft.start()
         self._reaper.start()
         self._vacuumer.start()
+        if self.repair is not None:
+            self.repair.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.repair is not None:
+            self.repair.stop()
         if self._http is not None:
             # shutdown() blocks on an event only serve_forever() sets — a
             # never-started thread (start() raised early) must skip it
@@ -217,7 +232,9 @@ class MasterServer:
 
     def _reap_loop(self) -> None:
         while not self._stop.wait(self._reap_interval):
-            self.topology.reap_dead_nodes()
+            dead = self.topology.reap_dead_nodes()
+            if dead and self.repair is not None:
+                self.repair.kick("nodes reaped")
 
     # -- automatic vacuum (topology_vacuum.go analog) --------------------------
 
@@ -304,7 +321,25 @@ class MasterServer:
         svc.add("RaftListClusterServers", self._rpc_raft_status)
         svc.add("VolumeGrow", self._rpc_volume_grow)
         svc.add("CollectionDelete", self._rpc_collection_delete)
+        svc.add("RepairStatus", self._rpc_repair_status)
         return svc
+
+    def _rpc_repair_status(self, req: dict, ctx) -> dict:
+        """Fleet-repair view for `ec.status` and the chaos gates: queue
+        depth, redundancy histogram, placement-violation audit, and the
+        seq-ordered dispatch event log that proves 2-missing stripes
+        began repair before any 1-missing stripe."""
+        if self.repair is None:
+            return {
+                "enabled": False,
+                "queue_depth": 0,
+                "inflight": 0,
+                "redundancy_histogram": {},
+                "violations": [],
+                "events": [],
+                "suspects": [],
+            }
+        return self.repair.status()
 
     def _rpc_collection_delete(self, req: dict, ctx) -> dict:
         """Drop every volume and EC shard set of one collection across the
@@ -520,6 +555,8 @@ class MasterServer:
         stats.MasterReceivedHeartbeatCounter.inc()
         hb = Heartbeat.from_dict(req)
         self.topology.process_heartbeat(hb)
+        if self.repair is not None and hb.unreachable_peers:
+            self.repair.note_reports(hb.url, hb.unreachable_peers)
         return {
             "volume_size_limit": self.topology.volume_size_limit,
             "leader": self._leader_address() or self.address,
@@ -602,13 +639,23 @@ class MasterServer:
         shard_map = self.topology.lookup_ec_shards(vid)
         if not shard_map:
             raise rpc.NotFoundFault(f"ec volume {vid} not found")
+        # each holder carries its failure-domain labels: readers sort
+        # their survivor/hedge ladders same-rack-first on ties, so a
+        # degraded read prefers the cheap fetch without a master
+        # round-trip at decision time
         return {
             "volume_id": vid,
             "shard_id_locations": [
                 {
                     "shard_id": sid,
                     "locations": [
-                        {"url": n.url, "public_url": n.public_url, "grpc_port": n.grpc_port}
+                        {
+                            "url": n.url,
+                            "public_url": n.public_url,
+                            "grpc_port": n.grpc_port,
+                            "data_center": n.data_center,
+                            "rack": n.rack,
+                        }
                         for n in nodes
                     ],
                 }

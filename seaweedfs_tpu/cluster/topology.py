@@ -121,11 +121,21 @@ class Topology:
         # EC registry: vid -> {shard_id -> set of node urls}
         self.ec_locations: dict[int, dict[int, set[str]]] = {}
         self.ec_collections: dict[int, str] = {}
+        # per-volume code geometry + shard size, from heartbeats: the
+        # repair scheduler ranks stripes by bytes at risk and computes
+        # missing counts against the VOLUME's (k, k+m), not the legacy 14
+        self.ec_geometry: dict[int, dict] = {}
         self.max_volume_id = 0
+        # optional observer (the master's repair scheduler): called OUTSIDE
+        # the topology lock whenever a heartbeat/unregister SHRANK some
+        # node's EC shard coverage — the death/quarantine signal that makes
+        # mass repair react in heartbeat time instead of scan time
+        self.on_ec_shrink = None
 
     # -- heartbeat ingest ----------------------------------------------------
 
     def process_heartbeat(self, hb: Heartbeat) -> None:
+        shrank = False
         with self._lock:
             node = self.nodes.get(hb.url)
             if node is None:
@@ -135,6 +145,8 @@ class Topology:
             node.max_volume_count = hb.max_volume_count
             node.grpc_port = hb.grpc_port
             node.public_url = hb.public_url or hb.url
+            node.data_center = hb.data_center
+            node.rack = hb.rack
 
             new_volumes = {}
             for vd in hb.volumes:
@@ -155,8 +167,22 @@ class Topology:
                 self.max_volume_id = max(self.max_volume_id, info.volume_id)
                 if getattr(info, "collection", ""):
                     self.ec_collections[info.volume_id] = info.collection
+                if info.total_shards or info.shard_size:
+                    self.ec_geometry[info.volume_id] = {
+                        "data_shards": info.data_shards,
+                        "total_shards": info.total_shards,
+                        "shard_size": info.shard_size,
+                    }
+            for vid, bits in node.ec_shards.items():
+                if bits.minus(new_shards.get(vid, ShardBits(0))):
+                    shrank = True  # some shard this node held is gone
             self._sync_ec_shards(node, new_shards)
             node.ec_shards = new_shards
+        if shrank and self.on_ec_shrink is not None:
+            try:
+                self.on_ec_shrink()
+            except Exception:  # noqa: BLE001 — observers must not break ingest
+                pass
 
     def _sync_ec_shards(self, node: DataNode, new: dict[int, ShardBits]) -> None:
         old = node.ec_shards
@@ -178,6 +204,7 @@ class Topology:
             if not m:
                 del self.ec_locations[vid]
                 self.ec_collections.pop(vid, None)
+                self.ec_geometry.pop(vid, None)
 
     def unregister_node(self, url: str) -> None:
         with self._lock:
@@ -186,7 +213,13 @@ class Topology:
                 return
             for vi in node.volumes.values():
                 self._layout_for_volume(vi).unregister(vi.id, node)
+            held_ec = bool(node.ec_shards)
             self._sync_ec_shards(node, {})
+        if held_ec and self.on_ec_shrink is not None:
+            try:
+                self.on_ec_shrink()
+            except Exception:  # noqa: BLE001 — observers must not break ingest
+                pass
 
     def reap_dead_nodes(self) -> list[str]:
         with self._lock:

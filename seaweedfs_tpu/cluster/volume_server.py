@@ -186,6 +186,13 @@ class VolumeServer:
         # nor serves the projection read — the capability-negotiation
         # fallback path): on | off | auto
         self._trace_repair = config.env("WEEDTPU_TRACE_REPAIR")
+        # peer-unreachable accounting for the heartbeat report: the repair
+        # scheduler cross-checks these against heartbeat silence, so a
+        # dead holder is discovered in read-path time instead of waiting
+        # for the topology reaper (initialized before scrub — its repair
+        # threads exercise the peer paths from __init__ onward)
+        self._peer_fail_mu = threading.Lock()
+        self._peer_failures: dict[str, int] = {}
         # scrub & self-heal: the background integrity scanner (when the
         # policy is on) plus the quarantine/repair machinery it feeds.
         # Repair workers start LAZILY on the first quarantine — ec.verify
@@ -226,7 +233,13 @@ class VolumeServer:
             from seaweedfs_tpu.ec.ingest import IngestManager
 
             self._ingest = IngestManager(
-                self.store, seal_trigger=self._auto_inline_seal
+                self.store,
+                seal_trigger=self._auto_inline_seal,
+                spread_factory=(
+                    self._spread_factory
+                    if config.env("WEEDTPU_INLINE_EC_SPREAD") == "on"
+                    else None
+                ),
             )
             self.store.on_write = self._ingest.on_write
 
@@ -281,6 +294,27 @@ class VolumeServer:
 
     # -- heartbeat -----------------------------------------------------------
 
+    def _note_peer_failure(self, addr: str) -> None:
+        """One unreachable-peer observation (degraded fetch, slab stream,
+        shard pull failed at the transport). Crossing the report
+        threshold puts the addr in the next heartbeat's
+        unreachable_peers — the repair scheduler's fast death signal."""
+        with self._peer_fail_mu:
+            self._peer_failures[addr] = self._peer_failures.get(addr, 0) + 1
+
+    def _note_peer_success(self, addr: str) -> None:
+        if not self._peer_failures:
+            return
+        with self._peer_fail_mu:
+            self._peer_failures.pop(addr, None)
+
+    def _unreachable_peers(self) -> list[str]:
+        threshold = int(config.env("WEEDTPU_REPAIR_REPORT_FAILURES"))
+        with self._peer_fail_mu:
+            return sorted(
+                a for a, n in self._peer_failures.items() if n >= threshold
+            )
+
     def _make_heartbeat(self) -> Heartbeat:
         stats.VolumeServerVolumeGauge.labels("normal").set(
             sum(len(loc.volumes) for loc in self.store.locations)
@@ -298,6 +332,7 @@ class VolumeServer:
             max_volume_count=self.max_volume_count,
             volumes=self.store.volume_infos(),
             ec_shards=[i.to_dict() for i in self.store.ec_volume_infos()],
+            unreachable_peers=self._unreachable_peers(),
         )
 
     def _masters_fanout(self, method: str, req: dict, timeout: float) -> int:
@@ -438,9 +473,28 @@ class VolumeServer:
                             time.sleep(delay)
                     locs: dict[int, list[str]] = {}
                     for entry in resp.get("shard_id_locations", []):
+                        # domain-locality ladder: the master annotates each
+                        # holder with its rack/DC, so ties in the failover
+                        # ladder (and the hedge's alternate pick) prefer
+                        # same-rack, then same-DC holders — the cheap fetch
+                        # — without any lookup at read time. Stable within
+                        # a tier: the master's ordering is preserved.
+                        def _locality(locd: dict) -> int:
+                            if not locd.get("rack") and not locd.get("data_center"):
+                                return 1  # unlabeled reply: neutral
+                            if (
+                                locd.get("data_center") == self.data_center
+                                and locd.get("rack") == self.rack
+                            ):
+                                return 0
+                            if locd.get("data_center") == self.data_center:
+                                return 1
+                            return 2
                         addrs = [
                             f"{locd['url'].rsplit(':', 1)[0]}:{locd['grpc_port']}"
-                            for locd in entry["locations"]
+                            for locd in sorted(
+                                entry["locations"], key=_locality
+                            )
                             if locd["url"] != self.url  # not a remote for ourselves
                         ]
                         if addrs:
@@ -524,11 +578,13 @@ class VolumeServer:
                             )
                             buf = b"".join(chunks)
                             if len(buf) == size:
+                                self._note_peer_success(addr)
                                 return buf
                             failed = True  # holder answered short: stale layout
                             trace_mod.annotate(short=len(buf))
                         except Exception:  # noqa: BLE001 — try next holder
                             self._peer_pool.invalidate(addr)
+                            self._note_peer_failure(addr)
                             failed = True
                             trace_mod.annotate(failed=True)
                         finally:
@@ -613,9 +669,13 @@ class VolumeServer:
                         timeout=EC_SHARD_READ_TIMEOUT,
                     )
                     buf = b"".join(chunks)
-                return buf if len(buf) == size else None
+                if len(buf) == size:
+                    self._note_peer_success(addr)
+                    return buf
+                return None
             except Exception:  # noqa: BLE001 — a failed backup is a miss
                 self._peer_pool.invalidate(addr)
+                self._note_peer_failure(addr)
                 return None
             finally:
                 attempts.pop(token, None)
@@ -981,6 +1041,9 @@ class VolumeServer:
         add("VolumeEcShardsGenerate", self._rpc_ec_generate)
         add("VolumeEcShardsCopy", self._rpc_ec_copy)
         add("VolumeEcShardsRebuild", self._rpc_ec_rebuild)
+        add("VolumeEcShardsRebuildBatch", self._rpc_ec_rebuild_batch)
+        add("VolumeEcShardPartialWrite", self._rpc_ec_partial_write)
+        add("VolumeEcShardSpreadCommit", self._rpc_ec_spread_commit)
         add("VolumeEcShardsConvert", self._rpc_ec_convert)
         add("VolumeEcShardsVerify", self._rpc_ec_verify)
         add("VolumeEcShardsMount", self._rpc_ec_mount)
@@ -1199,6 +1262,8 @@ class VolumeServer:
                 "size": v.content_size(),
                 "file_count": v.needle_count(),
                 "read_only": v.read_only,
+                "rack": self.rack,
+                "data_center": self.data_center,
             }
         ev = self.store.get_ec_volume(vid)
         if ev is not None:
@@ -1237,6 +1302,10 @@ class VolumeServer:
                 # total, not the legacy 14
                 "data_shards": ev.data_shards,
                 "total_shards": ev.total_shards,
+                # failure-domain labels: placement planners and operator
+                # audits read the holder's rack/zone straight off status
+                "rack": self.rack,
+                "data_center": self.data_center,
             }
         raise rpc.NotFoundFault(f"volume {vid} not found")
 
@@ -1273,6 +1342,10 @@ class VolumeServer:
         import base64
 
         try:
+            # wire the remote reader first: an EC volume whose stripe is
+            # partly remote (spread parity, lost local shards) must serve
+            # this read through the same degraded ladder as the HTTP path
+            self._open_ec_volume(int(req["volume_id"]))
             n = self.store.read_needle(int(req["volume_id"]), int(req["needle_id"]))
         except CrcError:
             # same verify-on-read healing as the HTTP path: a repairer
@@ -1414,6 +1487,10 @@ class VolumeServer:
         with self.maintenance_lock(vid):  # never interleave with compact/copy
             if req.get("inline") and self._inline_usable(kwargs):
                 info = self._ingest.seal_volume(vid, v.base_path)
+                # the SHELL owns this seal's cut-over (ec.encode copies +
+                # spreads from here): discard any pre-spread partials so
+                # its allocation starts from the full local set
+                self._finalize_spread(vid, v.base_path, "shell")
             else:
                 if self._ingest is not None:
                     # a warm generate supersedes any inline partial state:
@@ -1470,11 +1547,21 @@ class VolumeServer:
                     v.read_only = True
                     froze = True
                 t0 = time.monotonic()
-                self._ingest.seal_volume(vid, v.base_path)
+                seal_info = self._ingest.seal_volume(vid, v.base_path)
                 stripe.write_sorted_file_from_idx(v.base_path)
+                # spread cut-over BEFORE the local mount: committed parity
+                # shards mount on their planned holders and vanish from
+                # this node's discovery set — the volume is born spread,
+                # the owner never hosts all k+m (broken/unplanned spreads
+                # leave everything local exactly as before)
+                spread_done = self._finalize_spread(
+                    vid, v.base_path, seal_info.get("mode", "warm")
+                )
                 self.store.mount_ec_volume(vid, v.base_path)
                 stats.EcEncodeSeconds.observe(time.monotonic() - t0)
                 stats.EcEncodeBytes.inc(os.path.getsize(v.base_path + ".dat"))
+                if spread_done:
+                    trace_mod.annotate(spread=spread_done)
                 sealed = True
             self.heartbeat_once()
         except Exception:  # noqa: BLE001 — auto-seal is opportunistic: the
@@ -1520,6 +1607,162 @@ class VolumeServer:
                         continue
                     raise
         return {}
+
+    # -- inline-ingest parity spreading (WEEDTPU_INLINE_EC_SPREAD) -----------
+
+    def _spread_factory(self, vid: int, base: str):
+        """Build a SpreadSession for one ingesting volume: ask the master
+        for the live topology, run the failure-domain planner over it,
+        and tee each parity shard at its planned eventual holder. None
+        (no spreading, seal stays fully local) when the cluster has no
+        viable targets or the master is unreachable."""
+        from seaweedfs_tpu.ec import placement
+        from seaweedfs_tpu.ec import spread as spread_mod
+        from seaweedfs_tpu.ec.shard_bits import ShardBits
+        from seaweedfs_tpu.storage.store import parse_base_name
+
+        topo = self._master_query("VolumeList", {})
+        nodes: list[dict] = []
+        for dc, racks in (topo.get("data_centers") or {}).items():
+            for rack, nds in racks.items():
+                for nd in nds:
+                    nodes.append(
+                        {
+                            "url": nd["url"],
+                            "grpc": f"{nd['url'].rsplit(':', 1)[0]}:{nd['grpc_port']}",
+                            "data_center": dc,
+                            "rack": rack,
+                            "ec_load": sum(
+                                ShardBits(e.get("shard_bits", 0)).shard_id_count()
+                                for e in nd.get("ec_shards", [])
+                            ),
+                        }
+                    )
+        enc = self.store.encoder
+        targets = placement.plan_parity_targets(
+            nodes,
+            self.url,
+            enc.data_shards,
+            enc.total_shards,
+            cap_override=int(config.env("WEEDTPU_PLACEMENT_MAX_PER_DOMAIN")),
+            load_of=lambda n: n["ec_load"],
+        )
+        if not targets:
+            return None
+        parsed = parse_base_name(os.path.basename(base))
+        return spread_mod.SpreadSession(
+            vid,
+            parsed[0] if parsed else "",
+            base,
+            {sid: n["grpc"] for sid, n in targets.items()},
+            self._peer_pool,
+            enc.data_shards,
+            self._ingest.large,
+        )
+
+    def _finalize_spread(self, vid: int, base: str, mode: str) -> list[int]:
+        """Seal cut-over for a pre-spread volume: commit each target's
+        parity partial (tail ship + CRC verify + rename + mount there)
+        and unlink the owner's local copy of every committed shard, so
+        the subsequent local mount hosts only the remaining shards.
+        Inline/resumed seals only — a warm fallback re-encoded from
+        scratch, so its spread partials are aborted instead."""
+        if self._ingest is None:
+            return []
+        session = self._ingest.take_spread(vid)
+        if session is None:
+            return []
+        if mode not in ("inline", "resumed"):
+            session.abort()
+            return []
+        info = stripe.read_ec_info(base)
+        recorded = (info or {}).get("shard_crc32")
+        total = stripe.geometry_from_info(info).total_shards
+        if not isinstance(recorded, list) or len(recorded) != total:
+            session.abort()  # nothing to CRC-verify commits against
+            return []
+        shard_size = scrub_mod.expected_shard_size(info)
+        done = session.finalize(self.grpc_address, recorded, shard_size)
+        for s in done:
+            try:
+                os.unlink(stripe.shard_file_name(base, s))
+            except OSError:
+                pass  # already absent: the target still hosts it
+        return done
+
+    def _rpc_ec_partial_write(self, req: dict, ctx) -> dict:
+        """VolumeEcShardPartialWrite: land one absolute-offset window of
+        a parity shard being spread to this node into `<base>.ecNN.inp`
+        (invisible to shard discovery until the commit renames it)."""
+        from seaweedfs_tpu.ec.ingest import part_path
+
+        vid = int(req["volume_id"])
+        shard = int(req["shard_id"])
+        offset = int(req.get("offset", 0))
+        raw = req.get("data") or ""
+        data = (
+            base64.b64decode(raw) if isinstance(raw, str) else bytes(raw)
+        )
+        base = self._base_path_for(vid, req.get("collection", ""))
+        p = part_path(base, shard)
+        mode = "r+b" if os.path.exists(p) else "w+b"
+        with open(p, mode) as f:
+            f.seek(offset)
+            f.write(data)
+        return {}
+
+    def _rpc_ec_spread_commit(self, req: dict, ctx) -> dict:
+        """VolumeEcShardSpreadCommit: finalize (or, with size=0, discard)
+        a spread parity partial. The bytes on disk must CRC32-match the
+        owner's .eci record BEFORE the rename — a torn ship sequence
+        must never mount as a real shard."""
+        from seaweedfs_tpu.ec import spread as spread_mod
+        from seaweedfs_tpu.ec.ingest import part_path
+
+        vid = int(req["volume_id"])
+        shard = int(req["shard_id"])
+        size = int(req.get("size", 0))
+        collection = req.get("collection", "")
+        base = self._base_path_for(vid, collection)
+        p = part_path(base, shard)
+        if size <= 0:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+            return {"mounted": False}
+        if not os.path.exists(p):
+            raise rpc.NotFoundFault(f"no spread partial for {vid}.{shard:02d}")
+        with self.maintenance_lock(vid):
+            with open(p, "r+b") as f:
+                f.truncate(size)
+                f.flush()
+                os.fsync(f.fileno())
+            crc = spread_mod.local_crc(p)
+            if crc != (int(req.get("crc32", 0)) & 0xFFFFFFFF):
+                os.unlink(p)  # torn spread: the owner keeps its local copy
+                raise rpc.RpcFault(
+                    f"spread partial {vid}.{shard:02d} CRC mismatch",
+                    code=grpc.StatusCode.FAILED_PRECONDITION,
+                )
+            src = req.get("source_data_node") or ""
+            if src:
+                self._ensure_ec_index_files(vid, collection, base, [src])
+            os.replace(p, stripe.shard_file_name(base, shard))
+            mounted = False
+            if req.get("mount"):
+                ev = self.store.get_ec_volume(vid)
+                if ev is not None:
+                    mounted = ev.mount_local_shard(shard)
+                else:
+                    self.store.mount_ec_volume(vid, base)
+                    mounted = True
+        if mounted:
+            try:
+                self.heartbeat_once()  # this node is a holder NOW
+            except Exception:  # noqa: BLE001 — next beat carries it
+                pass
+        return {"mounted": mounted}
 
     def _rpc_ec_file_copy(self, req: dict, ctx):
         """Stream one local EC-related file (server side of ShardsCopy and
@@ -1746,6 +1989,157 @@ class VolumeServer:
                 trace_fallback=trace_fallback,
             )
             return resp
+
+    def _rpc_ec_rebuild_batch(self, req: dict, ctx) -> dict:
+        """VolumeEcShardsRebuildBatch: this node rebuilds MANY volumes'
+        missing shards in one call — the fleet scheduler's dispatch unit.
+        Each volume is planned like a single remote rebuild (fresh holder
+        map, survivor choice, shard-size preflight, slab sources through
+        the admission-gated bulk read), then same-signature volumes fuse
+        into shared width-packed decode pipelines
+        (`stripe.rebuild_ec_files_batch`). Rebuilt shards mount here and
+        the delta heartbeats immediately. Per-volume failures are soft
+        (reported in `results[].error`); the call only faults wholesale
+        on malformed requests."""
+        vols = list(req.get("volumes") or [])
+        if not vols:
+            raise rpc.RpcFault(
+                "volumes required", code=grpc.StatusCode.INVALID_ARGUMENT
+            )
+        tuning = {}
+        if int(req.get("buffer_size") or 0) > 0:
+            tuning["buffer_size"] = int(req["buffer_size"])
+        if int(req.get("max_batch_bytes") or 0) > 0:
+            tuning["max_batch_bytes"] = int(req["max_batch_bytes"])
+        t0 = time.monotonic()
+        jobs: list[dict] = []
+        meta: dict[str, dict] = {}  # base -> {vid, collection}
+        errors: dict[int, str] = {}
+        executor = futures.ThreadPoolExecutor(
+            max_workers=EC_REBUILD_FETCH_WORKERS,
+            thread_name_prefix="ec-rebuild-batch",
+        )
+        with ExitStack() as locks, trace_mod.ensure("rebuild.run", klass="maint"):
+            trace_mod.annotate(batch=len(vols))
+            # per-volume maintenance locks, vid-sorted so concurrent
+            # batches can never deadlock on each other
+            for v in sorted(vols, key=lambda d: int(d["volume_id"])):
+                vid = int(v["volume_id"])
+                collection = v.get("collection", "")
+                sources: dict[int, stripe.SlabSource] = {}
+                try:
+                    locks.enter_context(self.maintenance_lock(vid))
+                    base = self._base_path_for(vid, collection)
+                    self._invalidate_shard_locations(vid)
+                    locs = self._lookup_shard_locations(vid)
+                    local = set(stripe.find_local_shards(base))
+                    present = sorted(local | set(locs))
+                    enc = stripe.encoder_for_base(base, self.store.encoder)
+                    missing = [
+                        s for s in range(enc.total_shards) if s not in present
+                    ]
+                    if not missing:
+                        meta.setdefault(base, {"vid": vid, "collection": collection})
+                        jobs.append(
+                            {"base": base, "sources": {}, "shard_size": 0,
+                             "missing": [], "encoder": enc}
+                        )
+                        continue
+                    if len(present) < enc.data_shards:
+                        errors[vid] = (
+                            f"only {len(present)} survivors reachable, "
+                            f"need {enc.data_shards}"
+                        )
+                        continue
+                    holders = sorted({a for aa in locs.values() for a in aa})
+                    self._ensure_ec_index_files(vid, collection, base, holders)
+                    shard_size, _caps = self._resolve_shard_size(
+                        vid, base, local, holders
+                    )
+                    chosen = present[: enc.data_shards]
+                    for s in chosen:
+                        if s in local:
+                            sources[s] = stripe.LocalSlabSource(
+                                stripe.shard_file_name(base, s)
+                            )
+                    sources.update(
+                        self._remote_slab_sources(
+                            vid, [s for s in chosen if s not in local], executor
+                        )
+                    )
+                    meta[base] = {"vid": vid, "collection": collection}
+                    jobs.append(
+                        {
+                            "base": base,
+                            "sources": sources,
+                            "shard_size": shard_size,
+                            "missing": missing,
+                            "encoder": enc,
+                        }
+                    )
+                except Exception as e:  # noqa: BLE001 — soft per-volume
+                    # sources opened before the failure (local survivor
+                    # handles) must not leak fds: the post-run cleanup
+                    # only reaches jobs that were actually appended
+                    for src in sources.values():
+                        src.close()
+                    errors[vid] = f"{type(e).__name__}: {e}"[:300]
+            try:
+                res = stripe.rebuild_ec_files_batch(jobs, **tuning)
+            finally:
+                for job in jobs:
+                    for src in job["sources"].values():
+                        src.close()
+                executor.shutdown(wait=False, cancel_futures=True)
+        results: list[dict] = []
+        total_wire = 0
+        for job in jobs:
+            base = job["base"]
+            m = meta[base]
+            wire = sum(
+                src.bytes_fetched
+                for src in job["sources"].values()
+                if isinstance(src, stripe.RemoteSlabSource)
+            )
+            total_wire += wire
+            rebuilt = res["rebuilt"].get(base)
+            err = res["errors"].get(base, "")
+            if rebuilt and not err:
+                try:
+                    ev = self.store.get_ec_volume(m["vid"])
+                    if ev is not None:
+                        for s in rebuilt:
+                            ev.mount_local_shard(s)
+                    else:
+                        self.store.mount_ec_volume(m["vid"], base)
+                except Exception as e:  # noqa: BLE001 — rebuilt but dark
+                    err = f"mount failed: {e}"[:300]
+            results.append(
+                {
+                    "volume_id": m["vid"],
+                    "rebuilt_shard_ids": rebuilt or [],
+                    "error": err,
+                    "wire_bytes": wire,
+                }
+            )
+        for vid, err in errors.items():
+            results.append(
+                {"volume_id": vid, "rebuilt_shard_ids": [], "error": err,
+                 "wire_bytes": 0}
+            )
+        if total_wire:
+            stats.EcRepairNetworkBytes.labels("slab").inc(total_wire)
+            stats.EcRebuildRemoteBytes.inc(total_wire)
+        stats.EcRebuildSeconds.observe(time.monotonic() - t0)
+        try:
+            self.heartbeat_once()  # rebuilt shards are holders NOW
+        except Exception:  # noqa: BLE001 — masters may be mid-chaos
+            pass
+        return {
+            "results": sorted(results, key=lambda r: r["volume_id"]),
+            "wire_bytes": total_wire,
+            "dispatch_groups": res["dispatch_groups"],
+        }
 
     def _plan_trace_groups(
         self,
@@ -1991,7 +2385,13 @@ class VolumeServer:
                 # whole-holder failover for all sources. The source marks
                 # the holder dead for ITSELF; genuinely-broken channels
                 # are redialed by the degraded-read path's invalidation.
-                return self._fetch_slab(addr, vid, sid, offset, size)
+                try:
+                    data = self._fetch_slab(addr, vid, sid, offset, size)
+                except Exception:
+                    self._note_peer_failure(addr)
+                    raise
+                self._note_peer_success(addr)
+                return data
 
             return fetch
 

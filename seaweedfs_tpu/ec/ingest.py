@@ -67,6 +67,10 @@ PART_SUFFIX = ".inp"
 
 _JOURNAL_VERSION = 1
 
+#: "no spread decision made yet" sentinel for IngestManager._spreads —
+#: distinct from None, which latches "factory declined/failed: stay local"
+_SPREAD_UNSET = object()
+
 
 def journal_path(base_file_name: str) -> str:
     return base_file_name + JOURNAL_EXT
@@ -238,6 +242,10 @@ class InlineStripeBuilder:
         self._ring_cache: dict = {}
         self._dat = None
         self._flusher = None  # lazy single-worker executor
+        #: optional parity-spread hook (shard_id, pos, length) — set by the
+        #: IngestManager when WEEDTPU_INLINE_EC_SPREAD is on, so a delta
+        #: patch below the shipped watermark marks the target range dirty
+        self.on_parity_patch = None
         #: rows already handed to the flusher — the threshold check must
         #: not re-submit a job per poll while one is still fsyncing (each
         #: stale job would re-fsync all 14 partials before noticing)
@@ -600,6 +608,13 @@ class InlineStripeBuilder:
             h.write(b)
             h.flush()
             os.fsync(h.fileno())
+        if self.on_parity_patch is not None:
+            for s, b in writes.items():
+                if s >= DATA_SHARDS_COUNT:
+                    try:
+                        self.on_parity_patch(s, pos, len(b))
+                    except Exception:  # noqa: BLE001 — spread is best-effort
+                        pass
 
     # -- seal / abort ---------------------------------------------------------
 
@@ -922,8 +937,15 @@ class IngestManager:
         buffer_size: int = EC_BUFFER_SIZE,
         max_batch_bytes: int = 64 * 1024 * 1024,
         seal_trigger: Optional[Callable[[int], None]] = None,
+        spread_factory: Optional[Callable] = None,
     ):
         self.store = store
+        #: WEEDTPU_INLINE_EC_SPREAD: `spread_factory(vid, base) ->
+        #: SpreadSession | None` supplied by the volume server; sessions
+        #: tee each parity shard's encoded rows to its eventual holder so
+        #: seal cut-over only ships the tail
+        self._spread_factory = spread_factory
+        self._spreads: dict[int, object] = {}
         self.seal_bytes = (
             config.env("WEEDTPU_INLINE_EC_SEAL_BYTES")
             if seal_bytes is None
@@ -1052,6 +1074,39 @@ class IngestManager:
                 b.poll()
             except Exception:  # noqa: BLE001 — builder marked broken
                 continue
+            self._spread_poll(vid, b)
+
+    def _spread_poll(self, vid: int, b: InlineStripeBuilder) -> None:
+        """Tee newly-encoded parity rows to the volume's spread session
+        (created lazily from the factory; a failed creation latches off
+        for this volume — spreading must never become a retry storm on
+        the encoder worker)."""
+        if self._spread_factory is None or b.broken:
+            return
+        with self._lock:
+            session = self._spreads.get(vid, _SPREAD_UNSET)
+        if session is _SPREAD_UNSET:
+            try:
+                session = self._spread_factory(vid, b.base)
+            except Exception:  # noqa: BLE001 — no plan, no spread
+                session = None
+            with self._lock:
+                self._spreads[vid] = session
+            if session is not None:
+                b.on_parity_patch = session.note_patch
+        if session is None:
+            return
+        try:
+            session.poll(b.rows_done)
+        except Exception:  # noqa: BLE001 — session marks itself broken
+            pass
+
+    def take_spread(self, vid: int):
+        """Hand the volume's spread session to the seal path (and stop
+        polling it). None when spreading never started for this volume."""
+        with self._lock:
+            session = self._spreads.pop(vid, None)
+        return None if session is _SPREAD_UNSET else session
 
     def close(self) -> None:
         """Stop the encoder worker (server shutdown). Builders keep their
@@ -1168,6 +1223,12 @@ class IngestManager:
         with self._lock:
             b = self._builders.pop(vid, None)
             self._sealing.discard(vid)
+            session = self._spreads.pop(vid, None)
+        if session is not None and session is not _SPREAD_UNSET:
+            try:
+                session.abort()  # scrub the remote partials too
+            except Exception:  # noqa: BLE001 — dead peers keep only .inp litter
+                pass
         if b is not None:
             b.abort()
         if base is None and b is not None:

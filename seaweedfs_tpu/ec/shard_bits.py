@@ -49,14 +49,31 @@ class EcVolumeInfo:
     volume_id: int
     collection: str = ""
     shard_bits: ShardBits = field(default_factory=lambda: ShardBits(0))
+    # code geometry + per-shard byte size, heartbeat-propagated so the
+    # master's repair scheduler can compute missing counts against the
+    # volume's real (k, k+m) and rank stripes by bytes at risk. 0 = an
+    # old reporter: consumers fall back to the legacy 10+4 defaults.
+    shard_size: int = 0
+    data_shards: int = 0
+    total_shards: int = 0
 
     def to_dict(self) -> dict:
         return {
             "volume_id": self.volume_id,
             "collection": self.collection,
             "shard_bits": int(self.shard_bits),
+            "shard_size": int(self.shard_size),
+            "data_shards": int(self.data_shards),
+            "total_shards": int(self.total_shards),
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "EcVolumeInfo":
-        return cls(d["volume_id"], d.get("collection", ""), ShardBits(d.get("shard_bits", 0)))
+        return cls(
+            d["volume_id"],
+            d.get("collection", ""),
+            ShardBits(d.get("shard_bits", 0)),
+            shard_size=int(d.get("shard_size", 0) or 0),
+            data_shards=int(d.get("data_shards", 0) or 0),
+            total_shards=int(d.get("total_shards", 0) or 0),
+        )

@@ -1179,6 +1179,184 @@ def rebuild_ec_files_from_sources(
     return missing
 
 
+def rebuild_ec_files_batch(
+    jobs: list[dict],
+    encoder: Optional[Encoder] = None,
+    buffer_size: int = 4 * 1024 * 1024,
+    max_batch_bytes: int = 64 * 1024 * 1024,
+    pipeline_depth: Optional[int] = None,
+    prefetch_batches: Optional[int] = None,
+) -> dict:
+    """MANY volumes' rebuilds through SHARED device dispatches — the
+    fleet-repair batch engine (and the PR 9 residual: dp used to shard
+    one volume's staging width, so a storm of small volumes paid a
+    partial-width dispatch each).
+
+    Each job is {"base", "sources" ({shard id -> SlabSource}),
+    "shard_size", "missing" (optional)}. Jobs whose (survivor set,
+    missing set, geometry) SIGNATURE matches share one fused decode
+    matrix and one staging-ring pipeline in which batches are
+    WIDTH-PACKED across volume boundaries: a batch window fills with
+    volume A's tail and volume B's head side by side (the GF matmul is
+    column-independent, so which volume a column came from is purely a
+    scatter concern at drain time). Small stripes therefore ride full-
+    width dispatches instead of one shallow dispatch per volume.
+
+    Per-group failure semantics: any failure unlinks EVERY group
+    member's partial outputs and records the error per job; other
+    signature groups still run. Returns
+      {"rebuilt": {base: [shard ids]}, "errors": {base: str},
+       "dispatch_groups": int}."""
+    enc_default = encoder
+    groups: dict[tuple, list[dict]] = {}
+    out: dict = {"rebuilt": {}, "errors": {}, "dispatch_groups": 0}
+    for job in jobs:
+        enc = job.get("encoder") or enc_default or encoder_for_base(job["base"])
+        present = sorted(job["sources"])
+        missing = job.get("missing")
+        if missing is None:  # an explicit [] means "nothing to rebuild",
+            # NOT "compute it" — a healed volume must come back rebuilt=[]
+            missing = [s for s in range(enc.total_shards) if s not in job["sources"]]
+        missing = sorted(missing)
+        if not missing:
+            out["rebuilt"][job["base"]] = []
+            continue
+        if len(present) < enc.data_shards:
+            out["errors"][job["base"]] = (
+                f"only {len(present)} shards present, need {enc.data_shards}"
+            )
+            continue
+        survivors = tuple(present[: enc.data_shards])
+        sig = (
+            survivors,
+            tuple(missing),
+            enc.data_shards,
+            enc.total_shards,
+            getattr(enc, "matrix_kind", ""),
+        )
+        groups.setdefault(sig, []).append(
+            {**job, "encoder": enc, "missing": missing, "survivors": survivors}
+        )
+    depth = DEFAULT_PIPELINE_DEPTH if pipeline_depth is None else max(1, int(pipeline_depth))
+    ahead = (
+        DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
+    )
+    for sig, members in groups.items():
+        out["dispatch_groups"] += 1
+        try:
+            _rebuild_group(members, depth, ahead, buffer_size, max_batch_bytes)
+            for job in members:
+                out["rebuilt"][job["base"]] = list(job["missing"])
+        except BaseException as e:
+            for job in members:
+                for s in job["missing"]:
+                    try:
+                        os.unlink(shard_file_name(job["base"], s))
+                    except OSError:
+                        pass
+                out["errors"][job["base"]] = f"{type(e).__name__}: {e}"[:300]
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit: partials are cleaned, but
+                # the interrupt must propagate, not be absorbed into a
+                # per-volume error string while later groups keep running
+                raise
+    return out
+
+
+def _rebuild_group(
+    members: list[dict], depth: int, ahead: int, buffer_size: int,
+    max_batch_bytes: int,
+) -> None:
+    """One same-signature group: a single depth-N pipeline whose batches
+    pack columns from consecutive volumes (see rebuild_ec_files_batch)."""
+    enc = members[0]["encoder"]
+    survivors = list(members[0]["survivors"])
+    missing = list(members[0]["missing"])
+    align = int(getattr(enc, "width_align", 1) or 1)
+    chunks_per_batch = max(1, max_batch_bytes // (enc.data_shards * buffer_size))
+    span = _aligned(chunks_per_batch * buffer_size, align)
+    ring = _StagingRing(depth + 1, (enc.data_shards, span))
+    crcs = [{s: 0 for s in missing} for _ in members]
+    # batches of width-packed segments: [(job index, offset, length), ...]
+    batches: list[list[tuple[int, int, int]]] = []
+    cur: list[tuple[int, int, int]] = []
+    room = span
+    for ji, job in enumerate(members):
+        off = 0
+        size = int(job["shard_size"])
+        while off < size:
+            take = min(room, size - off)
+            cur.append((ji, off, take))
+            off += take
+            room -= take
+            if room == 0:
+                batches.append(cur)
+                cur, room = [], span
+    if cur:
+        batches.append(cur)
+    with ExitStack() as stack:
+        outs = [
+            {
+                s: stack.enter_context(
+                    open(shard_file_name(job["base"], s), "wb")
+                )
+                for s in missing
+            }
+            for job in members
+        ]
+        inflight: deque = deque()  # FIFO of (handle, segments, valid)
+
+        def drain_one() -> None:
+            lazy, segs, valid = inflight.popleft()
+            with trace_mod.span("rebuild.drain", width=valid):
+                dec = np.asarray(lazy)  # (len(missing), width) — sync point
+                col = 0
+                for ji, off, length in segs:
+                    for k, s in enumerate(missing):
+                        row = dec[k, col : col + length]
+                        outs[ji][s].write(row)
+                        crcs[ji][s] = zlib.crc32(row, crcs[ji][s])
+                    col += length
+
+        def issue_prefetch(bi: int) -> None:
+            if bi < len(batches):
+                for ji, off, length in batches[bi]:
+                    src = members[ji]["sources"]
+                    for s in survivors:
+                        src[s].prefetch(off, length)
+
+        try:
+            for j in range(min(ahead, len(batches))):
+                issue_prefetch(j)
+            for bi, segs in enumerate(batches):
+                issue_prefetch(bi + ahead)
+                while len(inflight) >= depth:
+                    drain_one()
+                width = sum(length for _, _, length in segs)
+                with trace_mod.span("rebuild.stage", batch=bi, width=width):
+                    staging = ring.take()
+                    col = 0
+                    for ji, off, length in segs:
+                        src = members[ji]["sources"]
+                        for i, s in enumerate(survivors):
+                            src[s].read_into(off, staging[i, col : col + length])
+                        col += length
+                    aw = _aligned(width, align)
+                    if aw > width:
+                        staging[:, width:aw] = 0  # pad columns are zeros
+                decoded = enc.reconstruct_lazy(
+                    staging[:, :aw], survivors, missing, donate=True
+                )
+                inflight.append((decoded, segs, width))
+            while inflight:
+                drain_one()
+        except BaseException:
+            _discard_inflight(inflight)
+            raise
+    for ji, job in enumerate(members):
+        _verify_rebuilt_crcs(job["base"], crcs[ji])
+
+
 def rebuild_ec_files(
     base_file_name: str,
     encoder: Optional[Encoder] = None,

@@ -71,6 +71,7 @@ SPAN_NAMES: dict[str, str] = {
     "encode.drain": "device sync + shard write-out for one encode batch",
     "ingest.encode": "inline-EC encode of newly-final large rows (one poll)",
     "ingest.seal": "inline-EC seal finalization of one volume",
+    "ingest.spread.commit": "seal-time commit of one pre-spread parity shard",
     "scrub.cycle": "one full background integrity pass over mounted shards",
     "scrub.repair": "one automatic repair attempt of a quarantined shard",
     "convert.run": "one whole-volume geometry conversion",

@@ -114,6 +114,11 @@ class Heartbeat:
     max_volume_count: int = 8
     volumes: list[dict] = field(default_factory=list)  # VolumeInformation dicts
     ec_shards: list[dict] = field(default_factory=list)  # EcVolumeInfo dicts
+    # peers (grpc host:port) this server repeatedly failed to reach on
+    # the degraded-read/rebuild paths — the master's repair scheduler
+    # cross-checks them against heartbeat silence to learn about dead
+    # holders without waiting for the topology reaper
+    unreachable_peers: list[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -130,6 +135,7 @@ class Heartbeat:
             max_volume_count=int(d.get("max_volume_count", 8)),
             volumes=list(d.get("volumes", [])),
             ec_shards=list(d.get("ec_shards", [])),
+            unreachable_peers=list(d.get("unreachable_peers", [])),
         )
 
     @property

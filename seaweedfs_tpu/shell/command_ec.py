@@ -82,28 +82,29 @@ def _volume_locations(nodes: list[dict], vid: int) -> list[dict]:
     return [n for n in nodes if any(int(v["id"]) == vid for v in n.get("volumes", []))]
 
 
-def allocate_shards(nodes: list[dict], total: int = TOTAL_SHARDS_COUNT) -> dict[str, list[int]]:
-    """Greedy balanced+rack-aware spread of `total` shard ids over nodes
-    (command_ec_common.go balancedEcDistribution analog): each shard goes
-    to the node with the fewest (assigned + existing) shards, tie-broken
-    toward racks with fewer shards of this volume."""
+def allocate_shards(
+    nodes: list[dict],
+    total: int = TOTAL_SHARDS_COUNT,
+    data_shards: int = DATA_SHARDS_COUNT,
+) -> dict[str, list[int]]:
+    """Balanced, FAILURE-DOMAIN-CAPPED spread of `total` shard ids over
+    nodes — the shared `ec/placement.py` planner: each shard goes to the
+    least-loaded node whose rack still has headroom under the
+    no-domain-holds-more-than-m cap (the invariant that makes a whole-
+    rack loss survivable by construction); on topologies with too few
+    racks the cap relaxes minimally instead of failing."""
     if not nodes:
         raise ShellError("no volume servers available")
-    assigned: dict[str, list[int]] = {n["url"]: [] for n in nodes}
-    base_load = {n["url"]: _node_ec_load(n) for n in nodes}
-    rack_count: dict[str, int] = {}
-    for sid in range(total):
-        best = min(
-            nodes,
-            key=lambda n: (
-                len(assigned[n["url"]]) + base_load[n["url"]],
-                rack_count.get(n["rack"], 0),
-                n["url"],
-            ),
-        )
-        assigned[best["url"]].append(sid)
-        rack_count[best["rack"]] = rack_count.get(best["rack"], 0) + 1
-    return {u: s for u, s in assigned.items() if s}
+    from seaweedfs_tpu.ec import placement
+    from seaweedfs_tpu.utils import config as _config
+
+    return placement.plan_spread(
+        nodes,
+        total,
+        max(1, total - data_shards),
+        cap_override=int(_config.env("WEEDTPU_PLACEMENT_MAX_PER_DOMAIN")),
+        load_of=_node_ec_load,
+    )
 
 
 def _parallel(work: list) -> None:
@@ -862,8 +863,106 @@ def pick_balance_move(
     return vid, sid
 
 
+def _move_shard(
+    env: CommandEnv, src: dict, dst: dict, vid: int, collection: str,
+    sid: int, dst_has_vid: bool,
+) -> None:
+    """One shard migration dst <- src via the copy/mount/delete RPC
+    discipline (PR 12's shard-copy machinery)."""
+    env.vs_call(
+        grpc_addr(dst),
+        "VolumeEcShardsCopy",
+        {
+            "volume_id": vid,
+            "collection": collection,
+            "shard_ids": [sid],
+            "source_data_node": grpc_addr(src),
+            "copy_ecx_file": not dst_has_vid,
+        },
+    )
+    env.vs_call(
+        grpc_addr(dst),
+        "VolumeEcShardsMount",
+        {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+    )
+    env.vs_call(
+        grpc_addr(src),
+        "VolumeEcShardsDelete",
+        {"volume_id": vid, "collection": collection, "shard_ids": [sid]},
+    )
+
+
+def fix_placement_moves(
+    placement_map: dict[str, dict[int, set]],
+    by_url: dict[str, dict],
+    parity_of,
+    cap_override: int = 0,
+    only_vids=None,
+):
+    """Plan the migrations that restore the failure-domain invariant:
+    for every (stripe, domain) holding more than m shards, move the
+    excess (highest shard ids first) to nodes in domains with headroom,
+    least-loaded first. Pure: yields (vid, sid, src_url, dst_url); the
+    caller executes every planned move — `placement_map` is mutated AS
+    the plan is built, so a caller-side skip would desynchronize the
+    map from the cluster (filter with `only_vids` instead)."""
+    from seaweedfs_tpu.ec import placement as pl
+
+    moves: list[tuple[int, int, str, str]] = []
+    domains = {u: pl.domain_of(n) for u, n in by_url.items()}
+    vids = sorted({vid for per in placement_map.values() for vid in per})
+    if only_vids is not None:
+        vids = [v for v in vids if v in set(only_vids)]
+    for vid in vids:
+        parity = parity_of(vid)
+        cap = pl.max_per_domain(parity, cap_override)
+        holders = {}
+        for u, per in placement_map.items():
+            for s in per.get(vid, ()):
+                holders.setdefault(s, []).append(u)
+        for dom, sids in pl.stripe_violations(
+            holders, domains, parity, cap_override
+        ):
+            excess = sids[cap:]
+            for sid in excess:
+                src_url = next(
+                    u for u in holders.get(sid, []) if domains[u] == dom
+                )
+
+                def dom_count(d: tuple) -> int:
+                    return len(
+                        {
+                            s
+                            for u, per in placement_map.items()
+                            if domains[u] == d
+                            for s in per.get(vid, ())
+                        }
+                    )
+
+                candidates = [
+                    u
+                    for u in placement_map
+                    if domains[u] != dom
+                    and sid not in placement_map[u].get(vid, ())
+                    and dom_count(domains[u]) < cap
+                ]
+                if not candidates:
+                    continue  # nowhere legal: reported, not worsened
+                dst_url = min(
+                    candidates,
+                    key=lambda u: (
+                        sum(len(s) for s in placement_map[u].values()),
+                        u,
+                    ),
+                )
+                moves.append((vid, sid, src_url, dst_url))
+                placement_map[src_url][vid].discard(sid)
+                placement_map[dst_url].setdefault(vid, set()).add(sid)
+    return moves
+
+
 def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    fl = parse_flags(args, collection="")
+    fl = parse_flags(args, collection="", fixPlacement=False)
     env.confirm_locked()
     nodes = env.topology_nodes()
     colls = _ec_collections(env)
@@ -883,6 +982,53 @@ def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
         return sum(len(s) for s in placement[url].values())
 
     moves = 0
+    if fl.fixPlacement:
+        # restore the failure-domain invariant FIRST (a rack holding >m
+        # shards of one stripe): correctness moves beat load moves
+        def parity_of(vid: int) -> int:
+            holders = [
+                u for u, per in placement.items() if per.get(vid)
+            ]
+            for u in holders:
+                try:
+                    st = env.vs_call(
+                        grpc_addr(by_url[u]), "VolumeStatus",
+                        {"volume_id": vid}, timeout=10,
+                    )
+                    total = int(st.get("total_shards") or 0)
+                    data = int(st.get("data_shards") or 0)
+                    if total and data:
+                        return max(1, total - data)
+                except Exception:  # noqa: BLE001 — next holder
+                    continue
+            return TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT
+        from seaweedfs_tpu.utils import config as _config
+
+        planned = fix_placement_moves(
+            placement, by_url, parity_of,
+            cap_override=int(_config.env("WEEDTPU_PLACEMENT_MAX_PER_DOMAIN")),
+            # filter BEFORE planning: the planner mutates `placement` as
+            # it plans, so every planned move must actually execute
+            only_vids=(
+                [v for v in colls if colls.get(v, "") == fl.collection]
+                if fl.collection
+                else None
+            ),
+        )
+        for vid, sid, src_url, dst_url in planned:
+            _move_shard(
+                env, by_url[src_url], by_url[dst_url], vid,
+                colls.get(vid, ""), sid,
+                # placement was already mutated by the planner: "had the
+                # volume before this move" = any shard besides sid
+                bool(placement[dst_url].get(vid, set()) - {sid}),
+            )
+            moves += 1
+        if planned:
+            w.write(
+                f"ec.balance: fixed placement with {len(planned)} "
+                "domain-cap move(s)\n"
+            )
     while True:
         urls = sorted(placement, key=load)
         lightest, heaviest = urls[0], urls[-1]
@@ -894,6 +1040,31 @@ def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
         if picked is None:
             break
         vid, sid = picked
+        if fl.fixPlacement:
+            # the load loop must not re-break the invariant the fix
+            # phase just restored: refuse a move that would push the
+            # destination's rack past the domain cap (stop balancing —
+            # pick would re-propose the same move forever)
+            from seaweedfs_tpu.ec import placement as _pl
+
+            domains = {u: _pl.domain_of(n) for u, n in by_url.items()}
+            holders: dict[int, list[str]] = {}
+            for u, per in placement.items():
+                for s in per.get(vid, ()):
+                    holders.setdefault(s, []).append(u)
+            # model the move: sid leaves heaviest, lands on lightest
+            holders[sid] = [
+                u for u in holders.get(sid, []) if u != heaviest
+            ] + [lightest]
+            if _pl.stripe_violations(
+                holders, domains, parity_of(vid),
+                int(_config.env("WEEDTPU_PLACEMENT_MAX_PER_DOMAIN")),
+            ):
+                w.write(
+                    "ec.balance: stopping — the next load move would "
+                    "violate the domain cap\n"
+                )
+                break
         collection = colls.get(vid, "")
         src, dst = by_url[heaviest], by_url[lightest]
         env.vs_call(
@@ -928,8 +1099,11 @@ def do_ec_balance(args: list[str], env: CommandEnv, w: TextIO) -> None:
 register(
     ShellCommand(
         "ec.balance",
-        "ec.balance [-collection <name>]\n\teven out EC shard counts across "
-        "volume servers",
+        "ec.balance [-collection <name>] [-fixPlacement]\n\teven out EC "
+        "shard counts across volume servers; -fixPlacement first migrates "
+        "shards\n\tout of failure domains holding more than m shards of a "
+        "stripe (the\n\tno-rack-holds->m invariant), via the copy/mount/"
+        "delete shard machinery",
         do_ec_balance,
     )
 )
@@ -1053,17 +1227,54 @@ def _metric_sum(rows, name: str, **match) -> float:
     )
 
 
+def _fleet_risk_lines(env: CommandEnv) -> list[str]:
+    """The fleet-risk section of ec.status: the master scheduler's
+    redundancy histogram (stripes by shards lost — the "am I about to
+    lose data" view), failure-domain violations, and repair queue depth
+    / inflight / recent events."""
+    try:
+        st = env.master_call("RepairStatus", {})
+    except Exception as e:  # noqa: BLE001 — old master: no fleet section
+        return [f"fleet: unavailable ({e})"]
+    hist = st.get("redundancy_histogram") or {}
+    hist_s = " ".join(
+        f"{k}-lost={hist[k]}" for k in sorted(hist, key=lambda x: int(x))
+    ) or "-"
+    lines = [
+        "fleet: scheduler="
+        + ("on" if st.get("enabled") else "off (WEEDTPU_REPAIR=off)")
+        + f" queue={st.get('queue_depth', 0)} inflight={st.get('inflight', 0)}"
+        + f" stripes[{hist_s}]"
+    ]
+    suspects = st.get("suspects") or []
+    if suspects:
+        lines.append(f"fleet: suspects={' '.join(suspects)}")
+    for v in st.get("violations") or []:
+        lines.append(f"fleet: VIOLATION {v}")
+    events = st.get("events") or []
+    for e in events[-5:]:
+        lines.append(
+            f"fleet: [{e['seq']}] vid={e['volume_id']} "
+            f"missing={e['missing']} {e['state']}"
+            + (f" -> {e['target']}" if e.get("target") else "")
+            + (f" ({e['detail']})" if e.get("detail") else "")
+        )
+    return lines
+
+
 def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
-    """One-screen cluster health summary: per-server quarantined shards
-    (with reasons, from VolumeStatus), scrub progress, rebuild/convert
-    inflight (live weedtpu_rpc_inflight gauges), and the codec backend
-    each server selected — the four surfaces that previously required
-    reading VolumeStatus, /metrics, ec.verify output, and ec.backend
-    separately. Read-only; no cluster lock."""
+    """One-screen cluster health summary: the master's fleet-risk view
+    (redundancy histogram, placement violations, repair queue) plus
+    per-server quarantined shards (with reasons, from VolumeStatus),
+    scrub progress, rebuild/convert inflight (live weedtpu_rpc_inflight
+    gauges), and the codec backend each server selected. Read-only; no
+    cluster lock."""
     parse_flags(args)
     nodes = env.topology_nodes()
     if not nodes:
         raise ShellError("no volume servers")
+    for line in _fleet_risk_lines(env):
+        w.write(line + "\n")
     for n in sorted(nodes, key=lambda n: n["url"]):
         url = n["url"]
         ec_vids = sorted(
@@ -1118,10 +1329,11 @@ def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
 register(
     ShellCommand(
         "ec.status",
-        "ec.status\n\tone-screen cluster health: per-server quarantined "
-        "shards (+reasons),\n\tscrub progress, live rebuild/convert "
-        "inflight, repair outcomes, and the\n\tselected codec backend — "
-        "VolumeStatus + /metrics folded into one view",
+        "ec.status\n\tone-screen cluster health: the master's fleet-risk "
+        "view (stripes by\n\tremaining redundancy, failure-domain "
+        "violations, repair queue/events),\n\tplus per-server quarantined "
+        "shards (+reasons), scrub progress, live\n\trebuild/convert "
+        "inflight, repair outcomes, and the selected codec backend",
         do_ec_status,
     )
 )

@@ -352,6 +352,19 @@ InlineEcSeals = REGISTRY.counter(
     "restart then finalized, warm = full .dat re-encode fallback",
     ("mode",),
 )
+InlineEcSpreadBytes = REGISTRY.counter(
+    "weedtpu_inline_ec_spread_bytes_total",
+    "parity bytes streamed to their placement-planned eventual holders "
+    "DURING inline encode (WEEDTPU_INLINE_EC_SPREAD) — seal cut-over "
+    "then ships only the tail",
+)
+InlineEcSpreadCommits = REGISTRY.counter(
+    "weedtpu_inline_ec_spread_commits_total",
+    "seal-time spread commits by result (ok = the target CRC-verified, "
+    "mounted, and now hosts the parity shard; failed = the shard stayed "
+    "local — spreading is an optimization, never an availability trade)",
+    ("result",),
+)
 EcConvertBytes = REGISTRY.counter(
     "weedtpu_ec_convert_bytes_total",
     "bytes the geometry converter moved, by direction: read = source "
@@ -381,6 +394,35 @@ EcBackendSelected = REGISTRY.gauge(
     "codec backend chosen by new_encoder (1 = currently selected; source "
     "says why: on-chip-evidence, platform, env:WEEDTPU_BACKEND, explicit)",
     ("backend", "source"),
+)
+RepairQueueDepth = REGISTRY.gauge(
+    "weedtpu_repair_queue_depth",
+    "under-replicated stripes currently queued by the master's fleet "
+    "repair scheduler (ranked 2-missing strictly before 1-missing)",
+)
+RepairInflight = REGISTRY.gauge(
+    "weedtpu_repair_inflight",
+    "stripes whose batched rebuild dispatch is currently running, "
+    "bounded by WEEDTPU_REPAIR_MAX_INFLIGHT",
+)
+RepairDispatch = REGISTRY.counter(
+    "weedtpu_repair_dispatch_total",
+    "stripe repairs the fleet scheduler dispatched, by missing-shard "
+    "count at dispatch time (the priority class: '2' rows must start "
+    "before '1' rows during a storm)",
+    ("missing",),
+)
+RepairBackoff = REGISTRY.counter(
+    "weedtpu_repair_backoff_total",
+    "repair dispatches deferred by exponential backoff after a 503/"
+    "RESOURCE_EXHAUSTED (the rebuild admission lane pushing back) or a "
+    "transport failure",
+)
+PlacementViolations = REGISTRY.gauge(
+    "weedtpu_placement_violations",
+    "stripes x domains currently violating the failure-domain invariant "
+    "(a rack holding more than m shards of one stripe), from the repair "
+    "scheduler's last status audit",
 )
 RpcServerSeconds = REGISTRY.histogram(
     "weedtpu_rpc_server_seconds",

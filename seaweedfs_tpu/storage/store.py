@@ -354,6 +354,9 @@ class Store:
                         volume_id=vid,
                         collection=parsed[0] if parsed else "",
                         shard_bits=ShardBits.from_ids(ev.shard_ids),
+                        shard_size=int(ev.shard_size or 0),
+                        data_shards=int(ev.data_shards),
+                        total_shards=int(ev.total_shards),
                     )
                 )
         return out

@@ -504,6 +504,85 @@ register_env(
     "tests/replays); 0 = OS entropy.",
 )
 register_env(
+    "WEEDTPU_REPAIR", str, "off",
+    "Master-side fleet repair scheduler: `on` enumerates every stripe "
+    "left under-replicated by a dead/quarantined holder, ranks by "
+    "remaining redundancy (2-missing strictly before 1-missing, ties by "
+    "stripe bytes then single-domain exposure), and drives batched "
+    "remote rebuilds through the rebuild admission lane; `off` (default) "
+    "leaves mass repair to the operator's ec.rebuild.",
+    parse=_enum("on", "off"),
+)
+register_env(
+    "WEEDTPU_REPAIR_MAX_INFLIGHT", int, 2,
+    "Cluster-wide cap on concurrently-running batched rebuild dispatches "
+    "from the fleet repair scheduler (clamped to >= 1) — the scheduler's "
+    "own pacing budget on top of each holder's "
+    "WEEDTPU_REBUILD_MAX_INFLIGHT admission gate.",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_REPAIR_BATCH", int, 8,
+    "How many same-priority-class stripes one repair dispatch may carry "
+    "to a single rebuild target (clamped to >= 1). The target fuses "
+    "equal missing-signature volumes into shared decode dispatches, so "
+    "bigger batches amortize device/staging setup across volumes.",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_REPAIR_SCAN_S", float, 30.0,
+    "Seconds between full under-replication scans of the master's EC "
+    "registry. Death signals (reaped nodes, shrinking heartbeats, "
+    "confirmed peer-unreachable reports) trigger an immediate scan on "
+    "top of this cadence.",
+)
+register_env(
+    "WEEDTPU_REPAIR_SETTLE_S", float, 2.0,
+    "Correlation window the repair scheduler waits after a death signal "
+    "before dispatching: a rack's nodes die together but their heartbeat "
+    "silences stagger, and ranking before the dust settles would start "
+    "1-missing repairs that should have been 2-missing.",
+)
+register_env(
+    "WEEDTPU_REPAIR_DEAD_S", float, 15.0,
+    "Heartbeat-silence age after which a holder that peers ALSO report "
+    "unreachable is treated as dead for repair purposes (unreported "
+    "holders die at 4x this, bounded below by 60 s, so a long GC pause "
+    "alone never triggers a mass rebuild).",
+)
+register_env(
+    "WEEDTPU_REPAIR_BACKOFF", float, 2.0,
+    "Base seconds of the per-stripe exponential backoff after a repair "
+    "dispatch is refused (503/RESOURCE_EXHAUSTED from the admission "
+    "lane) or fails in transport; doubles per failure, capped at 12x.",
+)
+register_env(
+    "WEEDTPU_REPAIR_REPORT_FAILURES", int, 3,
+    "Consecutive unreachable-peer failures on the degraded-read/rebuild "
+    "paths before a volume server names that peer in its heartbeat's "
+    "unreachable_peers report (clamped to >= 1; any success resets the "
+    "count).",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_PLACEMENT_MAX_PER_DOMAIN", int, 0,
+    "Operator override of the failure-domain placement cap (shards of "
+    "one stripe a single rack may hold). 0 (default) = the volume's "
+    "parity count m, the largest cap that still survives a whole-domain "
+    "loss.",
+    parse=_clamped_int(0),
+)
+register_env(
+    "WEEDTPU_INLINE_EC_SPREAD", str, "off",
+    "Inline-ingest parity spreading: `on` streams each parity shard's "
+    "encoded rows to its placement-planned eventual holder WHILE the "
+    "volume is still ingesting, so seal cut-over ships only the small "
+    "tail and the owner never hosts all k+m shards; any spread failure "
+    "falls back to sealing that shard locally. Requires "
+    "WEEDTPU_INLINE_EC=on.",
+    parse=_enum("on", "off"),
+)
+register_env(
     "WEEDTPU_LOOKUP_RETRIES", int, 2,
     "Bounded retries (with decorrelated jitter) of the single-flight "
     "master shard-location lookup leader before it fails its waiters — "
