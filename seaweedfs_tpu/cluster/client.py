@@ -11,6 +11,7 @@ and submit (assign+upload in one call).
 from __future__ import annotations
 
 import http.client
+import socket
 import time
 import threading
 import urllib.error
@@ -79,6 +80,53 @@ class MasterClient:
         self._current = self.addresses[0]
         self._lock = threading.Lock()
         self._vid_cache: dict[int, tuple[float, list[Location]]] = {}
+        # per-thread keep-alive connections to volume servers: read_ex
+        # reuses them instead of a fresh TCP connect per request (the
+        # volume server speaks HTTP/1.1, and thread-per-connection on
+        # its side makes connection churn the dominant per-read cost at
+        # kilo-rps). Plain-HTTP only; TLS clusters take the urllib path.
+        self._tl = threading.local()
+        # location suspicion (client half of the planner's holder
+        # suspicion ladder): a replica that just failed over is tried
+        # LAST for the next few seconds, so a wedged server costs the
+        # first few requests their timeout instead of every request —
+        # at kilo-rps an unsuspecting client burns timeout x rate worth
+        # of in-flight capacity on a single SIGSTOP'd node
+        self._suspect: dict[str, float] = {}
+
+    def _ordered(self, locations: list[Location]) -> list[Location]:
+        """Locations with currently-suspect replicas moved to the back
+        (still tried — suspicion reorders, it never excludes)."""
+        now = time.monotonic()
+        fresh = [l for l in locations if self._suspect.get(l.url, 0.0) <= now]
+        if len(fresh) == len(locations):
+            return locations
+        return fresh + [l for l in locations if l not in fresh]
+
+    def _mark_suspect(self, netloc: str, for_s: float = 3.0) -> None:
+        self._suspect[netloc] = time.monotonic() + for_s
+
+    def _pooled_conn(self, netloc: str) -> http.client.HTTPConnection:
+        conns = getattr(self._tl, "conns", None)
+        if conns is None:
+            conns = self._tl.conns = {}
+        c = conns.get(netloc)
+        if c is None:
+            c = http.client.HTTPConnection(netloc, timeout=self.http_timeout)
+            # Connect eagerly so we can disable Nagle: a reused keep-alive
+            # socket otherwise serializes each small request behind the
+            # server's ~40 ms delayed ACK.
+            c.connect()
+            c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conns[netloc] = c
+        return c
+
+    def _drop_conn(self, netloc: str) -> None:
+        conns = getattr(self._tl, "conns", None)
+        if conns is not None:
+            c = conns.pop(netloc, None)
+            if c is not None:
+                c.close()
 
     def _client_for(self, address: str) -> rpc.RpcClient:
         with self._lock:
@@ -151,6 +199,13 @@ class MasterClient:
             for c in self._clients.values():
                 c.close()
             self._clients.clear()
+        # only the calling thread's pooled sockets are reachable here;
+        # other threads' daemon sockets close with the process
+        conns = getattr(self._tl, "conns", None)
+        if conns is not None:
+            for c in conns.values():
+                c.close()
+            conns.clear()
 
     def __enter__(self):
         return self
@@ -243,8 +298,17 @@ class MasterClient:
         raise ClusterError(f"upload of {fid} failed: {last_err}")
 
     def read(self, fid: str) -> bytes:
+        return self.read_ex(fid)[0]
+
+    def read_ex(self, fid: str) -> tuple[bytes, Optional[str]]:
+        """Like read(), but also surfaces the serving class the volume
+        server resolved the read to (X-Weedtpu-Read-Class: healthy /
+        ec_intact / cached / degraded), or None when the server predates
+        the header. Load harnesses use it to bucket per-request latency
+        by what actually happened instead of guessing from topology."""
         vid = int(fid.split(",", 1)[0])
         last_err = None
+        pooled = tls.scheme() == "http"
         # second pass refreshes the vid cache: the volume may have moved
         # (ec.encode cut-over, balance) since it was cached
         for attempt in range(2):
@@ -256,17 +320,43 @@ class MasterClient:
                 headers["Authorization"] = "Bearer " + mint_file_token(
                     self.read_signing_key, fid
                 )
-            for loc in locations:
+            for loc in self._ordered(locations):
+                if pooled:
+                    # a kept-alive connection the server closed between
+                    # requests surfaces as an error on the FIRST op: retry
+                    # that once with a fresh connection before failing over
+                    for _fresh in (False, True):
+                        try:
+                            c = self._pooled_conn(loc.url)
+                            c.request("GET", "/" + fid, headers=headers)
+                            r = c.getresponse()
+                            body = r.read()
+                        except _FAILOVER_ERRORS as e:
+                            self._drop_conn(loc.url)
+                            last_err = e
+                            continue
+                        if r.status == 200:
+                            self._suspect.pop(loc.url, None)
+                            return body, r.getheader(trace_mod.READ_CLASS_HEADER)
+                        # 404 on one replica can be staleness (e.g. it was
+                        # down during the write) — try the other replicas,
+                        # but an answering server is not suspect
+                        last_err = f"HTTP {r.status}"
+                        break
+                    else:
+                        self._mark_suspect(loc.url)
+                    continue
                 try:
                     req = urllib.request.Request(f"{tls.scheme()}://{loc.url}/{fid}", headers=headers)
                     with tls.urlopen(req, timeout=self.http_timeout) as r:
-                        return r.read()
+                        body = r.read()
+                        self._suspect.pop(loc.url, None)
+                        return body, r.headers.get(trace_mod.READ_CLASS_HEADER)
                 except urllib.error.HTTPError as e:
-                    # 404 on one replica can be staleness (e.g. it was down
-                    # during the write) — keep trying the others before failing
                     last_err = f"HTTP {e.code}"
                 except _FAILOVER_ERRORS as e:
                     last_err = e
+                    self._mark_suspect(loc.url)
         raise ClusterError(f"read of {fid} failed on all locations: {last_err}")
 
     def delete(self, fid: str) -> bool:

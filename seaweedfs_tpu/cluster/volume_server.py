@@ -2787,6 +2787,9 @@ class _ThreadingHTTPServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
 
 class _Handler(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Keep-alive clients send one small request per round trip; with Nagle
+    # on, each response stalls ~40 ms behind the peer's delayed ACK.
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -2978,7 +2981,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._reply_json(500, {"error": str(e)}, head=head)
             return
         ctype = n.mime.decode() if n.mime else "application/octet-stream"
-        self._reply(200, n.data, ctype, head=head)
+        # the serving class the read resolved to (healthy / ec_intact /
+        # cached / degraded) rides back per-request so load harnesses can
+        # classify latencies without scraping traces
+        klass = trace_mod.current_class()
+        self._reply(
+            200, n.data, ctype, head=head,
+            headers={trace_mod.READ_CLASS_HEADER: klass} if klass else None,
+        )
 
     def do_GET(self) -> None:
         self._serve_get(head=False)

@@ -558,6 +558,12 @@ class InlineStripeBuilder:
             p += seg
         if patched:
             self.crc_valid = False
+            # an overwrite changed encoded bytes for this base: decoded
+            # intervals cached before the delta describe the OLD contents
+            # and must never serve another read (PR 16 no-stale-bytes rule)
+            from seaweedfs_tpu.ec import read_planner as read_planner_mod
+
+            read_planner_mod.CACHE.invalidate_volume(self.base)
             self.delta_stats["updates"] += 1
             self.delta_stats["changed_bytes"] += patched
             # accounting for the small-write gate: old+new data bytes in,

@@ -1,0 +1,1035 @@
+"""ReadPlanner — the per-interval decision tree behind EcVolume reads.
+
+One object owns everything the read path decides per interval: which rung
+serves it (local -> decoded-interval cache -> remote -> reconstruct), how
+remote fetches are capped and blamed (per-holder wedge caps, the suspicion
+ladder, EWMA-driven hedging), how concurrent decodes of the same interval
+coalesce into one survivor fan-out, and how quarantined shards reroute.
+Historically this logic grew interleaved through `ec_volume.py`; the
+extraction gives it a single seam so serving tiers can be layered behind
+it without re-threading `ec_volume.py` each time.
+
+The planner holds a back-reference to its EcVolume and reads the volume's
+mutable collaborators (`remote_reader`, `encoder`, the `recover_*` knobs,
+the suspicion registry) dynamically — swapping a reader or encoder on the
+volume mid-life keeps working exactly as before the extraction.
+
+The first tier behind the planner is the DECODED-INTERVAL CACHE: degraded
+traffic is wire-dominated (TRACE_ATTRIB_r01: fetch.holder 0.67 of the
+tail vs decode 0.22), so a hot degraded interval that is reconstructed
+once per *request* wastes a full survivor fan-out every time. The cache
+makes it once per epoch instead — see `DecodedIntervalCache`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from collections import OrderedDict
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from concurrent.futures import TimeoutError as _FutureTimeout  # 3.10: not builtins.TimeoutError
+from typing import Optional
+
+import numpy as np
+
+from seaweedfs_tpu import stats
+from seaweedfs_tpu.obs import trace as trace_mod
+
+from seaweedfs_tpu.ec import stripe
+from seaweedfs_tpu.utils import config
+
+
+class EcDegradedReadError(IOError):
+    """A degraded read could not be served. Typed (instead of a bare
+    IOError/None bubble) so the volume server can answer 503 with a
+    Retry-After hint and operators can count failure classes apart.
+    Carries WHO was attempted and what the suspicion registry thought at
+    failure time — the difference between "the cluster lost the stripe"
+    and "one wedged peer is poisoning the ladder"."""
+
+    #: seconds a client should back off before retrying; subclasses pick
+    #: a default matched to their failure mode, callers may override
+    retry_after: float = 1.0
+
+    def __init__(
+        self,
+        msg: str,
+        shard_id: Optional[int] = None,
+        attempted: tuple = (),
+        suspected: tuple = (),
+        retry_after: Optional[float] = None,
+    ):
+        super().__init__(msg)
+        self.shard_id = shard_id
+        #: holder keys (peer addrs when the reader names peers, else
+        #: (volume, shard) tuples) the read actually tried
+        self.attempted = list(attempted)
+        #: holder keys sitting in a suspicion window when the read failed
+        self.suspected = list(suspected)
+        if retry_after is not None:
+            self.retry_after = retry_after
+
+
+class EcNoViableHolders(EcDegradedReadError):
+    """Too few survivors reachable and no attempt still pending: every
+    candidate answered a miss, erred, or sat suspected. Retrying sooner
+    than the suspicion backoff mostly re-fails, hence the longer hint."""
+
+    retry_after = 5.0
+
+
+class EcDegradedReadTimeout(EcDegradedReadError):
+    """The overall recover deadline expired with fetches still in flight —
+    survivors exist but answered too slowly; a prompt retry may win."""
+
+    retry_after = 1.0
+
+
+class EcShardCorrupt(EcDegradedReadError):
+    """The read failed AND this volume has shards quarantined for failed
+    integrity verification — no clean copy could serve the interval. The
+    scrubber's auto-repair is (or will be) rebuilding the quarantined
+    shards, so the retry hint matches the repair timescale, and the
+    operator-facing class says 'corruption', not 'holders down'."""
+
+    retry_after = 5.0
+
+    def __init__(self, msg: str, quarantined: Optional[dict] = None, **kw):
+        super().__init__(msg, **kw)
+        #: {shard_id: reason} snapshot of the volume's quarantine registry
+        self.quarantined = dict(quarantined or {})
+
+
+class _CoalesceSlot:
+    """One in-flight degraded decode: the leader publishes its result (or
+    error) here and sets the event; waiters read it instead of decoding."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class DecodedIntervalCache:
+    """Process-wide bounded LRU of DECODED shard intervals, keyed like a
+    `_CoalesceSlot` plus the owning volume: (base, shard, offset, size) ->
+    bytes. Only real reconstructions publish (the coalesce leader, or the
+    batch decoder per item), so a hot degraded interval costs one survivor
+    fan-out + decode per WEEDTPU_READ_CACHE_TTL_S epoch instead of one per
+    request. Capped by WEEDTPU_READ_CACHE_MB (MiB; 0 disables lookups and
+    publishes entirely).
+
+    Byte safety over hit rate: every event that can change what a shard
+    interval SHOULD read as — quarantine, shard remount after rebuild,
+    inline-ingest delta update, unmount / convert cut-over — flushes the
+    volume's entries AND bumps its generation. Publishers snapshot the
+    generation BEFORE gathering survivors and `put` refuses a stale
+    snapshot, so a decode that straddles an invalidation can never install
+    pre-event bytes. Generations are kept for every base ever invalidated
+    (one int each): forgetting one would let an in-flight decode from
+    before the flush publish against a fresh generation counter."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()  # leaf: guards maps only, no I/O
+        # key -> (payload, publish time); OrderedDict insertion order is
+        # the LRU order (get() re-ends the key)
+        self._entries: "OrderedDict[tuple, tuple[bytes, float]]" = OrderedDict()
+        self._bytes = 0
+        self._by_volume: dict[str, set] = {}
+        self._gen: dict[str, int] = {}
+
+    @staticmethod
+    def _cap_bytes() -> int:
+        return int(float(config.env("WEEDTPU_READ_CACHE_MB")) * (1 << 20))
+
+    def enabled(self) -> bool:
+        return self._cap_bytes() > 0
+
+    def generation(self, base: str) -> int:
+        """Snapshot BEFORE gathering survivors; pass to put()."""
+        with self._lock:
+            return self._gen.get(base, 0)
+
+    def get(self, base: str, shard_id: int, offset: int, size: int) -> Optional[bytes]:
+        if not self.enabled():
+            return None
+        key = (base, shard_id, offset, size)
+        ttl = float(config.env("WEEDTPU_READ_CACHE_TTL_S"))
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ttl > 0 and _time.monotonic() - ent[1] >= ttl:
+                # the epoch boundary: age out and let the read re-decode
+                self._drop_locked(key)
+                stats.ReadCacheEvictions.inc()
+                ent = None
+            if ent is None:
+                stats.ReadCacheMisses.inc()
+                return None
+            self._entries.move_to_end(key)
+            stats.ReadCacheHits.inc()
+            return ent[0]
+
+    def put(
+        self, base: str, shard_id: int, offset: int, size: int,
+        data: bytes, gen: int,
+    ) -> bool:
+        cap = self._cap_bytes()
+        if cap <= 0 or len(data) > cap:
+            return False
+        key = (base, shard_id, offset, size)
+        with self._lock:
+            if self._gen.get(base, 0) != gen:
+                # the volume was invalidated while this decode ran: its
+                # survivors may predate the event — refuse the publish
+                return False
+            if key in self._entries:
+                self._drop_locked(key)
+            self._entries[key] = (bytes(data), _time.monotonic())
+            self._bytes += len(data)
+            self._by_volume.setdefault(base, set()).add(key)
+            while self._bytes > cap and self._entries:
+                self._drop_locked(next(iter(self._entries)))
+                stats.ReadCacheEvictions.inc()
+            stats.ReadCacheBytes.set(float(self._bytes))
+        return True
+
+    def _drop_locked(self, key: tuple) -> None:
+        data, _ = self._entries.pop(key)
+        self._bytes -= len(data)
+        stats.ReadCacheBytes.set(float(self._bytes))
+        keys = self._by_volume.get(key[0])
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_volume[key[0]]
+
+    def invalidate_volume(self, base: str) -> int:
+        """Flush every cached interval of `base` and bump its generation
+        (quarantine / delta update / unmount / convert cut-over)."""
+        with self._lock:
+            self._gen[base] = self._gen.get(base, 0) + 1
+            dropped = list(self._by_volume.get(base, ()))
+            for key in dropped:
+                self._drop_locked(key)
+            if dropped:
+                stats.ReadCacheInvalidations.inc(len(dropped))
+            return len(dropped)
+
+    def invalidate_shard(self, base: str, shard_id: int) -> int:
+        """Flush one shard's cached intervals (remount after rebuild).
+        The generation still bumps per-volume: an in-flight decode OF THIS
+        SHARD must not publish pre-remount bytes, and over-invalidating a
+        sibling shard's in-flight publish merely costs one re-decode."""
+        with self._lock:
+            self._gen[base] = self._gen.get(base, 0) + 1
+            dropped = [
+                key for key in self._by_volume.get(base, ())
+                if key[1] == shard_id
+            ]
+            for key in dropped:
+                self._drop_locked(key)
+            if dropped:
+                stats.ReadCacheInvalidations.inc(len(dropped))
+            return len(dropped)
+
+    def clear(self) -> None:
+        """Full reset (tests): entries, volume index, AND generations."""
+        with self._lock:
+            self._entries.clear()
+            self._by_volume.clear()
+            self._gen.clear()
+            self._bytes = 0
+            stats.ReadCacheBytes.set(0.0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+
+#: the process-wide cache every planner publishes into — one byte budget
+#: shared by all mounted volumes, same scope as the suspicion registry
+CACHE = DecodedIntervalCache()
+
+
+class ReadPlanner:
+    """Owns the per-interval read decision tree for ONE EcVolume.
+
+    The volume keeps the storage-shaped state (index, shard handles,
+    quarantine registry, geometry); the planner keeps the serving-shaped
+    state (fetch pool, coalesce map) and every policy decision. Volume
+    attributes are read through properties at call time, never copied:
+    tests and the volume server mutate `remote_reader`/`encoder` on the
+    volume after construction and the planner must follow."""
+
+    def __init__(self, volume) -> None:
+        self.v = volume
+        # degraded-read survivor fan-out pool (lazily built: most volumes
+        # never take a reconstructing read, and a pool per mount would
+        # leak threads)
+        self._fetch_pool: Optional[ThreadPoolExecutor] = None
+        self._fetch_pool_lock = threading.Lock()
+        # single-flight coalescing of concurrent degraded decodes of the
+        # SAME (shard, offset, size): key -> _CoalesceSlot. The lock is
+        # leaf-level (never held across another acquisition or any I/O).
+        self._coalesce: dict[tuple[int, int, int], "_CoalesceSlot"] = {}
+        self._coalesce_lock = threading.Lock()
+
+    # -- volume views (live, never cached) -----------------------------------
+
+    @property
+    def base(self) -> str:
+        return self.v.base
+
+    @property
+    def remote_reader(self):
+        return self.v.remote_reader
+
+    @property
+    def encoder(self):
+        return self.v.encoder
+
+    @property
+    def total_shards(self) -> int:
+        return self.v.total_shards
+
+    @property
+    def data_shards(self) -> int:
+        return self.v.data_shards
+
+    @property
+    def quarantined(self) -> dict:
+        return self.v.quarantined
+
+    @property
+    def _suspicion(self):
+        return self.v._suspicion
+
+    @property
+    def recover_fetch_parallelism(self) -> int:
+        return self.v.recover_fetch_parallelism
+
+    @property
+    def recover_fetch_deadline(self) -> float:
+        return self.v.recover_fetch_deadline
+
+    @property
+    def recover_holder_timeout(self) -> float:
+        return self.v.recover_holder_timeout
+
+    @property
+    def recover_holder_backoff(self) -> float:
+        return self.v.recover_holder_backoff
+
+    @property
+    def recover_suspect_after(self) -> float:
+        return self.v.recover_suspect_after
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._fetch_pool_lock:
+            pool, self._fetch_pool = self._fetch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _fetch_executor(self) -> ThreadPoolExecutor:
+        with self._fetch_pool_lock:
+            if self._fetch_pool is None:
+                self._fetch_pool = ThreadPoolExecutor(
+                    max_workers=self.recover_fetch_parallelism,
+                    thread_name_prefix=f"ec-fetch-{os.path.basename(self.base)}",
+                )
+            return self._fetch_pool
+
+    # -- suspicion ladder ------------------------------------------------------
+
+    def _holder_key(self, shard_id: int) -> tuple:
+        """Suspicion key for the holder behind `shard_id`. When the
+        injected reader can name the peer (the volume server's closures
+        carry a cache-only `peer_for` attribute), the key IS the peer
+        identity — suspicion then applies to every shard of every volume
+        that peer serves, so one wedged peer costs one capped attempt
+        process-wide. Readers without peer identity fall back to a
+        (volume, shard) key: the old per-volume scope, never wrong, just
+        narrower."""
+        peer_for = getattr(self.remote_reader, "peer_for", None)
+        if peer_for is not None:
+            try:
+                peer = peer_for(shard_id)
+            except Exception:  # noqa: BLE001 — identity is best-effort
+                peer = None
+            if peer:
+                return ("peer", peer)
+        return ("volume-shard", self.base, shard_id)
+
+    def holder_suspected(self, shard_id: int) -> bool:
+        return self._suspicion.suspected(self._holder_key(shard_id))
+
+    def mark_holder_suspect(self, shard_id: int) -> None:
+        self._suspicion.mark(self._holder_key(shard_id), self.recover_holder_backoff)
+
+    def _track_wedged(self, shard_id: int, fut) -> None:
+        """Remember that `fut` is a call into a wedged holder whose pool
+        thread is still blocked; the holder reads as suspected until the
+        call finally returns (SIGCONT, TCP reset, ...)."""
+        self._suspicion.track_wedged(self._holder_key(shard_id), fut)
+
+    # -- the read ladder -------------------------------------------------------
+
+    def read_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
+        """One interval: local -> cache -> remote -> reconstruct."""
+        data = self.read_present(shard_id, offset, size)
+        if data is not None:
+            return data
+        return self.recover_interval(shard_id, offset, size)
+
+    def read_present(self, shard_id: int, offset: int, size: int) -> Optional[np.ndarray]:
+        """The non-reconstructing rungs of the read ladder (local ->
+        decoded-interval cache -> remote), or None when only
+        reconstruction can serve the interval. The cache sits BEFORE the
+        remote rung: the degraded tail is wire-dominated (fetch.holder
+        0.67 in TRACE_ATTRIB_r01), and a hit must skip RTTs, not just the
+        GF math. Local disk still wins — it is never stale."""
+        data = self.v._read_local(shard_id, offset, size)
+        if data is not None:
+            return data
+        data = self.cache_lookup(shard_id, offset, size)
+        if data is not None:
+            return data
+        return self._remote_fetch_capped(shard_id, offset, size)
+
+    def cache_lookup(self, shard_id: int, offset: int, size: int) -> Optional[np.ndarray]:
+        """Decoded-interval cache rung. A hit classifies the request
+        "cached" (unless a sibling interval already went degraded — the
+        slower class tells the truer story) and, critically, returns
+        BEFORE any fan-out, hedge, or reconstruct-histogram observation:
+        only real decodes may feed the EWMA/suspicion statistics."""
+        if not CACHE.enabled():
+            return None
+        raw = CACHE.get(self.base, shard_id, offset, size)
+        if raw is None:
+            with trace_mod.span("cache.miss", shard=shard_id):
+                pass
+            return None
+        with trace_mod.span("cache.hit", shard=shard_id, size=size):
+            if trace_mod.current_class() in ("healthy", "ec_intact"):
+                trace_mod.set_class("cached")
+            return np.frombuffer(raw, dtype=np.uint8).copy()
+
+    def _remote_fetch_capped(
+        self, shard_id: int, offset: int, size: int
+    ) -> Optional[np.ndarray]:
+        """One remote attempt under the per-holder cap: the call runs on
+        the fetch pool and is abandoned once it has RUN for
+        `recover_holder_timeout` — a SIGSTOPped/wedged holder (answers
+        nothing, errors nothing) costs exactly one capped wait, gets
+        marked suspect for the backoff window, and later reads skip it.
+        The cap is measured from the call's ACTUAL start, same rule as
+        the fan-out: an attempt stuck in the pool queue is the pool's
+        fault, not the holder's, and must never suspect a healthy peer
+        (the read gives up after ~2x the cap either way)."""
+        if self.remote_reader is None or self.holder_suspected(shard_id):
+            return None
+        started: list[float] = []
+        parent = trace_mod.current()
+
+        def _call():
+            started.append(_time.monotonic())
+            with trace_mod.attach(parent), trace_mod.span(
+                "ec.fetch", shard=shard_id
+            ):
+                return self.remote_reader(shard_id, offset, size)
+
+        cap = self.recover_holder_timeout
+        fut = self._fetch_executor().submit(_call)
+        try:
+            raw = fut.result(timeout=cap)
+        except _FutureTimeout:
+            if not started:
+                # never left the queue: saturated pool, holder unproven —
+                # a miss for this read, no suspicion
+                stripe._abandon_future(fut)
+                return None
+            remaining = cap - (_time.monotonic() - started[0])
+            raw = None
+            if remaining > 0:
+                try:
+                    raw = fut.result(timeout=remaining)
+                except _FutureTimeout:
+                    remaining = 0.0
+                except Exception:  # noqa: BLE001 — a down holder is a miss
+                    return None
+            if remaining <= 0:
+                self.mark_holder_suspect(shard_id)
+                self._track_wedged(shard_id, fut)
+                stripe._abandon_future(fut)
+                return None
+        except Exception:  # noqa: BLE001 — a down holder is a miss,
+            return None  # not a failed read: survivors can still serve it
+        if raw is None:
+            # a long-running NOTHING is the wedge signature when the
+            # reader has its own internal transport timeout (it swallows
+            # the stall and reports a miss): suspect without re-probing
+            if (
+                started
+                and _time.monotonic() - started[0] >= self.recover_suspect_after
+            ):
+                self.mark_holder_suspect(shard_id)
+            return None
+        if started:
+            # completed answers feed the per-peer latency EWMA the hedge
+            # delay derives from; misses/wedges never do (see suspicion)
+            self._suspicion.observe_latency(
+                self._holder_key(shard_id), _time.monotonic() - started[0]
+            )
+        return np.frombuffer(raw, dtype=np.uint8).copy()
+
+    # -- reconstruction --------------------------------------------------------
+
+    def recover_interval(self, shard_id: int, offset: int, size: int) -> np.ndarray:
+        """recoverOneRemoteEcShardInterval: read the same interval from every
+        other shard and reconstruct the wanted one. Concurrent recovers of
+        the SAME interval are single-flight coalesced (WEEDTPU_COALESCE_READS):
+        a hot needle on a lost shard costs one survivor fan-out + decode,
+        with every waiter handed a byte-identical copy."""
+        t0 = _time.monotonic()
+        trace_mod.set_class("degraded")
+        try:
+            with trace_mod.span("ec.recover", shard=shard_id, size=size):
+                if not config.env("WEEDTPU_COALESCE_READS"):
+                    return self._decode_once(shard_id, offset, size)
+                return self._recover_interval_coalesced(shard_id, offset, size)
+        finally:
+            # DegradedReadSeconds is the CLIENT-facing latency (waiters
+            # included); EcReconstructSeconds counts actual decodes and is
+            # observed in _recover_interval_inner, else N coalesced waiters
+            # would inflate the reconstruct histogram N-fold
+            stats.DegradedReadSeconds.observe(_time.monotonic() - t0)
+
+    def _recover_interval_coalesced(
+        self, shard_id: int, offset: int, size: int
+    ) -> np.ndarray:
+        key = (shard_id, offset, size)
+        with self._coalesce_lock:
+            slot = self._coalesce.get(key)
+            leader = slot is None
+            if leader:
+                slot = self._coalesce[key] = _CoalesceSlot()
+        if not leader:
+            stats.CoalescedReads.inc()
+            # generous bound: the leader's decode is itself bounded by the
+            # fetch deadline + one holder cap; a vanished leader (killed
+            # thread) must not strand waiters forever
+            budget = self.recover_fetch_deadline + self.recover_holder_timeout + 5.0
+            with trace_mod.span("ec.coalesce.wait", shard=shard_id) as sp:
+                won = slot.event.wait(timeout=budget)
+                if sp is not None:
+                    sp.annotate(served_by_leader=won)
+            if won:
+                if slot.error is not None:
+                    raise slot.error
+                assert slot.result is not None
+                return slot.result.copy()
+            return self._decode_once(shard_id, offset, size)
+        try:
+            out = self._decode_once(shard_id, offset, size)
+            slot.result = out
+            return out
+        except BaseException as e:
+            slot.error = e
+            raise
+        finally:
+            # unpublish BEFORE waking waiters: a brand-new reader arriving
+            # after the event must elect a fresh leader, never read a slot
+            # that is mid-teardown
+            with self._coalesce_lock:
+                self._coalesce.pop(key, None)
+            slot.event.set()
+
+    def _decode_once(self, shard_id: int, offset: int, size: int) -> np.ndarray:
+        """One real reconstruction, published into the decoded-interval
+        cache under the generation snapshotted BEFORE the survivor gather:
+        an invalidation (quarantine/remount/delta/cut-over) landing while
+        this decode runs bumps the generation and the publish is refused —
+        pre-event bytes can never be installed."""
+        gen = CACHE.generation(self.base) if CACHE.enabled() else 0
+        out = self._recover_interval_inner(shard_id, offset, size)
+        if CACHE.enabled():
+            CACHE.put(self.base, shard_id, offset, size, out.tobytes(), gen)
+        return out
+
+    def _recover_interval_inner(self, shard_id: int, offset: int, size: int) -> np.ndarray:
+        t0 = _time.monotonic()
+        try:
+            shards = self._gather_survivors(shard_id, offset, size)
+            with trace_mod.span(
+                "ec.decode",
+                backend=getattr(self.encoder, "backend", "?"),
+                width=size,
+            ):
+                rec = self.encoder.reconstruct(shards, wanted=[shard_id])
+            return rec[shard_id]
+        finally:
+            stats.EcReconstructSeconds.observe(_time.monotonic() - t0)
+
+    def _gather_survivors(
+        self, shard_id: int, offset: int, size: int
+    ) -> list[Optional[np.ndarray]]:
+        """Collect >= DATA_SHARDS survivor copies of one interval (local
+        first, then a parallel remote fan-out). Raises IOError when too few
+        survivors are reachable."""
+        with trace_mod.span("ec.gather", shard=shard_id):
+            return self._gather_survivors_fanout(shard_id, offset, size)
+
+    def _gather_survivors_fanout(
+        self, shard_id: int, offset: int, size: int
+    ) -> list[Optional[np.ndarray]]:
+        shards: list[Optional[np.ndarray]] = [None] * self.total_shards
+        have = 0
+        # local shards first — remote reads cost RTTs on the p50-critical path
+        for s in range(self.total_shards):
+            if s == shard_id or have >= self.data_shards:
+                continue
+            buf = self.v._read_local(s, offset, size)
+            if buf is not None:
+                shards[s] = buf
+                have += 1
+        need = self.data_shards - have
+        attempted: tuple = ()
+        deadline_expired = False
+        if need > 0 and self.remote_reader is not None:
+            # Fan out to ALL remaining survivors at once and take the first
+            # `need` arrivals — the reference reads the same interval from
+            # >=10 shards with parallel goroutines
+            # (recoverOneRemoteEcShardInterval [ref: weed/storage/
+            # store_ec.go — mount empty, SURVEY.md §3.2]); serial fetches
+            # cost one RTT per survivor and dominated the reconstruct p50.
+            # Late arrivals beyond `need` are ignored; a hung peer is cut by
+            # the overall deadline rather than stalling the read forever.
+            # suspected-wedged holders are skipped outright: the fan-out
+            # needs only `need` of the remaining survivors, and a holder
+            # inside its backoff window would just burn a pool thread
+            candidates = []
+            skipped_suspected = []
+            for s in range(self.total_shards):
+                if s == shard_id or shards[s] is not None:
+                    continue
+                if self.holder_suspected(s):
+                    skipped_suspected.append(s)
+                else:
+                    candidates.append(s)
+            trace_mod.annotate(
+                local=have, need=need,
+                **({"skipped_suspected": skipped_suspected}
+                   if skipped_suspected else {}),
+            )
+            fan_parent = trace_mod.current()
+            pool = self._fetch_executor()
+            # per-holder cap is measured from each call's ACTUAL start (a
+            # queued attempt waiting for a pool slot is not the holder's
+            # fault): the worker records its entry time, and the wait loop
+            # cuts any holder that has been RUNNING past the cap — wedged,
+            # not merely slow — marking it suspect. The OVERALL read is
+            # still bounded by `recover_fetch_deadline`, unchanged.
+            started: dict[int, float] = {}
+            attempted = tuple(self._holder_key(s) for s in candidates)
+
+            def _attempt(s: int):
+                started[s] = _time.monotonic()
+                with trace_mod.attach(fan_parent), trace_mod.span(
+                    "ec.fetch", shard=s
+                ):
+                    return self.remote_reader(s, offset, size)
+
+            futs = {pool.submit(_attempt, s): s for s in candidates}
+            primaries = {sid: fut for fut, sid in futs.items()}
+            pending = set(futs)
+            # hedging (WEEDTPU_HEDGE_READS): once a primary fetch has RUN
+            # past the peer's EWMA-derived tail, launch ONE backup against
+            # a different holder; first success wins, the loser is
+            # cancelled/drained, and both results must be byte-identical.
+            hedge_on = bool(config.env("WEEDTPU_HEDGE_READS"))
+            hedge_started: dict[int, float] = {}
+            # sid -> backup future, or None when a submit attempt found no
+            # second holder (memoized: retrying every loop tick would spin
+            # the wait budget down to 5 ms for the rest of the read)
+            hedges: dict[int, object] = {}
+            hedge_targets: dict[int, Optional[str]] = {}
+            hedge_futs: set = set()
+            hedge_wins: list[int] = []
+            winners: dict[int, bytes] = {}
+            deadline = _time.monotonic() + self.recover_fetch_deadline
+            cap = self.recover_holder_timeout
+            try:
+                while pending and have < self.data_shards:
+                    now = _time.monotonic()
+                    for fut in list(pending):
+                        sid = futs[fut]
+                        is_hedge = fut in hedge_futs
+                        t0s = (hedge_started if is_hedge else started).get(sid)
+                        if t0s is None or fut.done():
+                            continue
+                        if now - t0s >= cap:
+                            # running past the per-holder cap: wedged.
+                            # Suspect it, remember the blocked thread, and
+                            # stop waiting on it (the read may still
+                            # complete from the other survivors). A wedged
+                            # BACKUP blames the alternate holder it was
+                            # pinned at — never the primary's key (which
+                            # names a different, possibly healthy peer).
+                            pending.discard(fut)
+                            if is_hedge:
+                                self._suspect_hedge_target(
+                                    hedge_targets.get(sid), fut
+                                )
+                            else:
+                                self.mark_holder_suspect(sid)
+                                self._track_wedged(sid, fut)
+                            stripe._abandon_future(fut)
+                        elif (
+                            hedge_on
+                            and not is_hedge
+                            and sid not in hedges
+                            and now - t0s >= self.hedge_delay(sid)
+                        ):
+                            # memoize the outcome either way: None means
+                            # "no second holder", and must not be retried
+                            # (and re-pay peer lookups) every loop tick
+                            hedges[sid] = self._submit_hedge(
+                                pool, sid, offset, size,
+                                hedge_started, hedge_targets,
+                            )
+                            backup = hedges[sid]
+                            if backup is not None:
+                                hedge_futs.add(backup)
+                                futs[backup] = sid
+                                pending.add(backup)
+                    if not pending:
+                        break
+                    budget = deadline - now
+                    if budget <= 0:
+                        deadline_expired = True
+                        break
+                    # wake at the earliest per-holder cap OR pending hedge
+                    # fire time, whichever comes first
+                    wake: list[float] = []
+                    for f in pending:
+                        sid = futs[f]
+                        is_hedge = f in hedge_futs
+                        t0s = (hedge_started if is_hedge else started).get(sid)
+                        if t0s is None:
+                            continue
+                        wake.append(t0s + cap - now)
+                        if hedge_on and not is_hedge and sid not in hedges:
+                            wake.append(t0s + self.hedge_delay(sid) - now)
+                    if wake:
+                        budget = min(budget, max(min(wake), 0.005))
+                    done, pending = wait(
+                        pending, timeout=budget, return_when=FIRST_COMPLETED
+                    )
+                    for fut in done:
+                        sid = futs[fut]
+                        is_hedge = fut in hedge_futs
+                        try:
+                            raw = fut.result()
+                        except Exception:  # noqa: BLE001 — a failed peer is a miss
+                            raw = None
+                        t0s = (hedge_started if is_hedge else started).get(sid)
+                        now2 = _time.monotonic()
+                        if raw is not None and len(raw) == size:
+                            if t0s is not None and not is_hedge:
+                                # primaries only: a hedge's fast answer is
+                                # the OTHER holder's latency and would drag
+                                # the slow peer's estimate down
+                                self._suspicion.observe_latency(
+                                    self._holder_key(sid), now2 - t0s
+                                )
+                            want = winners.get(sid)
+                            if want is not None:
+                                # the hedged pair's LOSER also answered:
+                                # first-success already won, but the bytes
+                                # must agree — a divergence is survivor
+                                # corruption, not a race to tolerate
+                                if bytes(raw) != want:
+                                    stats.DegradedReadErrors.labels(
+                                        "HedgeMismatch"
+                                    ).inc()
+                                    raise IOError(
+                                        f"shard {sid}: hedged fetch returned "
+                                        "bytes differing from the primary's"
+                                    )
+                                continue
+                            winners[sid] = bytes(raw)
+                            shards[sid] = np.frombuffer(
+                                raw, dtype=np.uint8
+                            ).copy()
+                            have += 1
+                            if is_hedge:
+                                stats.HedgeWon.inc()
+                                hedge_wins.append(sid)
+                            other = (
+                                primaries.get(sid) if is_hedge else hedges.get(sid)
+                            )
+                            if other is not None and other in pending:
+                                pending.discard(other)
+                                self._settle_hedge_loser(other, winners[sid])
+                        else:
+                            # slow NOTHING = internally-timed-out wedge
+                            # (see _remote_fetch_capped); fast None is a
+                            # plain miss and never suspects. Same blame
+                            # rule as the cap: a slow-missing BACKUP names
+                            # its own alternate holder, not the primary.
+                            if (
+                                t0s is not None
+                                and now2 - t0s >= self.recover_suspect_after
+                            ):
+                                if is_hedge:
+                                    self._suspect_hedge_target(
+                                        hedge_targets.get(sid), None
+                                    )
+                                else:
+                                    self.mark_holder_suspect(sid)
+            finally:
+                fired = sorted(s for s, f in hedges.items() if f is not None)
+                trace_mod.annotate(
+                    gathered=have,
+                    **({"hedges_fired": fired} if fired else {}),
+                    **({"hedges_won": hedge_wins} if hedge_wins else {}),
+                    **({"deadline_expired": True} if deadline_expired else {}),
+                )
+                # EVERY exit (normal, deadline, or an exception raised
+                # mid-loop) cancels what never started and drains what did:
+                # the discard callback drops a late result/exception on the
+                # floor so a hung peer's thread never outlives the read with
+                # a reference to its buffer (or an unobserved error).
+                for fut in pending:
+                    stripe._abandon_future(fut)
+        if have < self.data_shards:
+            suspected = tuple(
+                self._holder_key(s)
+                for s in range(self.total_shards)
+                if s != shard_id and self.holder_suspected(s)
+            )
+            # the corruption class applies only when quarantine is actually
+            # RELEVANT to this failure: the wanted shard itself sits
+            # quarantined, or the quarantined shards are what kept the
+            # survivor count short (with them clean the read would have had
+            # enough). An unrelated quarantined shard during a plain
+            # holder outage must still classify as holders-down.
+            quarantine_blocked = bool(self.quarantined) and (
+                shard_id in self.quarantined
+                or (
+                    not deadline_expired
+                    and have + len(self.quarantined) >= self.data_shards
+                )
+            )
+            if quarantine_blocked:
+                # local shards sit quarantined for failed verification and
+                # the stripe still couldn't be served: this is CORRUPTION
+                # awaiting repair, not holders being down — a distinct
+                # class (and retry hint) for clients and dashboards
+                stats.DegradedReadErrors.labels(EcShardCorrupt.__name__).inc()
+                raise EcShardCorrupt(
+                    f"shard {shard_id}: only {have} clean surviving shards "
+                    f"reachable, need {self.data_shards}; local shards "
+                    f"{sorted(self.quarantined)} quarantined "
+                    f"({self.quarantined}) — repair pending",
+                    quarantined=self.quarantined,
+                    shard_id=shard_id,
+                    attempted=attempted,
+                    suspected=suspected,
+                )
+            cls = EcDegradedReadTimeout if deadline_expired else EcNoViableHolders
+            stats.DegradedReadErrors.labels(cls.__name__).inc()
+            raise cls(
+                f"shard {shard_id}: only {have} surviving shards reachable, "
+                f"need {self.data_shards}"
+                + (" (recover deadline expired)" if deadline_expired else ""),
+                shard_id=shard_id,
+                attempted=attempted,
+                suspected=suspected,
+            )
+        return shards
+
+    # -- hedging ---------------------------------------------------------------
+
+    def hedge_delay(self, shard_id: int) -> float:
+        """Seconds a survivor fetch may run before its backup launches.
+        WEEDTPU_HEDGE_DELAY_MS pins it; otherwise the per-peer latency
+        EWMA (mean + 4*dev, a live high-quantile tracker) decides, with a
+        cold-start default of half the slow-miss threshold. Never later
+        than half the per-holder cap — past that the wedge machinery owns
+        the fetch, not the hedge."""
+        fixed = float(config.env("WEEDTPU_HEDGE_DELAY_MS"))
+        if fixed > 0:
+            return fixed / 1e3
+        d = self._suspicion.hedge_delay(self._holder_key(shard_id))
+        if d is None:
+            d = max(0.05, self.recover_suspect_after / 2.0)
+        return min(d, self.recover_holder_timeout / 2.0)
+
+    def _submit_hedge(
+        self, pool, shard_id: int, offset: int, size: int,
+        hedge_started: dict[int, float],
+        hedge_targets: dict[int, Optional[str]],
+    ):
+        """Launch the backup fetch for one survivor. Readers that expose
+        holder addressing (`via` + `holders_for`, the volume server's
+        closures) are steered at a DIFFERENT holder than the one the
+        primary is inside; a reader without addressing re-runs its own
+        holder ladder. None when there is no second holder to try.
+
+        The backup rides the same bounded fetch pool as the primaries, so
+        under heavy wedging it can queue before it runs — HedgeFired is
+        therefore counted (and the per-holder cap armed) from the worker's
+        ACTUAL start, never at submit."""
+        reader = self.remote_reader
+        if reader is None:
+            return None
+        via = getattr(reader, "via", None)
+        holders_for = getattr(reader, "holders_for", None)
+        target = None
+        if via is not None and holders_for is not None:
+            primary = None
+            peer_for = getattr(reader, "peer_for", None)
+            if peer_for is not None:
+                try:
+                    primary = peer_for(shard_id)
+                except Exception:  # noqa: BLE001 — identity is best-effort
+                    primary = None
+            try:
+                holders = list(holders_for(shard_id) or ())
+            except Exception:  # noqa: BLE001 — no holder list, no hedge
+                return None
+            # skip holders already inside a suspicion window: pinning the
+            # ONE backup at a known-wedged peer would spend the hedge on
+            # exactly the holder it exists to route around
+            alts = [
+                a for a in holders
+                if a != primary and not self._suspicion.suspected(("peer", a))
+            ]
+            if not alts:
+                return None
+            target = alts[0]
+        hedge_targets[shard_id] = target
+        parent = trace_mod.current()
+
+        def _backup():
+            hedge_started[shard_id] = _time.monotonic()
+            stats.HedgeFired.inc()
+            with trace_mod.attach(parent), trace_mod.span(
+                "ec.hedge", shard=shard_id, **({"addr": target} if target else {})
+            ):
+                if target is not None:
+                    return via(target, shard_id, offset, size)
+                return reader(shard_id, offset, size)
+
+        return pool.submit(_backup)
+
+    def _suspect_hedge_target(self, target: Optional[str], fut) -> None:
+        """Suspicion for a wedged/slow-missing BACKUP fetch: the blame key
+        is the alternate holder the backup was pinned at (the peer-scoped
+        key the registry shares process-wide). A backup without addressing
+        (generic reader re-run) names no one — better unsuspected than the
+        primary's key mis-marked for a different peer's wedge."""
+        if not target:
+            return
+        key = ("peer", target)
+        self._suspicion.mark(key, self.recover_holder_backoff)
+        if fut is not None:
+            self._suspicion.track_wedged(key, fut)
+
+    def _settle_hedge_loser(self, fut, want: bytes) -> None:
+        """First-success-wins settlement: cancel the loser if it never
+        started; if running, drain it in the background and verify its
+        late result byte-identical to the winner's (a mismatch is counted
+        as HedgeMismatch — the read already returned the winner)."""
+        if fut.cancel():
+            return
+
+        def _check(f):
+            try:
+                raw = f.result()
+            except Exception:  # noqa: BLE001 — loser erred; winner served
+                return
+            if raw is not None and len(raw) == len(want) and bytes(raw) != want:
+                stats.DegradedReadErrors.labels("HedgeMismatch").inc()
+
+        fut.add_done_callback(_check)
+
+    # -- batched reconstruction ------------------------------------------------
+
+    def recover_intervals_batch(
+        self, shard_id: int, items: list[tuple[int, int]]
+    ) -> list[np.ndarray]:
+        """Recover several (offset, size) intervals that all miss the SAME
+        shard in one bucketed device call: survivors are gathered per
+        interval (the same local -> remote ladder as the single path),
+        grouped by which shards actually answered, zero-padded to a shared
+        bucket length, and decoded as a (B, survivors, bucket) stack with
+        ONE fused matrix per group — instead of one dispatch (and one
+        decode-matrix application) per interval. Zero padding is exact and
+        trimmed per interval before returning."""
+        if len(items) == 1:
+            off, size = items[0]
+            return [self.recover_interval(shard_id, off, size)]
+        t0 = _time.monotonic()
+        trace_mod.set_class("degraded")
+        try:
+            with trace_mod.span(
+                "ec.recover", shard=shard_id, batch=len(items)
+            ):
+                return self._recover_intervals_batch_inner(shard_id, items)
+        finally:
+            dt = _time.monotonic() - t0
+            stats.EcReconstructSeconds.observe(dt)
+            stats.DegradedReadSeconds.observe(dt)
+
+    def _recover_intervals_batch_inner(
+        self, shard_id: int, items: list[tuple[int, int]]
+    ) -> list[np.ndarray]:
+        # one generation snapshot covers the whole batch: every gather
+        # below starts after it, so the publish race check stays sound
+        publish = CACHE.enabled()
+        gen = CACHE.generation(self.base) if publish else 0
+        gathered = [
+            self._gather_survivors(shard_id, off, size) for off, size in items
+        ]
+        results: list[Optional[np.ndarray]] = [None] * len(items)
+        # distinct survivor sets decode with distinct matrices; in the
+        # common case (stable shard availability) there is ONE group
+        groups: dict[tuple, list[int]] = {}
+        for idx, shards in enumerate(gathered):
+            present = tuple(
+                i for i, s in enumerate(shards) if s is not None
+            )[: self.data_shards]
+            groups.setdefault(present, []).append(idx)
+        for survivors, idxs in groups.items():
+            nmax = max(items[i][1] for i in idxs)
+            stack = np.zeros(
+                (len(idxs), self.data_shards, nmax), dtype=np.uint8
+            )
+            for bi, i in enumerate(idxs):
+                for di, s in enumerate(survivors):
+                    arr = gathered[i][s]
+                    stack[bi, di, : arr.shape[0]] = arr
+            # bucketed: the encoder's own serving-path shape buckets,
+            # so odd interval sizes never pay a fresh XLA compile
+            with trace_mod.span(
+                "ec.decode",
+                backend=getattr(self.encoder, "backend", "?"),
+                batch=len(idxs),
+                width=nmax,
+            ):
+                out = self.encoder.reconstruct_batch(
+                    stack, survivors, [shard_id], bucketed=True
+                )
+            for bi, i in enumerate(idxs):
+                results[i] = np.ascontiguousarray(out[bi, 0, : items[i][1]])
+        if publish:
+            for (off, size), arr in zip(items, results):
+                CACHE.put(self.base, shard_id, off, size, arr.tobytes(), gen)
+        return results

@@ -76,6 +76,35 @@ class _Cell:
         self.errors += errors
         self.max = max(self.max, max_)
 
+    def to_dict(self) -> dict:
+        """Wire form for cross-process merging (weedload --procs workers
+        ship their recorders back as JSON). Bucket bounds are code-level
+        constants, so counts alone round-trip exactly."""
+        with self.lock:
+            return {
+                "counts": list(self.counts),
+                "total": self.total,
+                "sum": self.sum,
+                "errors": self.errors,
+                "max": self.max,
+            }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "_Cell":
+        cell = cls()
+        counts = list(d["counts"])
+        if len(counts) != len(cell.counts):
+            raise ValueError(
+                f"bucket count mismatch: {len(counts)} != {len(cell.counts)} "
+                "(recorder serialized by a different code version?)"
+            )
+        cell.counts = counts
+        cell.total = int(d["total"])
+        cell.sum = float(d["sum"])
+        cell.errors = int(d["errors"])
+        cell.max = float(d["max"])
+        return cell
+
     def _quantile(self, q: float) -> float:
         """Value at quantile `q` (caller holds the lock or owns the cell),
         reported as the matching bucket's upper bound (conservative:
@@ -138,6 +167,19 @@ class LatencyRecorder:
             if k == klass:
                 out.merge(cell)
         return out
+
+    def to_dict(self) -> dict:
+        """{"phase\\tklass": cell-dict} — what a weedload generator worker
+        writes to its result file; the driver folds every worker's dict
+        into one recorder with merge_dict."""
+        with self._lock:
+            items = list(self._cells.items())
+        return {f"{phase}\t{klass}": cell.to_dict() for (phase, klass), cell in items}
+
+    def merge_dict(self, d: dict) -> None:
+        for key, cell_dict in d.items():
+            phase, klass = key.split("\t", 1)
+            self._cell(phase, klass).merge(_Cell.from_dict(cell_dict))
 
     def phases(self) -> dict:
         """{phase: {klass: summary}} — the per-phase artifact section."""

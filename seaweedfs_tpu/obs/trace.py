@@ -64,6 +64,8 @@ SPAN_NAMES: dict[str, str] = {
     "ec.hedge": "backup fetch raced against a slow primary",
     "ec.coalesce.wait": "waiter parked on another read's in-flight decode",
     "ec.decode": "GF decode dispatch (backend + batch width in attrs)",
+    "cache.hit": "interval served from the decoded-interval cache (no fan-out)",
+    "cache.miss": "decoded-interval cache consulted and empty for this interval",
     "rebuild.run": "one whole-volume rebuild (local or distributed)",
     "rebuild.stage": "staging-ring fill for one rebuild batch (disk/wire)",
     "rebuild.drain": "device sync + shard write-out for one rebuild batch",
@@ -84,6 +86,10 @@ _ID_RE = re.compile(r"^[0-9a-fA-F][0-9a-fA-F-]{0,63}$")
 #: gRPC invocation-metadata key / HTTP header the id rides on
 MD_KEY = "weedtpu-trace"
 HTTP_HEADER = "X-Weedtpu-Trace"
+#: HTTP response header carrying the serving class a read resolved to
+#: (healthy / ec_intact / cached / degraded) — weedload classifies
+#: per-request latencies from it instead of guessing from topology
+READ_CLASS_HEADER = "X-Weedtpu-Read-Class"
 
 _cv: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "weedtpu_trace_span", default=None

@@ -1267,8 +1267,9 @@ def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
     (redundancy histogram, placement violations, repair queue) plus
     per-server quarantined shards (with reasons, from VolumeStatus),
     scrub progress, rebuild/convert inflight (live weedtpu_rpc_inflight
-    gauges), and the codec backend each server selected. Read-only; no
-    cluster lock."""
+    gauges), the decoded-interval read cache (hit/miss/hit-rate, bytes
+    resident, evictions, invalidations), and the codec backend each
+    server selected. Read-only; no cluster lock."""
     parse_flags(args)
     nodes = env.topology_nodes()
     if not nodes:
@@ -1315,6 +1316,21 @@ def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
             for name, labels, v in rows
             if name == "weedtpu_ec_backend_selected" and v == 1.0
         )
+        # decoded-interval cache: is degraded hot-set traffic actually
+        # being served from cache, and is the budget churning (evictions)
+        # or being flushed by topology events (invalidations)?
+        cache_hits = int(_metric_sum(rows, "weedtpu_read_cache_hits_total"))
+        cache_misses = int(_metric_sum(rows, "weedtpu_read_cache_misses_total"))
+        cache_mb = _metric_sum(rows, "weedtpu_read_cache_bytes") / 1e6
+        cache_evict = int(_metric_sum(rows, "weedtpu_read_cache_evictions_total"))
+        cache_inval = int(
+            _metric_sum(rows, "weedtpu_read_cache_invalidations_total")
+        )
+        cache_rate = (
+            f"{cache_hits / (cache_hits + cache_misses):.0%}"
+            if cache_hits + cache_misses
+            else "-"
+        )
         w.write(
             f"{url}: ec_volumes={len(ec_vids)} "
             f"quarantined=[{' '.join(quarantined) or '-'}] "
@@ -1322,6 +1338,8 @@ def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
             f"repairs={repairs_ok}ok/{repairs_fail}failed "
             f"rebuild={rebuild_inflight}inflight/{rebuilds_done}done "
             f"convert={convert_inflight}inflight/{converts_done}done "
+            f"cache={cache_hits}hit/{cache_misses}miss({cache_rate}) "
+            f"{cache_mb:.1f}MB evict={cache_evict} inval={cache_inval} "
             f"backend={','.join(backends) or '?'}\n"
         )
 
@@ -1333,7 +1351,8 @@ register(
         "view (stripes by\n\tremaining redundancy, failure-domain "
         "violations, repair queue/events),\n\tplus per-server quarantined "
         "shards (+reasons), scrub progress, live\n\trebuild/convert "
-        "inflight, repair outcomes, and the selected codec backend",
+        "inflight, repair outcomes, the decoded-interval\n\tread-cache "
+        "hit rate / footprint / churn, and the selected codec\n\tbackend",
         do_ec_status,
     )
 )

@@ -290,6 +290,30 @@ CoalescedReads = REGISTRY.counter(
     "degraded decodes absorbed by single-flight coalescing (waiters served "
     "from the leader's reconstruction instead of decoding again)",
 )
+ReadCacheHits = REGISTRY.counter(
+    "weedtpu_read_cache_hits_total",
+    "interval reads served from the decoded-interval cache — no fetch "
+    "fan-out, no hedge, no reconstruct histogram observation",
+)
+ReadCacheMisses = REGISTRY.counter(
+    "weedtpu_read_cache_misses_total",
+    "decoded-interval cache lookups that found nothing (including "
+    "TTL-expired entries) and fell through to the remote/reconstruct rungs",
+)
+ReadCacheEvictions = REGISTRY.counter(
+    "weedtpu_read_cache_evictions_total",
+    "decoded intervals dropped by the WEEDTPU_READ_CACHE_MB LRU budget or "
+    "the WEEDTPU_READ_CACHE_TTL_S age bound",
+)
+ReadCacheInvalidations = REGISTRY.counter(
+    "weedtpu_read_cache_invalidations_total",
+    "decoded intervals flushed by correctness events — quarantine, shard "
+    "remount, inline-ingest delta update, unmount/convert cut-over",
+)
+ReadCacheBytes = REGISTRY.gauge(
+    "weedtpu_read_cache_bytes",
+    "bytes currently held by the decoded-interval cache",
+)
 RebuildAdmissionWaits = REGISTRY.counter(
     "weedtpu_rebuild_admission_waits_total",
     "rebuild slab-read streams that had to WAIT for an admission token "

@@ -591,6 +591,24 @@ register_env(
     parse=_clamped_int(0),
 )
 
+register_env(
+    "WEEDTPU_READ_CACHE_MB", float, 64.0,
+    "Byte budget (MiB) of the process-wide decoded-interval read cache: a "
+    "hot degraded interval is reconstructed once per epoch, not once per "
+    "request (the coalesce leader publishes its decode). 0 disables the "
+    "cache entirely (no lookups, no counters). Clamped to >= 0.",
+    parse=lambda raw: max(0.0, float(raw)),
+)
+
+register_env(
+    "WEEDTPU_READ_CACHE_TTL_S", float, 30.0,
+    "Age (seconds) after which a cached decoded interval expires and the "
+    "next read re-decodes — the 'epoch' of decode-once-per-epoch serving. "
+    "0 means entries never expire by age (eviction/invalidation only). "
+    "Clamped to >= 0.",
+    parse=lambda raw: max(0.0, float(raw)),
+)
+
 
 def env_table_markdown() -> str:
     """The README `WEEDTPU_*` table, generated from the registry."""

@@ -49,6 +49,22 @@ def _reset_holder_suspicion():
     suspicion.GLOBAL.reset()
 
 
+@pytest.fixture(autouse=True)
+def _reset_read_cache(monkeypatch):
+    """The decoded-interval cache is process-wide and DEFAULT-ON in
+    production; tests run it default-OFF so the hundreds of existing
+    degraded-read tests keep measuring real decodes (repeat reads of one
+    needle would otherwise collapse to cache hits and invalidate their
+    latency/decode-count assertions). Cache-specific tests (and the
+    weedload smoke) opt back in with monkeypatch.setenv; the cache itself
+    is emptied after every test either way."""
+    monkeypatch.setenv("WEEDTPU_READ_CACHE_MB", "0")
+    yield
+    from seaweedfs_tpu.ec import read_planner
+
+    read_planner.CACHE.clear()
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Instrumented-lock gate: the tier-1 run's OBSERVED lock-order graph
     (package locks only — jax/stdlib internals order their own locks)

@@ -575,6 +575,7 @@ def test_trace_id_round_trips_distributed_degraded_read(cluster):
         assert n["url"] in status
     assert "ec_volumes=" in status and "scrub=" in status
     assert "backend=" in status and "rebuild=" in status
+    assert "cache=" in status and "inval=" in status
 
 
 def test_trace_id_round_trips_shell_rebuild_trace_and_slab(cluster):

@@ -1,0 +1,308 @@
+"""Decoded-interval read cache (PR 16): byte identity against the uncached
+path, coalesce-leader publishing, the stats-purity rule (hits never feed
+the reconstruct histogram or the hedge/EWMA machinery), and the
+no-stale-bytes guarantee for every invalidation event — quarantine, shard
+remount, inline-ingest delta update, and the unmount/convert cut-over seam
+(Store.mount/unmount route through EcVolume.close)."""
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import stats
+from seaweedfs_tpu.ec import ingest, read_planner, stripe
+from seaweedfs_tpu.ec.ec_volume import EcVolume
+from seaweedfs_tpu.ec.read_planner import CACHE
+from seaweedfs_tpu.ops.rs_codec import Encoder
+from seaweedfs_tpu.storage import idx as idx_mod
+from seaweedfs_tpu.storage import types
+
+LARGE = 1024
+SMALL = 64
+ENC = Encoder(10, 4, backend="numpy")
+
+
+@pytest.fixture()
+def volume(tmp_path):
+    """Synthetic volume: blob records at 8-aligned offsets + matching index
+    (same construction as test_ec_volume)."""
+    rng = np.random.default_rng(23)
+    base = str(tmp_path / "v9")
+    records = {}
+    offset = types.NEEDLE_PADDING_SIZE
+    blobs = [b"\x03" + bytes(7)]
+    for nid in [3, 10, 42, 999]:
+        body = int(rng.integers(1, 300))
+        total = types.actual_size(body, version=3)
+        rec = rng.integers(0, 256, size=total, dtype=np.uint8).tobytes()
+        records[nid] = (offset, body, rec)
+        blobs.append(rec)
+        offset += total
+    with open(base + ".dat", "wb") as f:
+        f.write(b"".join(blobs))
+    idx_mod.write_entries(
+        [(nid, types.offset_to_bytes(off), size) for nid, (off, size, _) in records.items()],
+        base + ".idx",
+    )
+    stripe.write_ec_files(base, large_block_size=LARGE, small_block_size=SMALL, buffer_size=64, encoder=ENC)
+    stripe.write_sorted_file_from_idx(base)
+    return base, records
+
+
+def open_vol(base, **kw):
+    kw.setdefault("encoder", ENC)
+    kw.setdefault("warm_on_mount", False)
+    return EcVolume(base, large_block_size=LARGE, small_block_size=SMALL, **kw)
+
+
+def enable_cache(monkeypatch, mb="64", ttl="0"):
+    monkeypatch.setenv("WEEDTPU_READ_CACHE_MB", mb)
+    monkeypatch.setenv("WEEDTPU_READ_CACHE_TTL_S", ttl)
+
+
+def drop_shards(base, shards):
+    for s in shards:
+        os.remove(stripe.shard_file_name(base, s))
+
+
+def test_cached_reads_byte_identical_to_uncached(volume, monkeypatch):
+    """The acceptance bar: uncached (cache off) vs cold decode-and-publish
+    vs warm cache hit must produce identical bytes for every needle."""
+    base, records = volume
+    drop_shards(base, [0, 13])
+    with open_vol(base) as ev:
+        uncached = {nid: ev.read_needle_blob(nid) for nid in records}
+    enable_cache(monkeypatch)
+    with open_vol(base) as ev:
+        h0, m0 = stats.ReadCacheHits.value, stats.ReadCacheMisses.value
+        cold = {nid: ev.read_needle_blob(nid) for nid in records}
+        assert stats.ReadCacheHits.value == h0, "cold pass must not hit"
+        assert stats.ReadCacheMisses.value > m0
+        warm = {nid: ev.read_needle_blob(nid) for nid in records}
+        assert stats.ReadCacheHits.value > h0, "warm pass must hit"
+    for nid, (off, size, rec) in records.items():
+        assert uncached[nid][: len(rec)] == rec
+        assert cold[nid] == uncached[nid], f"needle {nid}: cold != uncached"
+        assert warm[nid] == uncached[nid], f"needle {nid}: warm != uncached"
+
+
+def test_coalesce_leader_publishes_into_cache(volume, monkeypatch):
+    """N concurrent degraded reads of one interval: the coalesce leader's
+    single decode lands in the cache, and a LATER read is served from it
+    byte-identically with zero additional decodes."""
+    base, records = volume
+    with open(stripe.shard_file_name(base, 0), "rb") as f:
+        golden0 = f.read()
+    drop_shards(base, [0])
+    enable_cache(monkeypatch)
+    with open_vol(base, recover_fetch_parallelism=16) as ev:
+        decodes = []
+        real = ev.encoder.reconstruct
+
+        def counting(shards, wanted=None, **kw):
+            decodes.append(1)
+            return real(shards, wanted=wanted, **kw)
+
+        monkeypatch.setattr(ev.encoder, "reconstruct", counting)
+        results, barrier = [], threading.Barrier(5)
+        lock = threading.Lock()
+
+        def one():
+            barrier.wait()
+            out = ev._recover_interval(0, 0, 64).tobytes()
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=one) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+        assert len(results) == 5
+        assert all(r == golden0[:64] for r in results)
+        assert CACHE.snapshot()["entries"] >= 1, "leader did not publish"
+        n_decodes = len(decodes)
+        # the read ladder now serves the interval from the cache: no new
+        # decode, bytes identical to the leader's
+        late = ev._read_present(0, 0, 64)
+        assert late is not None and late.tobytes() == golden0[:64]
+        assert len(decodes) == n_decodes
+
+
+def test_cache_hits_feed_no_decode_or_hedge_stats(volume, monkeypatch):
+    """Stats purity: a hit returns before the fan-out, so repeated hot
+    reads move ONLY the hit counter — never the reconstruct/degraded
+    histograms, the hedge counters, or the coalesce counter."""
+    base, records = volume
+    drop_shards(base, [0, 1])
+    enable_cache(monkeypatch)
+    monkeypatch.setenv("WEEDTPU_HEDGE_READS", "1")
+    with open_vol(base) as ev:
+        warm = {nid: ev.read_needle_blob(nid) for nid in records}  # decode once
+        rec0 = stats.EcReconstructSeconds.labels().total
+        deg0 = stats.DegradedReadSeconds.labels().total
+        hed0 = stats.HedgeFired.value
+        coa0 = stats.CoalescedReads.value
+        h0 = stats.ReadCacheHits.value
+        for _ in range(3):
+            for nid in records:
+                assert ev.read_needle_blob(nid) == warm[nid]
+        assert stats.ReadCacheHits.value > h0
+        assert stats.EcReconstructSeconds.labels().total == rec0, "hit observed a decode"
+        assert stats.DegradedReadSeconds.labels().total == deg0, "hit observed degraded latency"
+        assert stats.HedgeFired.value == hed0, "hit fired a hedge"
+        assert stats.CoalescedReads.value == coa0
+
+
+def test_quarantine_flushes_volume_entries(volume, monkeypatch):
+    base, records = volume
+    drop_shards(base, [0])
+    enable_cache(monkeypatch)
+    with open_vol(base) as ev:
+        for nid in records:
+            ev.read_needle_blob(nid)
+        assert CACHE.snapshot()["entries"] >= 1
+        inv0 = stats.ReadCacheInvalidations.value
+        ev.quarantine_shard(5, "corrupt")
+        assert CACHE.snapshot()["entries"] == 0, "quarantine left stale intervals"
+        assert stats.ReadCacheInvalidations.value > inv0
+        # reads still serve, by re-decoding — never from the flushed cache
+        rec0 = stats.EcReconstructSeconds.labels().total
+        for nid, (off, size, rec) in records.items():
+            assert ev.read_needle_blob(nid)[: len(rec)] == rec
+        assert stats.EcReconstructSeconds.labels().total > rec0
+
+
+def test_shard_remount_flushes_that_shard(volume, monkeypatch):
+    """mount_local_shard is the repair path's remount-after-rebuild: the
+    rebuilt file is authoritative, cached decodes of that shard must go."""
+    base, records = volume
+    shutil.copy(stripe.shard_file_name(base, 0), base + ".ec00.save")
+    drop_shards(base, [0])
+    enable_cache(monkeypatch)
+    with open_vol(base) as ev:
+        ev._read_shard_interval(0, 0, 64)  # decode + publish for shard 0
+        assert any(k[1] == 0 for k in CACHE._entries), "no shard-0 entry cached"
+        shutil.copy(base + ".ec00.save", stripe.shard_file_name(base, 0))
+        assert ev.mount_local_shard(0)
+        assert not any(k[1] == 0 for k in CACHE._entries), (
+            "remount left stale shard-0 intervals"
+        )
+
+
+def test_unmount_and_remount_cutover_serves_fresh_bytes(tmp_path, monkeypatch):
+    """The close() seam (Store.mount_ec_volume remount / unmount — the
+    same seam ec.convert's cut-over routes through): re-encode the volume
+    with DIFFERENT contents under the same base, remount, and prove the
+    read serves the new bytes, not the cached decode of the old ones."""
+    enable_cache(monkeypatch)
+    nid = 77
+
+    def build(seed):
+        base = str(tmp_path / "v5")
+        for f in os.listdir(tmp_path):
+            if f.startswith("v5"):
+                os.remove(tmp_path / f)
+        rng = np.random.default_rng(seed)
+        body = 200
+        total = types.actual_size(body, version=3)
+        rec = rng.integers(0, 256, size=total, dtype=np.uint8).tobytes()
+        with open(base + ".dat", "wb") as f:
+            f.write(b"\x03" + bytes(7) + rec)
+        idx_mod.write_entries(
+            [(nid, types.offset_to_bytes(types.NEEDLE_PADDING_SIZE), body)],
+            base + ".idx",
+        )
+        stripe.write_ec_files(base, large_block_size=LARGE, small_block_size=SMALL, buffer_size=64, encoder=ENC)
+        stripe.write_sorted_file_from_idx(base)
+        os.remove(stripe.shard_file_name(base, 0))  # force a degraded read
+        return base, rec
+
+    base, old_rec = build(1)
+    ev = open_vol(base)
+    assert ev.read_needle_blob(nid)[: len(old_rec)] == old_rec
+    assert CACHE.snapshot()["entries"] >= 1
+    ev.close()  # the unmount/cut-over seam
+    assert CACHE.snapshot()["entries"] == 0, "close() left stale intervals"
+
+    base, new_rec = build(2)
+    assert new_rec != old_rec
+    with open_vol(base) as ev2:
+        got = ev2.read_needle_blob(nid)
+        assert got[: len(new_rec)] == new_rec, "stale pre-cut-over bytes served"
+
+
+def test_inline_delta_update_flushes_volume(tmp_path, monkeypatch):
+    """The PR-12 seam: an inline-ingest overwrite folds a delta into the
+    encoded rows — cached decodes of this base describe the old bytes and
+    must be flushed (and the generation bump must block a concurrent
+    publish that gathered pre-delta survivors)."""
+    from seaweedfs_tpu.ec.constants import DATA_SHARDS_COUNT
+
+    enable_cache(monkeypatch)
+    base = os.path.join(str(tmp_path), "v", "7")
+    os.makedirs(os.path.dirname(base), exist_ok=True)
+    data = np.random.default_rng(3).integers(
+        0, 256, LARGE * DATA_SHARDS_COUNT * 2 + 777, dtype=np.uint8
+    ).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+    b = ingest.InlineStripeBuilder(base, ENC, LARGE, SMALL, buffer_size=64)
+    b.poll()
+    assert b.rows_done == 2
+    # a decoded interval for this base sits cached (as if a degraded read
+    # of a spread-ahead shard had happened)
+    gen = CACHE.generation(base)
+    CACHE.put(base, 0, 0, 16, b"x" * 16, gen)
+    assert CACHE.snapshot()["entries"] == 1
+    new = b"\x5a" * 64
+
+    def mutate():
+        with open(base + ".dat", "r+b") as f:
+            f.write(new)
+
+    assert b.overwrite(0, data[:64], new, mutate=mutate) == 64
+    assert CACHE.snapshot()["entries"] == 0, "delta update left stale intervals"
+    # the generation moved: a decode that started before the delta (its
+    # snapshot is `gen`) must be refused
+    assert not CACHE.put(base, 1, 0, 16, b"y" * 16, gen)
+    assert CACHE.put(base, 1, 0, 16, b"y" * 16, CACHE.generation(base))
+    b.abort()
+
+
+def test_lru_bound_and_ttl(monkeypatch):
+    """The WEEDTPU_READ_CACHE_MB budget evicts LRU-first and the TTL ages
+    entries out (the decode-once-per-epoch bound)."""
+    enable_cache(monkeypatch, mb=str(4096 / (1 << 20)))  # 4 KiB budget
+    ev0 = stats.ReadCacheEvictions.value
+    gen = CACHE.generation("b")
+    for i in range(8):
+        assert CACHE.put("b", 0, i * 1024, 1024, bytes(1024), gen)
+    snap = CACHE.snapshot()
+    assert snap["bytes"] <= 4096 and snap["entries"] == 4
+    assert stats.ReadCacheEvictions.value - ev0 == 4
+    assert CACHE.get("b", 0, 0, 1024) is None          # evicted (oldest)
+    assert CACHE.get("b", 0, 7 * 1024, 1024) is not None  # newest survived
+
+    monkeypatch.setenv("WEEDTPU_READ_CACHE_TTL_S", "0.05")
+    time.sleep(0.06)
+    assert CACHE.get("b", 0, 7 * 1024, 1024) is None, "TTL did not expire entry"
+    assert stats.ReadCacheEvictions.value - ev0 == 5
+
+
+def test_cache_disabled_is_fully_bypassed(volume, monkeypatch):
+    """WEEDTPU_READ_CACHE_MB=0 (the tests' default): no lookups, no
+    publishes, no counters — the pre-PR-16 read path exactly."""
+    base, records = volume
+    drop_shards(base, [0])
+    h0, m0 = stats.ReadCacheHits.value, stats.ReadCacheMisses.value
+    with open_vol(base) as ev:
+        for nid, (off, size, rec) in records.items():
+            assert ev.read_needle_blob(nid)[: len(rec)] == rec
+            assert ev.read_needle_blob(nid)[: len(rec)] == rec
+    assert CACHE.snapshot() == {"entries": 0, "bytes": 0}
+    assert (stats.ReadCacheHits.value, stats.ReadCacheMisses.value) == (h0, m0)

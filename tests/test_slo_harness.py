@@ -75,6 +75,41 @@ def test_recorder_phases_classes_and_errors():
     assert merged.total == 2 and merged.errors == 1
 
 
+def test_recorder_round_trips_and_merges_across_processes():
+    """to_dict/merge_dict is the multi-process generator contract: a
+    worker ships its cells as JSON and the driver folds them in. The
+    merge must be bucket-exact (same quantiles as observing locally)
+    and refuse cells from a different bucket layout."""
+    a, b = slo.LatencyRecorder(), slo.LatencyRecorder()
+    local = slo.LatencyRecorder()
+    rng = np.random.default_rng(11)
+    for s in np.exp(rng.normal(-4.0, 1.0, size=2000)):
+        a.observe("steady", "cached", float(s))
+        local.observe("steady", "cached", float(s))
+    for s in np.exp(rng.normal(-3.0, 1.0, size=500)):
+        b.observe("chaos", "degraded", float(s))
+        local.observe("chaos", "degraded", float(s))
+    b.error("chaos", "degraded")
+    local.error("chaos", "degraded")
+
+    merged = slo.LatencyRecorder()
+    # JSON round-trip exactly as the worker files do
+    merged.merge_dict(json.loads(json.dumps(a.to_dict())))
+    merged.merge_dict(json.loads(json.dumps(b.to_dict())))
+    for klass in ("cached", "degraded"):
+        want, got = local.merged(klass), merged.merged(klass)
+        assert got.total == want.total and got.errors == want.errors
+        for q in (0.5, 0.99):
+            assert got.quantile(q) == want.quantile(q)
+    # a cell serialized by a different code version (bucket layout
+    # mismatch) must be rejected loudly, not merged wrong
+    bad = a.to_dict()
+    key = next(iter(bad))
+    bad[key]["counts"] = bad[key]["counts"][:-1]
+    with pytest.raises(ValueError, match="bucket count mismatch"):
+        slo.LatencyRecorder().merge_dict(bad)
+
+
 def test_slo_verdict_and_report_schema(tmp_path):
     rec = slo.LatencyRecorder()
     for _ in range(30):
@@ -160,9 +195,44 @@ def test_weedload_smoke_schema_and_zero_loss(tmp_path):
     assert all(t["root"].get("spans") is not None or t["kind"]
                for t in attrib["slowest"])
     # the leave-tracing-ON design claim, measured: trace-on healthy
-    # p99/throughput within 5% of trace-off on the same live cluster
+    # p99/throughput within 5% of trace-off on the same live cluster, or
+    # within the absolute per-read floor (loopback reads are so cheap
+    # that tracing's fixed few-dozen-µs cost can exceed 5% relatively
+    # while staying invisible against any real ms-scale read)
     overhead = report["trace_overhead"]
     assert overhead["ok"], f"tracing overhead gate failed: {overhead}"
+    # hot-set serving: the decoded-interval cache must actually engage
+    # under the zipf hot set (weedload itself exits 1 when hits == 0 —
+    # these assertions pin the artifact evidence, not just the exit code)
+    cache = report["cache"]
+    assert cache["hits"] >= 1 and cache["hit_rate"] is not None
+    assert cache["budget_mb"] > 0
+    # the read-class header routed cache hits into their own class, so
+    # `degraded` in this artifact means reads that actually decoded
+    assert report["overall"]["cached"]["count"] > 0
+
+
+def test_weedload_smoke_s3_front(tmp_path):
+    """weedload --front s3: the same open-loop harness through the S3
+    gateway (signed V4 requests -> s3api -> filer -> volume tier), with
+    classes derived from the objects' chunk fids. The EC'd volume lives
+    in the bucket's collection (`load_<vid>` on disk) — this smoke is
+    what catches a harness that only handles the default collection."""
+    weedload = _load_script("weedload")
+    out = tmp_path / "SLO_smoke_s3.json"
+    t0 = time.monotonic()
+    rc = weedload.main(["--smoke", "--front", "s3", "--out", str(out)])
+    took = time.monotonic() - t0
+    assert rc == 0, "s3-front smoke lost bytes or crashed"
+    assert took < 40.0, f"s3 smoke must stay inside the CI budget ({took:.1f}s)"
+    report = json.loads(out.read_text())
+    assert report["lost"] == [] and report["ok"]
+    assert report["workload"]["front"] == "s3"
+    by_class = report["workload"]["objects_by_class"]
+    assert by_class["healthy"] > 0 and by_class["degraded"] > 0
+    # degraded chunk reads reconstructed server-side through the gateway
+    assert report["counters"]["weedtpu_degraded_read_seconds_count"] > 0
+    assert report["overall"]["degraded"]["count"] > 0
 
 
 # -- in-process cluster for server-side checks --------------------------------
@@ -230,6 +300,12 @@ def test_rebuild_admission_gate_counts_waits(tmp_path, monkeypatch):
                         "shard_id": i,
                         "offset": 0,
                         "size": len(golden[i]),
+                        # small chunks: each stream spans several frames, so
+                        # the 50 ms inter-chunk yield keeps the token held
+                        # long enough that the streams MUST overlap (a
+                        # single-chunk stream can finish before the second
+                        # thread is even scheduled — a coin-flip on 1 core)
+                        "chunk_size": 64 * 1024,
                     },
                     timeout=60,
                 )
