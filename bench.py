@@ -489,6 +489,152 @@ def mode_cpu() -> None:
 
 
 # ---------------------------------------------------------------------------
+# stage 2i: compiled XOR-schedule backend vs the native library (child)
+# ---------------------------------------------------------------------------
+
+
+def _min_time(fn, iters: int, warmup: int = 1) -> float:
+    """min-of-iters wall time: the xorsched-vs-native gate is a SAME-RUN
+    ratio on a shared noisy box, and min is the estimator least polluted
+    by scheduler preemption (median still absorbs a slow neighbor)."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _xor_matrix_forms(enc):
+    """The four matrix shapes Encoder dispatches, as (name, matrix) —
+    everything the schedule compiler must lower byte-exactly."""
+    import numpy as np
+
+    survivors = [i for i in range(14) if i not in (2, 11)][:10]
+    decode = enc.reconstruction_matrix(survivors, [2, 11])
+    plan = enc.repair_projection_plan(survivors, [2, 11])
+    local = survivors[:5]  # a holder owning 5 of the survivors
+    projection = np.stack([plan[s] for s in local], axis=1)
+    delta = enc.parity_matrix[:, [3]]  # generator column: rank-1 update
+    return [
+        ("encode", enc.parity_matrix),
+        ("decode", decode),
+        ("projection", projection),
+        ("delta", delta),
+    ]
+
+
+def mode_xor(smoke: bool = False) -> None:
+    """BENCH_MODE=xor: the compiled XOR-schedule backend (ops/xorsched)
+    vs the native AVX2 library, measured in the SAME run so the committed
+    ratio is noise-immune (both numbers move with the box together).
+    Compile and execute are reported separately — the schedule is built
+    once per (matrix, tile) and cached, so steady-state cost is execute
+    only. Every form is byte-verified against the gf8 numpy golden before
+    any throughput number is trusted: `match` gates promotion in
+    rs_codec.pick_cpu_backend. `--smoke` is the deterministic tier-1
+    variant: byte-verification across tail-exercising widths, no timing
+    (and no `when` stamp, so the output is stable run to run)."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops import gf8, xorsched
+    from seaweedfs_tpu.ops.rs_codec import Encoder, _host_fingerprint
+    from seaweedfs_tpu.utils import config, native
+
+    enc = Encoder(10, 4, backend="numpy")  # matrices only; no dispatch here
+    forms = _xor_matrix_forms(enc)
+    out: dict = {
+        "host": _host_fingerprint(),
+        "native_level": xorsched.native_level(),
+        "tile_kb": config.env("WEEDTPU_XORSCHED_TILE_KB"),
+    }
+    if not smoke:
+        out["when"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    # compile pass: fresh cache, per-form compile time + schedule stats
+    xorsched.clear_schedule_cache()
+    compile_info: dict = {}
+    progs: dict = {}
+    for name, m in forms:
+        t0 = time.perf_counter()
+        prog = xorsched.get_schedule(m)
+        compile_info[f"{name}_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        compile_info[f"{name}_xors"] = prog.xor_count
+        compile_info[f"{name}_raw_xors"] = prog.raw_xors
+        compile_info[f"{name}_temps"] = prog.n_temps
+        progs[name] = prog
+    out["compile"] = compile_info
+
+    # byte-verification: interpreter AND native executor vs the gf8 golden,
+    # across widths that exercise full tiles, partial tiles, and the
+    # sub-8-symbol scalar tails
+    match = True
+    verify: dict = {}
+    widths = [1, 7, 31, 512, 4097] if smoke else [4097, 65536 + 488]
+    rng = np.random.default_rng(0)
+    for name, m in forms:
+        ok = True
+        for n in widths:
+            stack = rng.integers(0, 256, size=(m.shape[1], n), dtype=np.uint8)
+            golden = gf8.gf_mat_vec(m, stack)
+            interp = np.stack(xorsched.apply(progs[name], list(stack)))
+            ok = ok and bool((interp == golden).all())
+            nat = xorsched.apply_native(progs[name], list(stack))
+            if nat is not None:
+                ok = ok and bool((np.stack(nat) == golden).all())
+        verify[name] = ok
+        match = match and ok
+    out["verify"] = verify
+    out["match"] = match
+    out["cache"] = xorsched.schedule_cache_info()
+    if smoke:
+        out["ok"] = match
+        _emit(out)
+        return
+
+    # throughput: xorsched native executor vs the AVX2 library, same data,
+    # same run, min-of-iters (GB/s counts INPUT shard bytes / wall time,
+    # matching _measure_avx2's convention)
+    n = 8 << 20
+    have_native_lib = native.load() is not None
+    for name, m in forms:
+        if name == "delta":
+            continue  # 1-column rank-1 update: latency path, not bandwidth
+        stack = rng.integers(0, 256, size=(m.shape[1], n), dtype=np.uint8)
+        sec: dict = {}
+        if xorsched.native_available():
+            ins = list(stack)
+            t = _min_time(lambda: xorsched.apply_native(progs[name], ins), iters=5)
+            sec["xorsched_gbps"] = round(m.shape[1] * n / t / 1e9, 3)
+        if have_native_lib:
+            bufs = [s.tobytes() for s in stack]
+            t = _min_time(
+                lambda: native.gf_matrix_apply_native(m, bufs, n), iters=5
+            )
+            sec["native_gbps"] = round(m.shape[1] * n / t / 1e9, 3)
+        if "xorsched_gbps" in sec and "native_gbps" in sec:
+            sec["ratio"] = round(sec["xorsched_gbps"] / sec["native_gbps"], 2)
+        out[name] = sec
+
+    # the interpreter floor, small width + one iter: it exists as the
+    # byte-exact oracle and stale-.so fallback, not as a fast path
+    small = rng.integers(0, 256, size=(10, 1 << 20), dtype=np.uint8)
+    ins_small = list(small)
+    t = _min_time(lambda: xorsched.apply(progs["encode"], ins_small), iters=1, warmup=0)
+    out["encode"]["interp_gbps"] = round(10 * (1 << 20) / t / 1e9, 3)
+
+    enc_sec = out.get("encode", {})
+    dec_sec = out.get("decode", {})
+    out["gate"] = {
+        "encode_2x": bool(enc_sec.get("ratio", 0) >= 2.0),
+        "decode_parity": bool(dec_sec.get("ratio", 0) >= 1.0),
+    }
+    _emit(out)
+
+
+# ---------------------------------------------------------------------------
 # stage 2c: remote degraded-read ladder (child, JAX_PLATFORMS=cpu)
 # ---------------------------------------------------------------------------
 
@@ -2015,6 +2161,19 @@ def main() -> None:
     else:
         result["ec_ingest_error"] = ing_err
 
+    # stage 2i: compiled XOR-schedule backend vs the native library (the
+    # committed section rs_codec.pick_cpu_backend promotes on: same-run
+    # xorsched/native ratio, host fingerprint, byte-verification)
+    xor, xor_err = _run_child(
+        "xor",
+        timeout=min(300, max(30, int(deadline - time.monotonic()))),
+        extra_env={"JAX_PLATFORMS": "cpu"},
+    )
+    if xor:
+        result["xor"] = xor
+    else:
+        result["xor_error"] = xor_err
+
     # stage 2d: dp-scaling sweep over the virtual 8-device CPU mesh
     if deadline - time.monotonic() > 30:
         dp, dp_err = _run_child(
@@ -2177,6 +2336,14 @@ def main() -> None:
         result["auto_backend_on_tpu"] = pick_device_backend()[1]
     except Exception as e:  # noqa: BLE001
         result["auto_backend_on_tpu_error"] = str(e)[:200]
+    # the CPU-side twin: what new_encoder("auto") will select on a plain
+    # CPU host from committed BENCH xor evidence, and why
+    try:
+        from seaweedfs_tpu.ops.rs_codec import pick_cpu_backend
+
+        result["auto_backend_on_cpu"] = pick_cpu_backend()[1]
+    except Exception as e:  # noqa: BLE001
+        result["auto_backend_on_cpu_error"] = str(e)[:200]
     result["vs_baseline"] = round(result["value"] / TARGET_GBPS, 4)
     _emit(result)
 
@@ -2197,6 +2364,8 @@ if __name__ == "__main__":
         mode_ingest()
     elif mode == "convert":
         mode_convert()
+    elif mode == "xor":
+        mode_xor(smoke="--smoke" in sys.argv)
     elif mode == "dp":
         mode_dp()
     elif mode == "mesh":

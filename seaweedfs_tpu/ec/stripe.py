@@ -20,6 +20,12 @@ from disk, with no per-batch host allocation (readinto straight into the
 staging ring, buffer donation releasing batch HBM early on device
 backends) and the
 per-shard CRC32 folded into the same pass so shard bytes are touched once.
+
+The engine is backend-agnostic through the Encoder seam: the same flat
+(shards, width) dispatch shape serves the device paths (jax/pallas/mesh)
+and the CPU floor — including the compiled XOR-schedule backend
+(ops/xorsched), whose width-axis cache tiling happens INSIDE the dispatch,
+so the staging-batch geometry here needs no backend-specific casing.
 """
 
 from __future__ import annotations

@@ -42,7 +42,7 @@ EVIDENCE_MAX_AGE_DAYS = config.env("WEEDTPU_EVIDENCE_MAX_AGE_DAYS")
 #: single-client TPU tunnel) needs no rs_pallas/jax import.
 FUSED_VARIANTS = ("int8", "bf16", "u8", "mplane", "dma")
 
-_BACKENDS = ("numpy", "native", "jax", "pallas", "mesh")
+_BACKENDS = ("numpy", "native", "xorsched", "jax", "pallas", "mesh")
 
 
 # -- code-family registry (the geometry-flexible seam) ------------------------
@@ -278,6 +278,8 @@ class Encoder:
             if out is not None:
                 return out
             # library unavailable/unbuildable: numpy keeps serving
+        if self.backend == "xorsched":
+            return self._apply_xorsched(m, shards)
         if shards.ndim == 3:
             return np.moveaxis(gf8.gf_mat_vec(m, np.moveaxis(shards, 0, 1)), 1, 0)
         return gf8.gf_mat_vec(m, shards)
@@ -298,6 +300,32 @@ class Encoder:
         # batched: one library call with per-element slice pointers — one
         # worker pool for the whole flush and zero host-side repacking
         return native_mod.gf_matrix_apply_batch_native(m, shards, threads=0)
+
+    @staticmethod
+    def _apply_xorsched(m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        """Compiled XOR-schedule apply (ops/xorsched): the GF(2^8) matrix is
+        lowered once to a binary bit-plane XOR program (bounded LRU keyed by
+        matrix bytes + tile geometry) and replayed over the shard widths.
+        Never returns None — the numpy bulk-XOR interpreter inside xorsched
+        is the always-available floor when libweedtpu.so lacks the
+        weedtpu_xor_schedule_apply entry point."""
+        from seaweedfs_tpu.ops import xorsched
+
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if shards.ndim == 2:
+            out = np.stack(xorsched.apply_matrix(m, list(shards)))
+        else:
+            out = np.stack(
+                [np.stack(xorsched.apply_matrix(m, list(b))) for b in shards]
+            )
+        try:
+            from seaweedfs_tpu import stats
+
+            for event, v in xorsched.schedule_cache_info().items():
+                stats.XorschedCache.labels(event).set(v)
+        except Exception:  # noqa: BLE001 — metrics must never break dispatch
+            pass
+        return out
 
     def _apply(self, m: np.ndarray, shards: np.ndarray) -> np.ndarray:
         """Apply GF matrix m (R x C) to a shard stack (C, N) -> (R, N) or a
@@ -577,7 +605,7 @@ class Encoder:
         return np.asarray(self._apply_lazy(m, stack))
 
     def _bucket_for(self, n: int) -> Optional[int]:
-        if self.backend in ("numpy", "native") or n == 0:
+        if self.backend in ("numpy", "native", "xorsched") or n == 0:
             return None  # host backends have no compile cache to miss —
             # padding would only make the AVX2 kernel chew dead bytes
         for b in self.RECONSTRUCT_BUCKETS:
@@ -603,8 +631,9 @@ class Encoder:
         read never pays an XLA compile (jit caches key on shapes only — any
         GF matrix of the right shape covers every decode matrix). Returns
         the number of shapes compiled (0 on the host backends)."""
-        if self.backend in ("numpy", "native"):
-            return 0  # no XLA compile cache to warm
+        if self.backend in ("numpy", "native", "xorsched"):
+            return 0  # no XLA compile cache to warm (xorsched's schedule
+            # LRU fills on first dispatch; compiles are ~100ms host-side)
         count = 0
         for L in wanted_counts:
             m = self.gen_matrix[: max(1, L), : self.data_shards]
@@ -973,6 +1002,178 @@ def pick_mesh_backend(
     return True, decision
 
 
+# -- committed CPU bench evidence (the xorsched promotion input) --------------
+
+
+def _host_fingerprint() -> dict:
+    """Identity of THIS host for same-host evidence matching: cpu model
+    string + logical core count. Hostnames are ephemeral in the fleet;
+    the model+cores pair is what decides whether a committed BENCH number
+    was measured on silicon equivalent to the one now selecting."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    if not model:
+        import platform
+
+        model = platform.processor() or platform.machine() or ""
+    return {"cpu": model, "cores": int(os.cpu_count() or 0)}
+
+
+def load_cpu_bench_evidence(art_dir: Optional[str] = None) -> Optional[dict]:
+    """Newest committed `BENCH_r*.json` (repo root, beside MULTICHIP_r*)
+    whose payload carries an `xor` section, unwrapped from the round
+    wrapper ({"n", "cmd", "rc", "tail", "parsed"}) when present, with
+    `_file` recording provenance. Rounds without an xor section are
+    skipped rather than treated as de-promoting evidence: most bench
+    rounds measure other subsystems, and the newest XOR measurement is
+    the current truth for the xorsched decision. None when no readable
+    artifact carries one."""
+    art_dir = art_dir or _multichip_dir()
+    try:
+        names = sorted(
+            f
+            for f in os.listdir(art_dir)
+            if f.startswith("BENCH_r") and f.endswith(".json")
+        )
+    except OSError:
+        return None
+    for name in reversed(names):
+        try:
+            import json
+
+            with open(os.path.join(art_dir, name), encoding="utf-8") as f:
+                ev = json.load(f)
+            if not isinstance(ev, dict):
+                continue
+            if isinstance(ev.get("parsed"), dict):
+                ev = dict(ev["parsed"], n=ev.get("n"))
+            if isinstance(ev.get("xor"), dict):
+                ev["_file"] = name
+                return ev
+        except (OSError, ValueError):
+            continue  # an unreadable newest artifact must not hide older ones
+    return None
+
+
+def pick_cpu_backend(art_dir: Optional[str] = None) -> tuple[str, dict]:
+    """The CPU-floor promotion decision: flip `auto`'s plain-CPU pick from
+    the AVX2 library to the compiled XOR-schedule backend ONLY when a
+    committed `BENCH_r*.json` carries a fresh SAME-HOST byte-verified
+    measurement in which xorsched's encode beats the native number
+    recorded in the SAME run (shared boxes are noisy — both numbers move
+    with the noise together, so the committed ratio is the evidence;
+    cross-host or cross-run comparisons never are). Absent, stale,
+    other-host, unverified, or losing evidence keeps `_cpu_backend()`'s
+    pick, and so does a libweedtpu.so predating the xor executor entry
+    point — the pure-numpy interpreter cannot beat AVX2, only the
+    GFNI/AVX2 transpose path can. The decision dict mirrors
+    pick_device_backend's: evidence file/round, both numbers, reason."""
+    base = _cpu_backend()
+    ev = load_cpu_bench_evidence(art_dir)
+    if ev is None:
+        return base, {
+            "backend": base,
+            "reason": "no committed CPU bench evidence with an xor section",
+        }
+    xor = ev["xor"]
+    decision: dict = {
+        "evidence_file": ev.get("_file"),
+        "evidence_round": _evidence_round(ev),
+    }
+    age = _evidence_age_days(xor)
+    if age is None:
+        decision.update(
+            backend=base,
+            reason=(
+                f"xor evidence age unparseable (when={xor.get('when')!r}): "
+                "treated as stale"
+            ),
+        )
+        return base, decision
+    if age > EVIDENCE_MAX_AGE_DAYS:
+        decision.update(
+            backend=base,
+            reason=f"xor evidence stale ({age:.0f}d > {EVIDENCE_MAX_AGE_DAYS:.0f}d)",
+        )
+        return base, decision
+    host = xor.get("host") or {}
+    here = _host_fingerprint()
+    if (
+        str(host.get("cpu", "")) != here["cpu"]
+        or int(host.get("cores") or 0) != here["cores"]
+    ):
+        decision.update(
+            backend=base,
+            reason=(
+                f"evidence measured on a different host "
+                f"({host.get('cpu')!r} x{host.get('cores')}): not transferable"
+            ),
+        )
+        return base, decision
+    if xor.get("match") is not True:
+        # only a run that COMPLETED byte-verification against the numpy
+        # oracle is evidence — a fast-but-wrong executor must not promote
+        decision.update(
+            backend=base,
+            reason="xor evidence did not complete byte-verification",
+        )
+        return base, decision
+    enc = xor.get("encode") or {}
+    xs = enc.get("xorsched_gbps")
+    nat = enc.get("native_gbps")
+    decision["xorsched_gbps"] = float(xs) if isinstance(xs, (int, float)) else None
+    decision["native_gbps"] = float(nat) if isinstance(nat, (int, float)) else None
+    if (
+        not isinstance(xs, (int, float))
+        or not isinstance(nat, (int, float))
+        or nat <= 0
+    ):
+        decision.update(
+            backend=base,
+            reason="xor evidence lacks a same-run xorsched/native encode pair",
+        )
+        return base, decision
+    if xs <= nat:
+        decision.update(
+            backend=base,
+            reason=(
+                f"committed xorsched encode {xs} does not beat "
+                f"same-run native {nat}"
+            ),
+        )
+        return base, decision
+    try:
+        from seaweedfs_tpu.ops import xorsched as _xs_mod
+
+        native_ok = _xs_mod.native_available()
+    except Exception:  # noqa: BLE001 — a broken probe must not break auto
+        native_ok = False
+    if not native_ok:
+        decision.update(
+            backend=base,
+            reason=(
+                "libweedtpu.so lacks weedtpu_xor_schedule_apply "
+                "(stale binary: run make -C native): library path keeps serving"
+            ),
+        )
+        return base, decision
+    decision.update(
+        backend="xorsched",
+        reason=(
+            f"committed same-host bench: xorsched encode {xs} beats "
+            f"same-run native {nat}"
+        ),
+    )
+    return "xorsched", decision
+
+
 def _export_selection(selection: dict) -> None:
     """Mirror the factory's decision into the Prometheus registry: the
     previously-selected label (if any) drops to 0 so a scrape shows ONE
@@ -1037,6 +1238,14 @@ def new_encoder(
     per-chip decision chose. backend="mesh" forces the mesh path with
     `WEEDTPU_MESH_SHAPE`/`WEEDTPU_MESH_REBUILD` (or evidence/default)
     config; the selection audit records the mesh shape and evidence round.
+
+    CPU promotion: on plain-CPU hosts `pick_cpu_backend` extends the same
+    evidence rule to the compiled XOR-schedule backend — a fresh committed
+    `BENCH_r*.json` xor section measured on THIS host (cpu model + cores
+    fingerprint) in which xorsched's byte-verified encode beats the native
+    AVX2 number from the same run flips `auto` to "xorsched"; absent,
+    stale, other-host, or losing evidence keeps the AVX2 library (numpy
+    when it can't load).
     """
     if family is not None:
         geom = geometry_for(family)
@@ -1107,10 +1316,15 @@ def new_encoder(
                     reason=f"non-TPU accelerator ({d.platform}): XLA path",
                 )
             else:
-                backend = _cpu_backend()
-                selection.update(
-                    backend=backend, source="platform",
-                    reason="cpu host: AVX2 library when loadable, else numpy",
+                backend, cpu_dec = pick_cpu_backend()
+                selection.update(cpu_dec)
+                # provenance must be honest: promotion (or an explicit
+                # keep-native verdict) backed by a committed artifact is
+                # evidence; everything else is the platform default
+                selection["source"] = (
+                    "cpu-bench-evidence"
+                    if cpu_dec.get("evidence_file")
+                    else "platform"
                 )
             if n_dev > 1 and "mesh" not in selection:
                 # audit-only on non-TPU multi-device hosts: the decision
@@ -1126,11 +1340,22 @@ def new_encoder(
                     )
                 selection["mesh"] = mesh_dec
         except Exception:
-            backend = _cpu_backend()
-            selection.update(
-                backend=backend, source="platform",
-                reason="no jax backend: cpu fallback",
-            )
+            # jax-free hosts still honor committed CPU bench evidence —
+            # pick_cpu_backend touches only os/json/ctypes
+            try:
+                backend, cpu_dec = pick_cpu_backend()
+                selection.update(cpu_dec)
+                selection["source"] = (
+                    "cpu-bench-evidence"
+                    if cpu_dec.get("evidence_file")
+                    else "platform"
+                )
+            except Exception:  # noqa: BLE001 — the factory must not fail
+                backend = _cpu_backend()
+                selection.update(
+                    backend=backend, source="platform",
+                    reason="no jax backend: cpu fallback",
+                )
     else:
         selection.setdefault("backend", backend)
         selection.setdefault("source", "explicit")

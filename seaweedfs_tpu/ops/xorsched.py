@@ -1,0 +1,371 @@
+"""xorsched — compiled XOR-schedule realization of the GF(2^8) matrix apply.
+
+Any matrix the `Encoder` dispatches (encode parity, fused decode, projection
+column-slice, delta-parity column block) is lowered through gf8's bit-plane
+decomposition (`gf_matrix_to_bits`) into a binary 8R x 8C matrix over GF(2):
+with shard bytes viewed as 8 packed bit-planes, every output bit-plane is the
+XOR of a fixed subset of input bit-planes.  The compiler emits that XOR
+program once per (matrix bytes, tile geometry) and caches it in a bounded
+LRU, exactly like the decode-matrix memo in rs_codec:
+
+* grouping pass — the most frequent source-pair across all outputs is
+  hoisted into a reused temporary (greedy common-subexpression elimination,
+  after "Accelerating XOR-based Erasure Coding using Program Optimization
+  Techniques").  Pairs are only hoisted while they appear >= _GROUP_THRESHOLD
+  times: a temp used twice costs one extra store per use saved, so the
+  break-even is three uses, and threshold 3 measures ~8% less schedule
+  memory traffic than threshold 2 on the 10+4 Cauchy matrix.
+* cache tiling — execution walks the width axis in tiles sized so the whole
+  slot frame (inputs + temps + outputs, tile/8 bytes per plane) stays
+  cache-resident; ops are replayed per tile, not per buffer.
+
+Two executors share the program:
+
+* `apply` — pure-numpy bulk-XOR interpreter.  Always available; the
+  byte-exact oracle the native path and the tests verify against.
+* `apply_native` — `weedtpu_xor_schedule_apply` in libweedtpu.so (flat op
+  list, SIMD XOR over contiguous tiles; GFNI/AVX-512 bit-plane transposes
+  where the host has them, AVX2 otherwise, scalar everywhere else).  The
+  symbol is version-probed so an old .so quietly yields the interpreter
+  instead of crashing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from seaweedfs_tpu.ops import gf8
+from seaweedfs_tpu.utils import config
+
+# Hoist a source-pair into a temp only while it recurs at least this often
+# (see module docstring for the traffic break-even).
+_GROUP_THRESHOLD = 3
+
+
+@dataclass(frozen=True)
+class XorProgram:
+    """A compiled XOR schedule for one GF(2^8) matrix.
+
+    Slot space: [0, 8*cols) are the input bit-planes (plane 8c+i = bit i of
+    input shard c), temps follow, and [out_base, out_base + 8*rows) are the
+    output bit-planes.  `ops` is the flat op list the executors replay, each
+    op encoded as [dest_slot, n_src, src_slot...]; n_src == 0 zero-fills
+    (an all-zero matrix row) and n_src == 1 copies (an identity row).
+    """
+
+    rows: int
+    cols: int
+    n_slots: int
+    out_base: int
+    ops: np.ndarray  # int32, flat [dest, nsrc, srcs...] records
+    tile_sym: int  # symbols (bytes per shard) processed per tile
+    raw_xors: int  # XOR count of the ungrouped program
+    xor_count: int  # XOR count after the grouping pass
+    n_temps: int
+
+    @property
+    def scratch_bytes(self) -> int:
+        return self.n_slots * (self.tile_sym // 8)
+
+
+# ---------------------------------------------------------------------------
+# Compiler
+# ---------------------------------------------------------------------------
+
+
+def _pair(a: int, b: int) -> tuple[int, int]:
+    return (a, b) if a < b else (b, a)
+
+
+def _group(sets: list[set[int]], n_slots: int) -> tuple[list[tuple[int, int, int]], int]:
+    """Greedy pair-CSE over the per-output term sets (mutated in place).
+
+    Returns (temps, n_slots'): temps as (slot, src_a, src_b) in creation
+    order.  Pair counts are maintained incrementally with a lazy max-heap,
+    ties broken toward the lexicographically smallest pair so the same
+    matrix always compiles to the identical program.
+    """
+    import heapq
+
+    cnt: dict[tuple[int, int], int] = {}
+    rows_of: dict[tuple[int, int], set[int]] = {}
+    heap: list[tuple[int, int, int]] = []
+
+    def bump(p: tuple[int, int], row: int) -> None:
+        cnt[p] = cnt.get(p, 0) + 1
+        rows_of.setdefault(p, set()).add(row)
+        heapq.heappush(heap, (-cnt[p], p[0], p[1]))
+
+    def drop(p: tuple[int, int], row: int) -> None:
+        cnt[p] -= 1
+        rows_of[p].discard(row)
+
+    for ri, s in enumerate(sets):
+        ss = sorted(s)
+        for i in range(len(ss)):
+            for j in range(i + 1, len(ss)):
+                bump((ss[i], ss[j]), ri)
+
+    temps: list[tuple[int, int, int]] = []
+    while heap:
+        negc, a, b = heapq.heappop(heap)
+        p = (a, b)
+        if cnt.get(p, 0) != -negc:
+            continue  # stale heap entry
+        if -negc < _GROUP_THRESHOLD:
+            break
+        t = n_slots
+        n_slots += 1
+        temps.append((t, a, b))
+        for ri in sorted(rows_of[p]):
+            s = sets[ri]
+            if a not in s or b not in s:
+                continue
+            s.discard(a)
+            s.discard(b)
+            for x in s:
+                drop(_pair(a, x), ri)
+                drop(_pair(b, x), ri)
+                bump(_pair(x, t), ri)
+            drop(p, ri)
+            s.add(t)
+    return temps, n_slots
+
+
+def _default_tile_sym() -> int:
+    return config.env("WEEDTPU_XORSCHED_TILE_KB") * 1024
+
+
+def compile_schedule(matrix: np.ndarray, tile_sym: Optional[int] = None) -> XorProgram:
+    """Compile (uncached) — `get_schedule` is the memoized entry point."""
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    if m.ndim != 2 or 0 in m.shape:
+        raise ValueError(f"want a non-empty 2-D GF matrix, got shape {m.shape}")
+    if tile_sym is None:
+        tile_sym = _default_tile_sym()
+    tile_sym = max(512, (tile_sym // 512) * 512)  # SIMD transpose granularity
+    bits = gf8.gf_matrix_to_bits(m)
+    r8, c8 = bits.shape
+    sets = [set(np.nonzero(bits[r])[0].tolist()) for r in range(r8)]
+    raw_xors = sum(max(0, len(s) - 1) for s in sets)
+    temps, n_slots = _group(sets, c8)
+    out_base = n_slots
+    ops: list[int] = []
+    for t, a, b in temps:
+        ops += [t, 2, a, b]
+    for r in range(r8):
+        ss = sorted(sets[r])
+        ops += [out_base + r, len(ss)] + ss
+    xor_count = len(temps) + sum(max(0, len(s) - 1) for s in sets)
+    return XorProgram(
+        rows=m.shape[0],
+        cols=m.shape[1],
+        n_slots=out_base + r8,
+        out_base=out_base,
+        ops=np.asarray(ops, dtype=np.int32),
+        tile_sym=tile_sym,
+        raw_xors=raw_xors,
+        xor_count=xor_count,
+        n_temps=len(temps),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Schedule LRU (mirrors rs_codec's decode-matrix memo, but with a cap that
+# re-reads WEEDTPU_XORSCHED_CACHE on clear so tests can shrink it)
+# ---------------------------------------------------------------------------
+
+_cache_lock = threading.Lock()
+_cache: "OrderedDict[tuple, XorProgram]" = OrderedDict()
+_cache_cap: Optional[int] = None
+_hits = 0
+_misses = 0
+_evictions = 0
+
+
+def _cap() -> int:
+    global _cache_cap
+    if _cache_cap is None:
+        _cache_cap = max(1, config.env("WEEDTPU_XORSCHED_CACHE"))
+    return _cache_cap
+
+
+def get_schedule(matrix: np.ndarray, tile_sym: Optional[int] = None) -> XorProgram:
+    """The compiled program for (matrix bytes, tile geometry), LRU-cached."""
+    global _hits, _misses, _evictions
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    if tile_sym is None:
+        tile_sym = _default_tile_sym()
+    key = (m.shape, m.tobytes(), tile_sym)
+    with _cache_lock:
+        prog = _cache.get(key)
+        if prog is not None:
+            _hits += 1
+            _cache.move_to_end(key)
+            return prog
+        _misses += 1
+    prog = compile_schedule(m, tile_sym)
+    with _cache_lock:
+        _cache[key] = prog
+        _cache.move_to_end(key)
+        while len(_cache) > _cap():
+            _cache.popitem(last=False)
+            _evictions += 1
+    return prog
+
+
+def schedule_cache_info() -> dict:
+    with _cache_lock:
+        return {
+            "hits": _hits,
+            "misses": _misses,
+            "evictions": _evictions,
+            "size": len(_cache),
+            "cap": _cap(),
+        }
+
+
+def clear_schedule_cache() -> None:
+    """Empty the LRU and re-read the cap knob (test hook, like
+    rs_codec.clear_decode_matrix_cache)."""
+    global _cache_cap, _hits, _misses, _evictions
+    with _cache_lock:
+        _cache.clear()
+        _cache_cap = None
+        _hits = _misses = _evictions = 0
+
+
+# ---------------------------------------------------------------------------
+# Numpy interpreter — the byte-exact oracle
+# ---------------------------------------------------------------------------
+
+
+def _to_planes(seg: np.ndarray) -> np.ndarray:
+    """(C, w) bytes -> (8C, ceil(w/8)) packed bit-planes (little-endian:
+    plane byte j bit k = bit i of symbol 8j+k)."""
+    c, w = seg.shape
+    pw8 = -(-w // 8) * 8
+    if pw8 != w:
+        seg = np.pad(seg, ((0, 0), (0, pw8 - w)))
+    bits = np.unpackbits(seg, axis=1, bitorder="little").reshape(c, pw8, 8)
+    planes = np.packbits(bits.transpose(0, 2, 1).reshape(c * 8, pw8), axis=1, bitorder="little")
+    return planes
+
+
+def _from_planes(planes: np.ndarray, w: int) -> np.ndarray:
+    """(8R, pw) packed bit-planes -> (R, w) bytes (inverse of _to_planes)."""
+    r8, pw = planes.shape
+    bits = np.unpackbits(planes, axis=1, bitorder="little").reshape(r8 // 8, 8, pw * 8)
+    out = np.packbits(bits.transpose(0, 2, 1), axis=2, bitorder="little")[:, :, 0]
+    return np.ascontiguousarray(out[:, :w])
+
+
+def apply(prog: XorProgram, inputs: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Run the schedule with numpy bulk XOR — tile loop, packed planes."""
+    if len(inputs) != prog.cols:
+        raise ValueError(f"program wants {prog.cols} inputs, got {len(inputs)}")
+    ins = [np.ascontiguousarray(np.frombuffer(i, dtype=np.uint8)) if not isinstance(i, np.ndarray)
+           else np.ascontiguousarray(i, dtype=np.uint8) for i in inputs]
+    n = ins[0].shape[0]
+    for i in ins:
+        if i.shape[0] != n:
+            raise ValueError("input shards differ in length")
+    outs = [np.empty(n, dtype=np.uint8) for _ in range(prog.rows)]
+    ops = prog.ops
+    for off in range(0, n, prog.tile_sym):
+        w = min(prog.tile_sym, n - off)
+        pw = -(-w // 8)
+        seg = np.stack([i[off:off + w] for i in ins])
+        slots = np.zeros((prog.n_slots, pw), dtype=np.uint8)
+        slots[: prog.cols * 8] = _to_planes(seg)
+        k = 0
+        while k < len(ops):
+            dest, nsrc = int(ops[k]), int(ops[k + 1])
+            k += 2
+            if nsrc:
+                srcs = ops[k:k + nsrc]
+                k += nsrc
+                np.bitwise_xor.reduce(slots[srcs], axis=0, out=slots[dest])
+        res = _from_planes(slots[prog.out_base:], w)
+        for r in range(prog.rows):
+            outs[r][off:off + w] = res[r]
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Native executor binding (version-probed: an old libweedtpu.so without the
+# entry point must fall back to the interpreter, never crash)
+# ---------------------------------------------------------------------------
+
+
+def native_available() -> bool:
+    from seaweedfs_tpu.utils import native as native_mod
+
+    lib = native_mod.load()
+    return bool(lib is not None and hasattr(lib, "weedtpu_xor_schedule_apply"))
+
+
+def native_level() -> str:
+    """SIMD level the native executor would run at: gfni | avx2 | scalar |
+    unavailable (library or symbol missing)."""
+    from seaweedfs_tpu.utils import native as native_mod
+
+    lib = native_mod.load()
+    if lib is None or not hasattr(lib, "weedtpu_xorsched_level"):
+        return "unavailable"
+    return {2: "gfni", 1: "avx2"}.get(int(lib.weedtpu_xorsched_level()), "scalar")
+
+
+def apply_native(prog: XorProgram, inputs: Sequence[np.ndarray]) -> Optional[list[np.ndarray]]:
+    """Run the schedule through libweedtpu.so; None when the library (or
+    the xorsched entry point — stale .so) is unavailable."""
+    from seaweedfs_tpu.utils import native as native_mod
+
+    lib = native_mod.load()
+    if lib is None or not hasattr(lib, "weedtpu_xor_schedule_apply"):
+        return None
+    ins = [np.ascontiguousarray(np.frombuffer(i, dtype=np.uint8)) if not isinstance(i, np.ndarray)
+           else np.ascontiguousarray(i, dtype=np.uint8) for i in inputs]
+    if len(ins) != prog.cols:
+        raise ValueError(f"program wants {prog.cols} inputs, got {len(ins)}")
+    n = ins[0].shape[0]
+    for i in ins:
+        if i.shape[0] != n:
+            raise ValueError("input shards differ in length")
+    # np.empty, not zeros: the backward transpose writes every output byte,
+    # and the zeroing pass costs ~15% of the whole apply at these speeds
+    outs = [np.empty(n, dtype=np.uint8) for _ in range(prog.rows)]
+    ops = np.ascontiguousarray(prog.ops, dtype=np.int32)
+    InArr = ctypes.c_char_p * prog.cols
+    OutArr = ctypes.c_void_p * prog.rows
+    rc = lib.weedtpu_xor_schedule_apply(
+        ops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_uint64(ops.shape[0]),
+        ctypes.c_uint32(prog.n_slots),
+        ctypes.c_uint32(prog.cols * 8),
+        ctypes.c_uint32(prog.out_base),
+        ctypes.c_uint32(prog.rows * 8),
+        InArr(*[i.ctypes.data_as(ctypes.c_char_p) for i in ins]),
+        OutArr(*[o.ctypes.data_as(ctypes.c_void_p) for o in outs]),
+        ctypes.c_uint64(n),
+        ctypes.c_uint64(prog.tile_sym),
+    )
+    if not rc:
+        return None
+    return outs
+
+
+def apply_matrix(matrix: np.ndarray, inputs: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Compile-and-run convenience: native executor when present, numpy
+    interpreter otherwise.  Byte-identical either way."""
+    prog = get_schedule(matrix)
+    out = apply_native(prog, inputs)
+    if out is not None:
+        return out
+    return apply(prog, inputs)

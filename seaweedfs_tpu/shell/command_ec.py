@@ -1316,6 +1316,17 @@ def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
             for name, labels, v in rows
             if name == "weedtpu_ec_backend_selected" and v == 1.0
         )
+        # xorsched schedule-cache state (only exported once the server has
+        # dispatched through the xorsched path at least once)
+        xs_hits = int(_metric_sum(rows, "weedtpu_xorsched_schedule_cache", event="hits"))
+        xs_miss = int(_metric_sum(rows, "weedtpu_xorsched_schedule_cache", event="misses"))
+        xs_size = int(_metric_sum(rows, "weedtpu_xorsched_schedule_cache", event="size"))
+        xs_cap = int(_metric_sum(rows, "weedtpu_xorsched_schedule_cache", event="cap"))
+        xs = (
+            f" xorsched={xs_hits}hit/{xs_miss}miss({xs_size}/{xs_cap})"
+            if xs_hits or xs_miss
+            else ""
+        )
         # decoded-interval cache: is degraded hot-set traffic actually
         # being served from cache, and is the budget churning (evictions)
         # or being flushed by topology events (invalidations)?
@@ -1340,7 +1351,7 @@ def do_ec_status(args: list[str], env: CommandEnv, w: TextIO) -> None:
             f"convert={convert_inflight}inflight/{converts_done}done "
             f"cache={cache_hits}hit/{cache_misses}miss({cache_rate}) "
             f"{cache_mb:.1f}MB evict={cache_evict} inval={cache_inval} "
-            f"backend={','.join(backends) or '?'}\n"
+            f"backend={','.join(backends) or '?'}{xs}\n"
         )
 
 
@@ -1382,6 +1393,22 @@ def do_ec_backend(args: list[str], env: CommandEnv, w: TextIO) -> None:
     if isinstance(mesh_dec, dict) and enc.backend != "mesh":
         w.write(
             f"ec.backend: mesh not promoted: {mesh_dec.get('reason', 'n/a')}\n"
+        )
+    if enc.backend in ("numpy", "native", "xorsched"):
+        # CPU-floor audit: which of the three host paths serves, the BENCH
+        # evidence round behind an xorsched promotion (- when defaults
+        # held), the SIMD level the xor executor would run at, and the
+        # compiled-schedule LRU state of THIS process
+        from seaweedfs_tpu.ops import xorsched
+
+        ci = xorsched.schedule_cache_info()
+        w.write(
+            "ec.backend: cpu floor: "
+            f"path={enc.backend} "
+            f"evidence_round={enc.selection.get('evidence_round', '-')} "
+            f"xor_simd={xorsched.native_level()} "
+            f"sched_cache={ci['hits']}hit/{ci['misses']}miss "
+            f"size={ci['size']}/{ci['cap']} evict={ci['evictions']}\n"
         )
 
 

@@ -416,8 +416,17 @@ EcDispatchTotal = REGISTRY.counter(
 EcBackendSelected = REGISTRY.gauge(
     "weedtpu_ec_backend_selected",
     "codec backend chosen by new_encoder (1 = currently selected; source "
-    "says why: on-chip-evidence, platform, env:WEEDTPU_BACKEND, explicit)",
+    "says why: on-chip-evidence, cpu-bench-evidence, platform, "
+    "env:WEEDTPU_BACKEND, explicit)",
     ("backend", "source"),
+)
+XorschedCache = REGISTRY.gauge(
+    "weedtpu_xorsched_schedule_cache",
+    "compiled XOR-schedule LRU counters by event (hits/misses/evictions/"
+    "size/cap), mirrored from ops.xorsched at each xorsched dispatch — "
+    "steady-state serving should be all hits; churning misses mean the "
+    "matrix working set exceeds WEEDTPU_XORSCHED_CACHE",
+    ("event",),
 )
 RepairQueueDepth = REGISTRY.gauge(
     "weedtpu_repair_queue_depth",

@@ -238,9 +238,9 @@ register_env(
 register_env(
     "WEEDTPU_BACKEND", str, "",
     "Operator override of the evidence-based auto backend selection: one "
-    "of numpy | native | jax | pallas | mesh (empty/auto = measured "
-    "decision). Explicit new_encoder(backend=...) callers are never "
-    "overridden.",
+    "of numpy | native | xorsched | jax | pallas | mesh (empty/auto = "
+    "measured decision). Explicit new_encoder(backend=...) callers are "
+    "never overridden.",
 )
 register_env(
     "WEEDTPU_MESH_SHAPE", str, "",
@@ -502,6 +502,23 @@ register_env(
     "WEEDTPU_TRACE_SEED", int, 0,
     "Seed for the trace-sampling RNG (deterministic retention for "
     "tests/replays); 0 = OS entropy.",
+)
+register_env(
+    "WEEDTPU_XORSCHED_TILE_KB", int, 4,
+    "Width-axis tile of the xorsched executors, in KB per shard: each "
+    "tile keeps the whole bit-plane slot frame (inputs + grouped temps + "
+    "outputs) cache-resident while the XOR program replays. 4 KB "
+    "measures best on the committed BENCH host (L1-sized frame); "
+    "clamped to >= 1.",
+    parse=_clamped_int(1),
+)
+register_env(
+    "WEEDTPU_XORSCHED_CACHE", int, 64,
+    "Entry cap of the compiled XOR-schedule LRU (keyed by matrix bytes "
+    "+ tile geometry, like the decode-matrix memo). Compilation is "
+    "milliseconds and programs are KBs, so a small cap covers every "
+    "live (geometry, erasure-pattern) pair; clamped to >= 1.",
+    parse=_clamped_int(1),
 )
 register_env(
     "WEEDTPU_REPAIR", str, "off",

@@ -249,7 +249,8 @@ def test_selection_exported_through_stats(monkeypatch):
         'weedtpu_ec_backend_selected{backend="numpy",source="env:WEEDTPU_BACKEND"} 0.0'
         in lines
     )
-    assert f'backend="{enc.backend}",source="platform"}} 1.0' in lines
+    src = enc.selection["source"]  # platform, or cpu-bench-evidence when
+    assert f'backend="{enc.backend}",source="{src}"}} 1.0' in lines  # promoted
 
 
 def test_pallas_encoder_honors_variant_config():

@@ -83,7 +83,7 @@ def test_split_join(rng):
 
 def test_factory_auto_backend():
     enc = new_encoder()
-    assert enc.backend in ("numpy", "native", "jax")
+    assert enc.backend in ("numpy", "native", "xorsched", "jax")
 
 
 def test_other_geometries(rng):
@@ -163,15 +163,26 @@ def test_native_backend_matches_numpy_golden():
     assert fast.verify(rec)
 
 
-def test_auto_backend_on_cpu_prefers_native():
+def test_auto_backend_on_cpu_follows_evidence_rule():
+    """auto on a CPU host is the pick_cpu_backend decision: the AVX2
+    library by default, promoted to the compiled XOR-schedule backend
+    only under fresh committed same-host BENCH evidence in which
+    xorsched beat native in the same run (the r17 CPU-floor rule —
+    fabricated-evidence decision table lives in test_xorsched.py)."""
     import pytest
 
-    from seaweedfs_tpu.ops.rs_codec import new_encoder
+    from seaweedfs_tpu.ops import rs_codec
     from seaweedfs_tpu.utils import native as native_mod
 
     if native_mod.load() is None:
         pytest.skip("native library unavailable")
-    assert new_encoder().backend == "native"  # conftest pins cpu
+    expected, dec = rs_codec.pick_cpu_backend()
+    assert expected in ("native", "xorsched")
+    enc = rs_codec.new_encoder()  # conftest pins cpu
+    assert enc.backend == expected
+    if expected == "xorsched":
+        assert enc.selection["source"] == "cpu-bench-evidence"
+        assert enc.selection["evidence_file"].startswith("BENCH_r")
 
 
 def test_auto_backend_on_tpu_prefers_measured_fastest(monkeypatch):

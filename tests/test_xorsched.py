@@ -1,0 +1,397 @@
+"""Compiled XOR-schedule backend (ops/xorsched) + the CPU promotion rule.
+
+The r17 contract: every GF(2^8) matrix the Encoder dispatches — encode
+parity, fused decode, projection column-slice, delta-parity column — lowers
+through gf8's bit-plane decomposition into an XOR program that is
+byte-exact against the gf8 numpy golden at tile-edge/odd/tiny widths, on
+BOTH executors (numpy interpreter and the native SIMD path when the .so
+carries the entry point). Compilation is deterministic, the shared-
+subexpression grouping pass measurably shrinks the program, the schedule
+LRU is bounded, and `new_encoder("auto")` on CPU promotes xorsched over
+the AVX2 library ONLY under fresh committed same-host BENCH evidence in
+which xorsched beat native in the same run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf8, rs_codec, xorsched
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# full tiles (512-symbol SIMD groups), partial tiles, sub-8-symbol scalar
+# tails, and widths straddling the default 4096-symbol tile boundary
+WIDTHS = [1, 7, 8, 31, 255, 512, 513, 4095, 4096, 4097]
+
+
+def _forms() -> list[tuple[str, np.ndarray]]:
+    """The four matrix shapes the Encoder dispatches (bench's list is the
+    same by construction — both derive from one 10+4 encoder)."""
+    enc = rs_codec.Encoder(10, 4, backend="numpy")
+    survivors = [i for i in range(14) if i not in (2, 11)][:10]
+    decode = enc.reconstruction_matrix(survivors, [2, 11])
+    plan = enc.repair_projection_plan(survivors, [2, 11])
+    projection = np.stack([plan[s] for s in survivors[:5]], axis=1)
+    delta = enc.parity_matrix[:, [3]]
+    return [
+        ("encode", enc.parity_matrix),
+        ("decode", decode),
+        ("projection", projection),
+        ("delta", delta),
+    ]
+
+
+# -- byte-exactness ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["encode", "decode", "projection", "delta"])
+def test_interpreter_byte_exact_vs_golden(name):
+    m = dict(_forms())[name]
+    rng = np.random.default_rng(17)
+    prog = xorsched.compile_schedule(m)
+    for n in WIDTHS:
+        stack = rng.integers(0, 256, size=(m.shape[1], n), dtype=np.uint8)
+        golden = gf8.gf_mat_vec(m, stack)
+        got = np.stack(xorsched.apply(prog, list(stack)))
+        assert (got == golden).all(), f"{name} interpreter mismatch at n={n}"
+
+
+@pytest.mark.parametrize("name", ["encode", "decode", "projection", "delta"])
+def test_native_executor_byte_exact_vs_golden(name):
+    if not xorsched.native_available():
+        pytest.skip("libweedtpu.so lacks the xorsched entry point")
+    m = dict(_forms())[name]
+    rng = np.random.default_rng(18)
+    prog = xorsched.compile_schedule(m)
+    for n in WIDTHS:
+        stack = rng.integers(0, 256, size=(m.shape[1], n), dtype=np.uint8)
+        golden = gf8.gf_mat_vec(m, stack)
+        outs = xorsched.apply_native(prog, list(stack))
+        assert outs is not None
+        assert (np.stack(outs) == golden).all(), f"{name} native mismatch at n={n}"
+
+
+def test_non_multiple_of_tile_and_large_width():
+    m = dict(_forms())["encode"]
+    rng = np.random.default_rng(19)
+    prog = xorsched.compile_schedule(m, tile_sym=1024)
+    n = 65536 + 488  # many tiles + a ragged final tile + scalar tail
+    stack = rng.integers(0, 256, size=(10, n), dtype=np.uint8)
+    golden = gf8.gf_mat_vec(m, stack)
+    assert (np.stack(xorsched.apply(prog, list(stack))) == golden).all()
+    if xorsched.native_available():
+        outs = xorsched.apply_native(prog, list(stack))
+        assert (np.stack(outs) == golden).all()
+
+
+# -- compiler properties -----------------------------------------------------
+
+
+def test_schedule_determinism():
+    m = dict(_forms())["encode"]
+    a = xorsched.compile_schedule(m)
+    b = xorsched.compile_schedule(m)
+    assert np.array_equal(a.ops, b.ops)
+    assert (a.n_slots, a.out_base, a.xor_count, a.n_temps) == (
+        b.n_slots, b.out_base, b.xor_count, b.n_temps
+    )
+
+
+def test_grouping_reduces_xor_count_on_cauchy_10p4():
+    m = gf8.parity_matrix(10, 4, kind="cauchy")
+    prog = xorsched.compile_schedule(m)
+    # the numeric claim, not just "smaller": the greedy pair-CSE pass must
+    # remove at least a third of the raw bit-plane XORs on this matrix
+    # (measured ~52% on vandermonde; cauchy is in the same density class)
+    assert prog.raw_xors > 0
+    assert prog.n_temps > 0
+    assert prog.xor_count <= (2 * prog.raw_xors) // 3, (
+        f"grouping too weak: {prog.xor_count} of {prog.raw_xors} raw XORs"
+    )
+
+
+def test_tile_clamped_to_simd_multiple():
+    m = dict(_forms())["delta"]
+    prog = xorsched.compile_schedule(m, tile_sym=100)  # below the 512 floor
+    assert prog.tile_sym == 512
+    prog = xorsched.compile_schedule(m, tile_sym=1000)
+    assert prog.tile_sym % 512 == 0
+
+
+# -- schedule LRU ------------------------------------------------------------
+
+
+def test_lru_bound_and_eviction(monkeypatch):
+    monkeypatch.setenv("WEEDTPU_XORSCHED_CACHE", "2")
+    xorsched.clear_schedule_cache()
+    try:
+        mats = [gf8.parity_matrix(4, 2), gf8.parity_matrix(5, 2),
+                gf8.parity_matrix(6, 2)]
+        for m in mats:
+            xorsched.get_schedule(m)
+        info = xorsched.schedule_cache_info()
+        assert info["cap"] == 2
+        assert info["size"] == 2
+        assert info["evictions"] == 1
+        assert info["misses"] == 3
+        # the oldest entry was evicted: touching it again is a miss
+        xorsched.get_schedule(mats[0])
+        assert xorsched.schedule_cache_info()["misses"] == 4
+        # the newest is still resident: a hit
+        xorsched.get_schedule(mats[0])
+        assert xorsched.schedule_cache_info()["hits"] == 1
+    finally:
+        monkeypatch.delenv("WEEDTPU_XORSCHED_CACHE")
+        xorsched.clear_schedule_cache()
+
+
+def test_cache_keyed_by_tile_geometry():
+    xorsched.clear_schedule_cache()
+    m = gf8.parity_matrix(4, 2)
+    a = xorsched.get_schedule(m, tile_sym=1024)
+    b = xorsched.get_schedule(m, tile_sym=2048)
+    assert a.tile_sym != b.tile_sym
+    assert xorsched.schedule_cache_info()["size"] == 2
+    xorsched.clear_schedule_cache()
+
+
+# -- Encoder integration -----------------------------------------------------
+
+
+def test_encoder_xorsched_equals_numpy_on_public_ops():
+    e_x = rs_codec.Encoder(10, 4, backend="xorsched")
+    e_n = rs_codec.Encoder(10, 4, backend="numpy")
+    rng = np.random.default_rng(20)
+    data = [rng.integers(0, 256, 4097, dtype=np.uint8) for _ in range(10)]
+    sx, sn = e_x.encode(data), e_n.encode(data)
+    assert all((a == b).all() for a, b in zip(sx, sn))
+    shards = [None if i in (2, 11) else sx[i] for i in range(14)]
+    rx = e_x.reconstruct(shards)
+    assert all((rx[i] == sx[i]).all() for i in range(14))
+    # delta-parity update rides the same dispatch
+    parity = np.stack(sx[10:])
+    old = data[3][100:200]
+    new = (~old).astype(np.uint8)
+    px = e_x.update_parity(parity[:, 100:200], 3, old, new)
+    pn = e_n.update_parity(parity[:, 100:200], 3, old, new)
+    assert (px == pn).all()
+    # batched 3D stack (the streaming-pipeline shape)
+    stack = np.stack([np.stack(data), np.stack(data)[:, ::-1]])
+    assert (
+        e_x._apply(e_x.parity_matrix, stack)
+        == e_n._apply(e_n.parity_matrix, stack)
+    ).all()
+
+
+def test_dispatch_counter_ticks_xorsched_label():
+    from seaweedfs_tpu import stats
+
+    before = 0.0
+    for line in stats.EcDispatchTotal.collect():
+        if 'backend="xorsched"' in line:
+            before = float(line.rsplit(" ", 1)[1])
+    enc = rs_codec.Encoder(4, 2, backend="xorsched")
+    enc.encode([np.zeros(64, dtype=np.uint8)] * 4)
+    after = None
+    for line in stats.EcDispatchTotal.collect():
+        if 'backend="xorsched"' in line:
+            after = float(line.rsplit(" ", 1)[1])
+    assert after is not None and after >= before + 1
+
+
+def test_stale_so_falls_back_to_interpreter(monkeypatch):
+    """A libweedtpu.so predating the xorsched entry point must degrade to
+    the numpy interpreter, never crash or mis-encode."""
+    monkeypatch.setattr("seaweedfs_tpu.utils.native.load", lambda *a, **k: None)
+    assert xorsched.native_available() is False
+    assert xorsched.native_level() == "unavailable"
+    m = gf8.parity_matrix(4, 2)
+    prog = xorsched.compile_schedule(m)
+    stack = np.arange(4 * 100, dtype=np.uint8).reshape(4, 100) % 251
+    assert xorsched.apply_native(prog, list(stack)) is None
+    got = np.stack(xorsched.apply_matrix(m, list(stack)))
+    assert (got == gf8.gf_mat_vec(m, stack)).all()
+
+
+def test_stripe_pipeline_rides_xorsched_byte_identical(tmp_path):
+    """The streaming file pipelines (stripe._encode_rows via
+    write_ec_files, rebuild_ec_files) must ride the xorsched backend
+    unchanged and produce byte-identical shard files to the numpy path."""
+    from seaweedfs_tpu.ec import stripe
+
+    rng = np.random.default_rng(21)
+    dat = rng.integers(0, 256, 123_457, dtype=np.uint8).tobytes()
+    goldens = {}
+    for backend in ("numpy", "xorsched"):
+        base = str(tmp_path / f"v_{backend}")
+        with open(base + ".dat", "wb") as f:
+            f.write(dat)
+        enc = rs_codec.Encoder(10, 4, backend=backend)
+        stripe.write_ec_files(
+            base, large_block_size=16384, small_block_size=4096,
+            buffer_size=4096, encoder=enc, max_batch_bytes=10 * 3 * 4096,
+        )
+        goldens[backend] = [
+            open(stripe.shard_file_name(base, s), "rb").read()
+            for s in range(14)
+        ]
+        if backend == "xorsched":
+            lost = [0, 5, 11]
+            for s in lost:
+                os.unlink(stripe.shard_file_name(base, s))
+            assert stripe.rebuild_ec_files(base, encoder=enc) == lost
+            for s in range(14):
+                with open(stripe.shard_file_name(base, s), "rb") as f:
+                    assert f.read() == goldens[backend][s], f"shard {s}"
+    assert goldens["numpy"] == goldens["xorsched"]
+
+
+# -- pick_cpu_backend: the decision table ------------------------------------
+
+
+def _xor_evidence(when=None, host=None, xorsched_gbps=4.0, native_gbps=1.6,
+                  match=True):
+    import datetime
+
+    return {
+        "when": when or datetime.datetime.utcnow().strftime("%Y-%m-%dT%H:%MZ"),
+        "host": host if host is not None else rs_codec._host_fingerprint(),
+        "match": match,
+        "encode": {"xorsched_gbps": xorsched_gbps, "native_gbps": native_gbps},
+    }
+
+
+def _write_bench(dirpath, xor, name="BENCH_r91.json"):
+    with open(os.path.join(dirpath, name), "w", encoding="utf-8") as f:
+        json.dump({"n": 91, "rc": 0, "parsed": {"xor": xor}}, f)
+
+
+def test_winning_fresh_same_host_evidence_promotes(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    _write_bench(tmp_path, _xor_evidence())
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == "xorsched"
+    assert "beats" in dec["reason"]
+    assert dec["evidence_file"] == "BENCH_r91.json"
+    assert dec["evidence_round"] == 91
+    assert dec["xorsched_gbps"] == 4.0 and dec["native_gbps"] == 1.6
+
+
+def test_absent_evidence_keeps_library_path(tmp_path):
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == rs_codec._cpu_backend()
+    assert "no committed" in dec["reason"]
+
+
+def test_stale_evidence_keeps_library_path(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    _write_bench(tmp_path, _xor_evidence(when="2020-01-01T00:00Z"))
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == rs_codec._cpu_backend()
+    assert "stale" in dec["reason"]
+
+
+def test_losing_evidence_keeps_library_path(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    _write_bench(tmp_path, _xor_evidence(xorsched_gbps=1.5, native_gbps=1.6))
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == rs_codec._cpu_backend()
+    assert "does not beat" in dec["reason"]
+
+
+def test_other_host_evidence_never_promotes(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    _write_bench(
+        tmp_path, _xor_evidence(host={"cpu": "AMD EPYC 9999", "cores": 128})
+    )
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == rs_codec._cpu_backend()
+    assert "different host" in dec["reason"]
+
+
+def test_unverified_evidence_never_promotes(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    _write_bench(tmp_path, _xor_evidence(match=False))
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == rs_codec._cpu_backend()
+    assert "byte-verification" in dec["reason"]
+
+
+def test_stale_so_blocks_promotion_even_on_winning_evidence(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: False)
+    _write_bench(tmp_path, _xor_evidence())
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == rs_codec._cpu_backend()
+    assert "weedtpu_xor_schedule_apply" in dec["reason"]
+
+
+def test_rounds_without_xor_section_are_skipped_not_depromoting(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    _write_bench(tmp_path, _xor_evidence(), name="BENCH_r91.json")
+    # a NEWER round measuring other subsystems must not hide the xor one
+    with open(os.path.join(tmp_path, "BENCH_r92.json"), "w", encoding="utf-8") as f:
+        json.dump({"n": 92, "rc": 0, "parsed": {"metric": "other"}}, f)
+    backend, dec = rs_codec.pick_cpu_backend(art_dir=str(tmp_path))
+    assert backend == "xorsched"
+    assert dec["evidence_file"] == "BENCH_r91.json"
+
+
+def test_new_encoder_auto_promotes_on_cpu_evidence(tmp_path, monkeypatch):
+    monkeypatch.setattr(xorsched, "native_available", lambda: True)
+    monkeypatch.setattr(rs_codec, "_multichip_dir", lambda: str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    _write_bench(tmp_path, _xor_evidence())
+    enc = rs_codec.new_encoder()
+    assert enc.backend == "xorsched"
+    assert enc.selection["source"] == "cpu-bench-evidence"
+    assert enc.selection["evidence_round"] == 91
+
+
+def test_new_encoder_auto_keeps_library_without_evidence(tmp_path, monkeypatch):
+    monkeypatch.setattr(rs_codec, "_multichip_dir", lambda: str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    enc = rs_codec.new_encoder()
+    assert enc.backend == rs_codec._cpu_backend()
+    assert enc.selection["source"] == "platform"
+
+
+# -- knobs + bench smoke -----------------------------------------------------
+
+
+def test_xorsched_knobs_registered():
+    from seaweedfs_tpu.utils import config
+
+    assert config.env("WEEDTPU_XORSCHED_TILE_KB") == 4
+    assert config.env("WEEDTPU_XORSCHED_CACHE") == 64
+    assert {"WEEDTPU_XORSCHED_TILE_KB", "WEEDTPU_XORSCHED_CACHE"} <= set(
+        config.ENV_REGISTRY
+    )
+
+
+def test_bench_xor_smoke_deterministic():
+    """The tier-1 gate the issue names: `BENCH_MODE=xor bench.py --smoke`
+    byte-verifies all four matrix forms on both executors and emits a
+    deterministic JSON (no timing fields, no timestamp)."""
+    env = dict(os.environ, BENCH_MODE="xor", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=120,
+    )
+    out = None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        if line.strip().startswith("{"):
+            out = json.loads(line)
+            break
+    assert out is not None, "no JSON from the smoke child"
+    assert out["ok"] is True and out["match"] is True
+    assert all(out["verify"].values())
+    assert "when" not in out, "smoke output must be timestamp-free"
+    assert out["cache"]["size"] == 4  # one schedule per matrix form

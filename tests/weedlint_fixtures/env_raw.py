@@ -8,8 +8,11 @@ from seaweedfs_tpu.utils import config
 DEPTH = int(os.environ.get("WEEDTPU_PIPELINE_DEPTH", "2"))  # BAD: raw .get
 WHO = os.getenv("WEEDTPU_WHO", "")  # BAD: raw getenv
 RAW = os.environ["WEEDTPU_RAW"]  # BAD: raw subscript read
+TILE = os.environ.get("WEEDTPU_XORSCHED_TILE_KB", "4")  # BAD: raw .get of a registered knob
 TYPO = config.env("WEEDTPU_NO_SUCH_KNOB")  # BAD: not in ENV_REGISTRY
+XLRU = config.env("WEEDTPU_XORSCHED_LRU")  # BAD: unregistered (knob is _CACHE)
 
 OK = config.env("WEEDTPU_PIPELINE_DEPTH")  # fine: registered read
+OK2 = config.env("WEEDTPU_XORSCHED_CACHE")  # fine: registered read
 os.environ["WEEDTPU_SET_FOR_SUBPROCESS"] = "1"  # fine: write is plumbing
 CHILD_ENV = dict(os.environ)  # fine: whole-env passthrough
