@@ -13,6 +13,7 @@
 // Exposed with a plain C ABI for ctypes (no pybind11 in this image).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -483,45 +484,53 @@ int weedtpu_xorsched_level() {
   return 0;
 }
 
-// Replay a compiled XOR schedule.  sched: flat [dest, nsrc, srcs...] int32
-// records (sched_words total); slots [0, in_planes) are input planes,
-// [out_base, out_base+out_planes) output planes; ins/outs hold in_planes/8
-// and out_planes/8 shard pointers of `len` bytes; tile_sym is the per-shard
-// tile width (multiple of 512).  Returns 1 on success, 0 on invalid args.
-int weedtpu_xor_schedule_apply(const int32_t* sched, uint64_t sched_words,
-                               uint32_t n_slots, uint32_t in_planes,
-                               uint32_t out_base, uint32_t out_planes,
-                               const uint8_t* const* ins, uint8_t* const* outs,
-                               uint64_t len, uint64_t tile_sym) {
-  if (!sched || !ins || !outs || n_slots == 0 || (in_planes % 8) ||
-      (out_planes % 8) || tile_sym < 512 || (tile_sym % 512) ||
-      out_base + out_planes > n_slots || in_planes > n_slots)
-    return 0;
-  // validate the op stream once so a malformed schedule cannot scribble
+// One compiled schedule bound to its shard set — the unit the tile runner
+// executes.  A single-matrix apply is one block; a block-diagonal fused
+// decode is many, each owning a disjoint output range.
+struct XsBlock {
+  const int32_t* sched;
+  uint64_t sched_words;
+  uint32_t in_shards;
+  uint32_t out_base;
+  uint32_t out_shards;
+  const uint8_t* const* ins;
+  uint8_t* const* outs;
+  uint64_t len;
+};
+
+// Validate one op stream so a malformed schedule cannot scribble outside
+// the slot frame.  Returns the stream's max nsrc, or -1 on a bad stream.
+static int32_t xs_validate(const int32_t* sched, uint64_t sched_words,
+                           uint32_t n_slots) {
   int32_t max_nsrc = 1;
   for (uint64_t k = 0; k < sched_words;) {
-    if (k + 2 > sched_words) return 0;
+    if (k + 2 > sched_words) return -1;
     int32_t dest = sched[k], nsrc = sched[k + 1];
-    if (dest < 0 || (uint32_t)dest >= n_slots || nsrc < 0) return 0;
+    if (dest < 0 || (uint32_t)dest >= n_slots || nsrc < 0) return -1;
     if (nsrc > max_nsrc) max_nsrc = nsrc;
     k += 2;
-    if (k + (uint64_t)nsrc > sched_words) return 0;
+    if (k + (uint64_t)nsrc > sched_words) return -1;
     for (int32_t s = 0; s < nsrc; s++)
-      if (sched[k + s] < 0 || (uint32_t)sched[k + s] >= n_slots) return 0;
+      if (sched[k + s] < 0 || (uint32_t)sched[k + s] >= n_slots) return -1;
     k += nsrc;
   }
-  const uint32_t in_shards = in_planes / 8, out_shards = out_planes / 8;
-  const uint64_t plane_b = tile_sym / 8;
-  uint8_t* scratch = (uint8_t*)aligned_alloc(64, (size_t)n_slots * plane_b);
-  if (!scratch) return 0;
-  const int level = weedtpu_xorsched_level();
-  std::vector<const uint8_t*> srcs((size_t)max_nsrc);
-  for (uint64_t off = 0; off < len; off += tile_sym) {
-    const uint64_t w = std::min(tile_sym, len - off);
+  return max_nsrc;
+}
+
+// Run width tiles [t0, t1) of one block: forward transpose -> XOR replay ->
+// backward transpose, all inside the caller's scratch slot frame.  Tiles
+// are independent (each covers a disjoint byte range of every shard), so
+// disjoint tile ranges of the same block may run on different threads.
+static void xs_run_tiles(const XsBlock& b, uint64_t tile_sym, uint64_t plane_b,
+                         int level, uint8_t* scratch, const uint8_t** srcs,
+                         uint64_t t0, uint64_t t1) {
+  for (uint64_t ti = t0; ti < t1; ti++) {
+    const uint64_t off = ti * tile_sym;
+    const uint64_t w = std::min(tile_sym, b.len - off);
     const uint64_t pw = (w + 7) / 8;
     // forward transpose: shard bytes -> packed bit-planes
-    for (uint32_t c = 0; c < in_shards; c++) {
-      const uint8_t* src = ins[c] + off;
+    for (uint32_t c = 0; c < b.in_shards; c++) {
+      const uint8_t* src = b.ins[c] + off;
       uint8_t* pl[8];
       for (int i = 0; i < 8; i++) pl[i] = scratch + ((uint64_t)c * 8 + i) * plane_b;
       uint64_t s = 0;
@@ -543,32 +552,31 @@ int weedtpu_xor_schedule_apply(const int32_t* sched, uint64_t sched_words,
       }
     }
     // replay the XOR program over this tile's planes
-    for (uint64_t k = 0; k < sched_words;) {
-      const int32_t dest = sched[k], nsrc = sched[k + 1];
+    for (uint64_t k = 0; k < b.sched_words;) {
+      const int32_t dest = b.sched[k], nsrc = b.sched[k + 1];
       k += 2;
       uint8_t* d = scratch + (uint64_t)dest * plane_b;
       if (nsrc == 0) {
         memset(d, 0, pw);
-        k += nsrc;
         continue;
       }
       for (int32_t j = 0; j < nsrc; j++)
-        srcs[(size_t)j] = scratch + (uint64_t)sched[k + j] * plane_b;
+        srcs[(size_t)j] = scratch + (uint64_t)b.sched[k + j] * plane_b;
       k += nsrc;
 #if defined(__x86_64__)
-      if (level == 2) xs_xor_op_avx512(d, srcs.data(), nsrc, pw);
-      else if (level == 1) xs_xor_op_avx2(d, srcs.data(), nsrc, pw);
-      else xs_xor_op_scalar(d, srcs.data(), nsrc, pw);
+      if (level == 2) xs_xor_op_avx512(d, srcs, nsrc, pw);
+      else if (level == 1) xs_xor_op_avx2(d, srcs, nsrc, pw);
+      else xs_xor_op_scalar(d, srcs, nsrc, pw);
 #else
-      xs_xor_op_scalar(d, srcs.data(), nsrc, pw);
+      xs_xor_op_scalar(d, srcs, nsrc, pw);
 #endif
     }
     // backward transpose: output planes -> shard bytes
-    for (uint32_t r = 0; r < out_shards; r++) {
-      uint8_t* dst = outs[r] + off;
+    for (uint32_t r = 0; r < b.out_shards; r++) {
+      uint8_t* dst = b.outs[r] + off;
       uint8_t* pl[8];
       for (int i = 0; i < 8; i++)
-        pl[i] = scratch + ((uint64_t)out_base + (uint64_t)r * 8 + i) * plane_b;
+        pl[i] = scratch + ((uint64_t)b.out_base + (uint64_t)r * 8 + i) * plane_b;
       uint64_t s = 0;
 #if defined(__x86_64__)
       if (level == 2) {
@@ -588,8 +596,120 @@ int weedtpu_xor_schedule_apply(const int32_t* sched, uint64_t sched_words,
       }
     }
   }
+}
+
+// Replay a compiled XOR schedule.  sched: flat [dest, nsrc, srcs...] int32
+// records (sched_words total); slots [0, in_planes) are input planes,
+// [out_base, out_base+out_planes) output planes; ins/outs hold in_planes/8
+// and out_planes/8 shard pointers of `len` bytes; tile_sym is the per-shard
+// tile width (multiple of 512).  Returns 1 on success, 0 on invalid args.
+int weedtpu_xor_schedule_apply(const int32_t* sched, uint64_t sched_words,
+                               uint32_t n_slots, uint32_t in_planes,
+                               uint32_t out_base, uint32_t out_planes,
+                               const uint8_t* const* ins, uint8_t* const* outs,
+                               uint64_t len, uint64_t tile_sym) {
+  if (!sched || !ins || !outs || n_slots == 0 || (in_planes % 8) ||
+      (out_planes % 8) || tile_sym < 512 || (tile_sym % 512) ||
+      out_base + out_planes > n_slots || in_planes > n_slots)
+    return 0;
+  const int32_t max_nsrc = xs_validate(sched, sched_words, n_slots);
+  if (max_nsrc < 0) return 0;
+  const uint64_t plane_b = tile_sym / 8;
+  uint8_t* scratch = (uint8_t*)aligned_alloc(64, (size_t)n_slots * plane_b);
+  if (!scratch) return 0;
+  std::vector<const uint8_t*> srcs((size_t)max_nsrc);
+  const XsBlock b = {sched, sched_words, in_planes / 8, out_base,
+                     out_planes / 8, ins, outs, len};
+  xs_run_tiles(b, tile_sym, plane_b, weedtpu_xorsched_level(), scratch,
+               srcs.data(), 0, (len + tile_sym - 1) / tile_sym);
   free(scratch);
   return 1;
+}
+
+// Block-diagonal, width-parallel schedule replay: `n_blocks` compiled
+// schedules, each bound to its own shard pointers and byte length, run as
+// ONE flat (block, tile) task list across a thread pool.  Parallel arrays
+// describe the blocks; sched_off/ins_off/outs_off index into the
+// concatenated op-word / input-pointer / output-pointer arrays.  All
+// blocks share `tile_sym` (one slot-frame geometry, one scratch size).
+// threads = 0 means hardware concurrency; the pool is clamped to the
+// task count and to a ~256 KiB-per-worker usefulness floor, like
+// weedtpu_gf_matrix_apply_mt.  Tiles never share output bytes, so no
+// synchronization beyond the final join is needed.  Returns 1 on
+// success, 0 on invalid args.
+int weedtpu_xor_schedule_apply_blocks(
+    const int32_t* sched, const uint64_t* sched_off, const uint64_t* sched_words,
+    const uint32_t* n_slots, const uint32_t* in_planes, const uint32_t* out_base,
+    const uint32_t* out_planes, const uint8_t* const* ins,
+    const uint64_t* ins_off, uint8_t* const* outs, const uint64_t* outs_off,
+    const uint64_t* lens, uint32_t n_blocks, uint64_t tile_sym,
+    uint32_t threads) {
+  if (!sched || !sched_off || !sched_words || !n_slots || !in_planes ||
+      !out_base || !out_planes || !ins || !ins_off || !outs || !outs_off ||
+      !lens || n_blocks == 0 || tile_sym < 512 || (tile_sym % 512))
+    return 0;
+  std::vector<XsBlock> blocks((size_t)n_blocks);
+  uint32_t max_slots = 0;
+  int32_t max_nsrc = 1;
+  uint64_t total_bytes = 0;
+  // (block, first tile) prefix so tasks flatten to one atomic counter
+  std::vector<uint64_t> tile_base((size_t)n_blocks + 1, 0);
+  for (uint32_t g = 0; g < n_blocks; g++) {
+    if (n_slots[g] == 0 || (in_planes[g] % 8) || (out_planes[g] % 8) ||
+        out_base[g] + out_planes[g] > n_slots[g] || in_planes[g] > n_slots[g])
+      return 0;
+    const int32_t mn = xs_validate(sched + sched_off[g], sched_words[g],
+                                   n_slots[g]);
+    if (mn < 0) return 0;
+    if (mn > max_nsrc) max_nsrc = mn;
+    if (n_slots[g] > max_slots) max_slots = n_slots[g];
+    blocks[g] = {sched + sched_off[g], sched_words[g], in_planes[g] / 8,
+                 out_base[g], out_planes[g] / 8, ins + ins_off[g],
+                 outs + outs_off[g], lens[g]};
+    tile_base[g + 1] = tile_base[g] + (lens[g] + tile_sym - 1) / tile_sym;
+    total_bytes += (uint64_t)(in_planes[g] / 8) * lens[g];
+  }
+  const uint64_t n_tasks = tile_base[n_blocks];
+  if (n_tasks == 0) return 1;  // every block empty: vacuous success
+  if (threads == 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    threads = hw ? hw : 1;
+  }
+  // below ~256 KiB per worker, spawn overhead beats the parallel win
+  uint64_t max_useful = total_bytes / (256 * 1024);
+  if (max_useful < threads) threads = (uint32_t)std::max<uint64_t>(1, max_useful);
+  if (threads > n_tasks) threads = (uint32_t)n_tasks;
+  const uint64_t plane_b = tile_sym / 8;
+  const int level = weedtpu_xorsched_level();
+  std::atomic<uint64_t> next{0};
+  std::atomic<int> oom{0};
+  auto worker = [&]() {
+    uint8_t* scratch = (uint8_t*)aligned_alloc(64, (size_t)max_slots * plane_b);
+    if (!scratch) {
+      oom.store(1);
+      return;
+    }
+    std::vector<const uint8_t*> srcs((size_t)max_nsrc);
+    uint32_t g = 0;
+    for (;;) {
+      const uint64_t t = next.fetch_add(1);
+      if (t >= n_tasks) break;
+      while (t >= tile_base[g + 1]) g++;  // task ids ascend per worker
+      while (t < tile_base[g]) g--;       // (other workers may skip g ahead)
+      xs_run_tiles(blocks[g], tile_sym, plane_b, level, scratch, srcs.data(),
+                   t - tile_base[g], t - tile_base[g] + 1);
+    }
+    free(scratch);
+  };
+  if (threads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(threads);
+    for (uint32_t t = 0; t < threads; t++) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return oom.load() ? 0 : 1;
 }
 
 }  // extern "C"

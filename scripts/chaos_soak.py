@@ -65,7 +65,8 @@ served to a client shows up as BYTES DIFFER = lost).
 loop (see run_rack_mode): 7 rack-labeled servers, four domain-spread EC
 volumes, open-loop read traffic, SIGKILL one node and then an entire
 two-node rack, with the master's WEEDTPU_REPAIR scheduler required to
-repair 2-missing stripes strictly before 1-missing ones, converge back
+carry each settle-window cohort in ONE fused batch (2-missing stripes
+ahead of 1-missing ones as the in-batch BLOCK order), converge back
 to full coverage, and leave zero failure-domain violations.
 
 Usage:
@@ -73,7 +74,7 @@ Usage:
       python scripts/chaos_soak.py [--seconds 300] [--wedge] [--latency] \
           [--inline] [--corrupt] [--convert] [--rack]
 Writes artifacts/SOAK_r09.json (SOAK_r10.json with --corrupt,
-SOAK_r11.json with --convert, SOAK_r12.json with --rack) and exits
+SOAK_r11.json with --convert, SOAK_r13.json with --rack) and exits
 nonzero on any lost byte, unhealed injection, incomplete conversion, or
 a fleet-repair gate failure (ordering / coverage / placement audit).
 """
@@ -220,17 +221,27 @@ def run_rack_mode(seconds: int) -> int:
 
       phase 1 (a node):  SIGKILL the rk5 node — A volumes go 2-missing,
                          B volumes 1-missing; the master scheduler must
-                         dispatch every 2-missing repair before any
-                         1-missing one, batch them to one target, and
+                         carry the whole cohort in ONE fused batch with
+                         every 2-missing volume ordered before any
+                         1-missing one as the in-batch BLOCK order, and
                          converge the registry back to full coverage.
       phase 2 (a rack):  SIGKILL BOTH rk0 nodes back to back — now the
                          B volumes are 2-missing and the A volumes
                          1-missing (the mirror image), same ordering
                          gate, same convergence gate.
 
-    The run FAILS on any lost byte, any out-of-order dispatch, residual
+    Since the heterogeneous-fusion change the scheduler no longer splits
+    a cohort into per-missing-class batches: 2-before-1 is asserted as a
+    per-batch property (block_missing non-increasing inside every
+    dispatched batch), and each batch's dispatch→mount wall plus the
+    target-reported dispatch_groups are recorded so the heal-time claim
+    is backed by per-dispatch occupancy data (SOAK_r12 paid one decode
+    dispatch per signature group; the gate here is that every batch
+    collapses to dispatch_groups=1).
+
+    The run FAILS on any lost byte, any out-of-order block, residual
     placement violations after healing, or incomplete coverage. Writes
-    artifacts/SOAK_r12.json."""
+    artifacts/SOAK_r13.json."""
     # scheduler + detection tuning must land BEFORE the master/server
     # processes exist (Node.start copies os.environ; the in-process
     # master reads the registry at construction)
@@ -428,21 +439,27 @@ def run_rack_mode(seconds: int) -> int:
                     if e["seq"] > seq0
                 ]
 
-            def priority_ok(events: list[dict]) -> bool:
-                """Every >=2-missing dispatch strictly precedes every
-                1-missing dispatch — the acceptance ordering gate."""
-                dispatched = [e for e in events if e["state"] == "dispatched"]
-                two = [e["seq"] for e in dispatched if e["missing"] >= 2]
-                one = [e["seq"] for e in dispatched if e["missing"] == 1]
-                if not two or not one:
+            def priority_ok(batches: list[dict]) -> bool:
+                """2-before-1 is now an IN-BATCH property: the fused batch
+                carries the whole cohort, so the acceptance ordering gate
+                is that every dispatched batch lists its >=2-missing
+                volumes before its 1-missing ones (block_missing
+                non-increasing), and the phase exercised BOTH classes."""
+                missing = [m for b in batches for m in b["block_missing"]]
+                if not any(m >= 2 for m in missing) or 1 not in missing:
                     return False  # the scenario must produce BOTH classes
-                return max(two) < min(one)
+                return all(
+                    all(a >= b2 for a, b2 in
+                        zip(b["block_missing"], b["block_missing"][1:]))
+                    for b in batches
+                )
 
             def run_phase(name: str, victims: list[Node], budget: float) -> dict:
                 seq0 = max(
                     (e["seq"] for e in master.repair.status()["events"]),
                     default=0,
                 )
+                nb0 = len(master.repair.status()["batches"])
                 for v in victims:
                     v.kill(hard=True)
                     report["kills"] += 1
@@ -462,13 +479,31 @@ def run_rack_mode(seconds: int) -> int:
                             break
                     time.sleep(1.0)
                 events = repair_events_after(seq0)
+                batches = [
+                    {k: b[k] for k in
+                     ("target", "volumes", "signature_groups",
+                      "dispatch_groups", "block_order", "block_missing",
+                      "wall_s")}
+                    for b in master.repair.status()["batches"][nb0:]
+                ]
                 phase = {
                     "victims": [v.i for v in victims],
                     "heal_seconds": round(time.monotonic() - t0, 1),
                     "coverage_complete": all(
                         coverage(v) == list(range(14)) for v in vids
                     ),
-                    "priority_ok": priority_ok(events),
+                    "priority_ok": priority_ok(batches),
+                    # per-dispatch occupancy: wall_s is the scheduler's
+                    # dispatch->mount wall (the RPC mounts rebuilt shards
+                    # before responding), dispatch_groups the fused decode
+                    # count the target reported
+                    "batches": batches,
+                    "signature_groups_total": sum(
+                        b["signature_groups"] for b in batches
+                    ),
+                    "dispatch_groups_total": sum(
+                        b["dispatch_groups"] for b in batches
+                    ),
                     "events": [
                         {k: e[k] for k in
                          ("seq", "volume_id", "missing", "state", "target")}
@@ -537,6 +572,30 @@ def run_rack_mode(seconds: int) -> int:
                 },
                 "backoffs": _stats.RepairBackoff.value,
             }
+            # fusion accounting vs SOAK_r12: the pre-fusion scheduler paid
+            # one decode dispatch per signature group (dispatch_groups ==
+            # signature_groups); collapsed means every batch here reported
+            # dispatch_groups == 1 while carrying >1 signature overall
+            all_batches = [
+                b
+                for ph in ("phase1_node", "phase2_rack")
+                for b in report.get(ph, {}).get("batches", [])
+            ]
+            report["fusion"] = {
+                "fused_volumes_total":
+                    master.repair.status()["fused_volumes_total"],
+                "signature_groups_total": sum(
+                    b["signature_groups"] for b in all_batches
+                ),
+                "dispatch_groups_total": sum(
+                    b["dispatch_groups"] for b in all_batches
+                ),
+                "collapsed": bool(all_batches) and all(
+                    b["dispatch_groups"] == 1 for b in all_batches
+                ) and sum(b["signature_groups"] for b in all_batches) > sum(
+                    b["dispatch_groups"] for b in all_batches
+                ),
+            }
         finally:
             stop_traffic.set()
             if client is not None:
@@ -555,10 +614,11 @@ def run_rack_mode(seconds: int) -> int:
         and report.get("phase1_node", {}).get("priority_ok", False)
         and report.get("phase2_rack", {}).get("coverage_complete", False)
         and report.get("phase2_rack", {}).get("priority_ok", False)
+        and report.get("fusion", {}).get("collapsed", False)
         and not report.get("placement_violations")
     )
     os.makedirs(ART, exist_ok=True)
-    with open(os.path.join(ART, "SOAK_r12.json"), "w", encoding="utf-8") as f:
+    with open(os.path.join(ART, "SOAK_r13.json"), "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["ok"] else 1

@@ -2022,13 +2022,17 @@ class VolumeServer:
         with ExitStack() as locks, trace_mod.ensure("rebuild.run", klass="maint"):
             trace_mod.annotate(batch=len(vols))
             # per-volume maintenance locks, vid-sorted so concurrent
-            # batches can never deadlock on each other
+            # batches can never deadlock on each other — but PLANNING runs
+            # in request order below: the scheduler sent the batch in
+            # priority order, and job order becomes the block order of the
+            # fused dispatch (2-missing blocks before 1-missing)
             for v in sorted(vols, key=lambda d: int(d["volume_id"])):
+                locks.enter_context(self.maintenance_lock(int(v["volume_id"])))
+            for v in vols:
                 vid = int(v["volume_id"])
                 collection = v.get("collection", "")
                 sources: dict[int, stripe.SlabSource] = {}
                 try:
-                    locks.enter_context(self.maintenance_lock(vid))
                     base = self._base_path_for(vid, collection)
                     self._invalidate_shard_locations(vid)
                     locs = self._lookup_shard_locations(vid)
@@ -2135,10 +2139,17 @@ class VolumeServer:
             self.heartbeat_once()  # rebuilt shards are holders NOW
         except Exception:  # noqa: BLE001 — masters may be mid-chaos
             pass
+        vid_of_base = {b: m["vid"] for b, m in meta.items()}
         return {
             "results": sorted(results, key=lambda r: r["volume_id"]),
             "wire_bytes": total_wire,
             "dispatch_groups": res["dispatch_groups"],
+            "signature_groups": res.get("signature_groups", 0),
+            "volumes_fused": res.get("volumes_fused", 0),
+            "block_order": [
+                vid_of_base[b] for b in res.get("block_order", [])
+                if b in vid_of_base
+            ],
         }
 
     def _plan_trace_groups(

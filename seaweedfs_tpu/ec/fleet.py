@@ -191,6 +191,13 @@ class RepairScheduler:
         self._not_before: dict[int, float] = {}
         self._backoff: dict[int, float] = {}
         self._hist: dict[str, int] = {}
+        #: per-dispatch occupancy records (bounded, newest last): how many
+        #: volumes and signature groups each batch carried, the fused
+        #: dispatch count the target reported, the in-batch block order,
+        #: and the dispatch->response wall — the storm post-mortem data
+        #: RepairStatus serves
+        self._batches: deque = deque(maxlen=256)
+        self._fused_volumes_total = 0
         self._threads: list[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -488,10 +495,12 @@ class RepairScheduler:
 
     def _next_batch(self):
         """Pop the head stripe, choose its domain-compliant rebuild
-        target, and greedily add queued stripes of the SAME priority
-        class that the same target can legally host — one RPC then
-        carries many volumes, and the target fuses equal-signature
-        decodes into shared dispatches."""
+        target, and greedily add queued stripes — ACROSS priority
+        classes — that the same target can legally host.  One RPC then
+        carries the whole settle-window cohort, and the target fuses
+        every signature group into one block-diagonal decode dispatch.
+        Members are added in priority order, so 2-before-1 survives as
+        the batch's BLOCK order rather than as separate rounds."""
         head = self.queue.pop()
         if head is None:
             return None
@@ -503,14 +512,13 @@ class RepairScheduler:
             if len(self.queue) == 1:
                 self._stop.wait(min(nb - now, 0.5))
             return None
-        missing_class = -prio[0]
         nodes, registry, domains, geometry, collections = self._topology_view()
         if not nodes:
             self.queue.update(vid, prio)
             self._stop.wait(1.0)
             return None
 
-        def target_for(v: int):
+        def target_for(v: int, candidates=None):
             holders = registry.get(v) or {}
             geo = geometry.get(v) or {}
             data = int(geo.get("data_shards") or 0) or DATA_SHARDS_COUNT
@@ -518,8 +526,10 @@ class RepairScheduler:
             present = {s for s, urls in holders.items() if urls}
             missing = [s for s in range(total) if s not in present]
             return placement.pick_rebuild_target(
-                nodes, holders, domains, missing, max(1, total - data),
+                nodes if candidates is None else candidates,
+                holders, domains, missing, max(1, total - data),
                 cap_override=self.cap_override,
+                strict=candidates is not None,
             ), len(missing)
 
         target, n_missing = target_for(vid)
@@ -539,15 +549,17 @@ class RepairScheduler:
             ):
                 if len(batch) >= self.batch:
                     break
-                if -p2[0] != missing_class:
-                    break  # strictly lower urgency: later rounds
                 if self._not_before.get(v2, 0.0) > now:
                     continue
-                t2, m2 = target_for(v2)
+                # the head's target joins the batch whenever it can
+                # LEGALLY host this stripe's missing shards — requiring
+                # each stripe's independently-ranked best target to
+                # coincide would split the cohort by load-balance noise
+                t2, m2 = target_for(v2, candidates=[target])
                 if m2 == 0:
                     self.queue.discard(v2)  # healed: nothing to batch
                     continue
-                if t2 is not None and t2["url"] == target["url"]:
+                if t2 is not None:
                     self.queue.discard(v2)
                     batch.append((v2, p2, m2))
         with self._mu:
@@ -565,9 +577,11 @@ class RepairScheduler:
     def _run_batch(self, target: dict, batch: list, vols: list) -> None:
         addr = target["grpc"]
         seqs = {}
+        n_missing_of = {v: n for v, _, n in batch}
         for v, prio, n_missing in batch:
             seqs[v] = self._event("dispatched", v, n_missing, target=addr)
             stats.RepairDispatch.labels(str(n_missing)).inc()
+        t_dispatch = time.monotonic()
         try:
             try:
                 with rpc.RpcClient(addr) as c:
@@ -587,6 +601,25 @@ class RepairScheduler:
             except Exception as e:  # noqa: BLE001 — transport-level failure
                 self._requeue(batch, str(e), transient=True)
                 return
+            wall_s = time.monotonic() - t_dispatch
+            # the RPC mounts rebuilt shards before returning, so this wall
+            # IS dispatch->mount for every volume the batch carried
+            block_order = [int(v) for v in resp.get("block_order", [])]
+            record = {
+                "target": addr,
+                "volumes": len(batch),
+                "signature_groups": int(resp.get("signature_groups", 0)),
+                "dispatch_groups": int(resp.get("dispatch_groups", 0)),
+                "block_order": block_order,
+                "block_missing": [n_missing_of.get(v, 0) for v in block_order],
+                "wall_s": round(wall_s, 6),
+                "t": time.monotonic(),
+            }
+            with self._mu:
+                self._batches.append(record)
+                self._fused_volumes_total += int(resp.get("volumes_fused", 0))
+            stats.RepairFusedVolumes.inc(int(resp.get("volumes_fused", 0)))
+            stats.RepairDispatchGroups.set(int(resp.get("dispatch_groups", 0)))
             results = {
                 int(r.get("volume_id", -1)): r for r in resp.get("results", [])
             }
@@ -680,6 +713,20 @@ class RepairScheduler:
                 a for a, reporters in self._reports.items() if reporters
             )
             inflight = len(self._inflight)
+            batches = [
+                {
+                    "target": b["target"],
+                    "volumes": b["volumes"],
+                    "signature_groups": b["signature_groups"],
+                    "dispatch_groups": b["dispatch_groups"],
+                    "block_order": list(b["block_order"]),
+                    "block_missing": list(b["block_missing"]),
+                    "wall_s": b["wall_s"],
+                    "age_s": round(now - b["t"], 3),
+                }
+                for b in self._batches
+            ]
+            fused_total = self._fused_volumes_total
         return {
             "enabled": True,
             "queue_depth": len(self.queue),
@@ -688,4 +735,6 @@ class RepairScheduler:
             "violations": violations,
             "events": events,
             "suspects": suspects,
+            "batches": batches,
+            "fused_volumes_total": fused_total,
         }

@@ -174,6 +174,7 @@ def pick_rebuild_target(
     *,
     cap_override: int = 0,
     addr_of=None,
+    strict: bool = False,
 ) -> Optional[dict]:
     """Choose the node a whole-stripe rebuild should land on. Rebuilt
     shards all materialize on the target, so the constraint is
@@ -182,7 +183,10 @@ def pick_rebuild_target(
     this stripe's shards (fewest survivor slabs over the wire), then
     the least EC-loaded, then url. Falls back to the least-loaded
     compliant-less node when no rack has headroom (small topologies) —
-    repairing with a violation beats not repairing.
+    repairing with a violation beats not repairing — unless `strict`,
+    which returns None instead of violating (used when probing whether
+    a SPECIFIC node can legally join a batch; the caller has other
+    candidates, so there is no repair-or-nothing tradeoff).
 
     `addr_of(node) -> str` maps a node dict to the url key used in
     `holders` (defaults to node["url"])."""
@@ -208,6 +212,8 @@ def pick_rebuild_target(
         for n in nodes
         if len(per_dom.get(domain_of(n), set()) | set(missing)) <= cap
     ]
+    if strict and not compliant:
+        return None
     pool = compliant or list(nodes)
     return min(pool, key=key)
 

@@ -1192,6 +1192,7 @@ def rebuild_ec_files_batch(
     max_batch_bytes: int = 64 * 1024 * 1024,
     pipeline_depth: Optional[int] = None,
     prefetch_batches: Optional[int] = None,
+    fuse: Optional[bool] = None,
 ) -> dict:
     """MANY volumes' rebuilds through SHARED device dispatches — the
     fleet-repair batch engine (and the PR 9 residual: dp used to shard
@@ -1201,21 +1202,37 @@ def rebuild_ec_files_batch(
     Each job is {"base", "sources" ({shard id -> SlabSource}),
     "shard_size", "missing" (optional)}. Jobs whose (survivor set,
     missing set, geometry) SIGNATURE matches share one fused decode
-    matrix and one staging-ring pipeline in which batches are
-    WIDTH-PACKED across volume boundaries: a batch window fills with
-    volume A's tail and volume B's head side by side (the GF matmul is
-    column-independent, so which volume a column came from is purely a
-    scatter concern at drain time). Small stripes therefore ride full-
-    width dispatches instead of one shallow dispatch per volume.
+    matrix, and batches are WIDTH-PACKED across volume boundaries: a
+    batch window fills with volume A's tail and volume B's head side by
+    side (the GF matmul is column-independent, so which volume a column
+    came from is purely a scatter concern at drain time). Small stripes
+    therefore ride full-width dispatches instead of one shallow dispatch
+    per volume.
 
-    Per-group failure semantics: any failure unlinks EVERY group
-    member's partial outputs and records the error per job; other
-    signature groups still run. Returns
+    With `fuse` (default WEEDTPU_REBUILD_FUSE), DIFFERENT signatures
+    fuse too: every group becomes one BLOCK of a block-diagonal decode
+    (Encoder.reconstruct_block) and the whole heterogeneous cohort runs
+    through ONE staging-ring pipeline — dispatch_groups == 1 for any mix
+    of geometries and loss patterns. Groups keep insertion order, so the
+    caller's job order IS the block order. fuse=False restores one
+    pipeline per signature group (the bench baseline).
+
+    Failure semantics are GROUP-scoped either way: a failure unlinks
+    every partial output of that signature group's members and records
+    the error per job; other groups still run/complete. Returns
       {"rebuilt": {base: [shard ids]}, "errors": {base: str},
-       "dispatch_groups": int}."""
+       "dispatch_groups": int, "signature_groups": int,
+       "volumes_fused": int, "block_order": [base, ...]}."""
     enc_default = encoder
     groups: dict[tuple, list[dict]] = {}
-    out: dict = {"rebuilt": {}, "errors": {}, "dispatch_groups": 0}
+    out: dict = {
+        "rebuilt": {},
+        "errors": {},
+        "dispatch_groups": 0,
+        "signature_groups": 0,
+        "volumes_fused": 0,
+        "block_order": [],
+    }
     for job in jobs:
         enc = job.get("encoder") or enc_default or encoder_for_base(job["base"])
         present = sorted(job["sources"])
@@ -1247,6 +1264,32 @@ def rebuild_ec_files_batch(
     ahead = (
         DEFAULT_PREFETCH_BATCHES if prefetch_batches is None else max(1, int(prefetch_batches))
     )
+    out["signature_groups"] = len(groups)
+    out["block_order"] = [job["base"] for members in groups.values() for job in members]
+    out["volumes_fused"] = len(out["block_order"])
+    if fuse is None:
+        fuse = config.env("WEEDTPU_REBUILD_FUSE") == "on"
+    if fuse and groups:
+        out["dispatch_groups"] = 1
+        glist = list(groups.values())
+        try:
+            rebuilt, errors = _rebuild_fused(
+                glist, depth, ahead, buffer_size, max_batch_bytes
+            )
+            out["rebuilt"].update(rebuilt)
+            out["errors"].update(errors)
+        except BaseException as e:
+            for members in glist:
+                for job in members:
+                    for s in job["missing"]:
+                        try:
+                            os.unlink(shard_file_name(job["base"], s))
+                        except OSError:
+                            pass
+                    out["errors"][job["base"]] = f"{type(e).__name__}: {e}"[:300]
+            if not isinstance(e, Exception):
+                raise
+        return out
     for sig, members in groups.items():
         out["dispatch_groups"] += 1
         try:
@@ -1267,6 +1310,158 @@ def rebuild_ec_files_batch(
                 # per-volume error string while later groups keep running
                 raise
     return out
+
+
+def _rebuild_fused(
+    groups: list[list[dict]], depth: int, ahead: int, buffer_size: int,
+    max_batch_bytes: int,
+) -> tuple[dict, dict]:
+    """The heterogeneous cohort as ONE pipeline: every signature group is a
+    block of a block-diagonal decode, and each staging batch packs blocks'
+    survivor columns side by side — group g's segments stay consecutive
+    inside a batch, so each block is a contiguous column range and the
+    composite's zero blocks never materialize (reconstruct_block dispatches
+    per-block ranges).  Same depth-N inflight deque, per-volume CRC fold,
+    and triple overlap as `_rebuild_group`.
+
+    Group-scoped failure isolation: a survivor-read failure marks ONLY that
+    group failed — its later segments stop staging, its drains stop
+    writing, its partials are unlinked, its members get the error — while
+    every other block keeps flowing through the same dispatches.  Wholesale
+    failures (decode/drain) raise to the caller, which unlinks everything.
+
+    Returns ({base: [rebuilt shard ids]}, {base: error})."""
+    encs = [members[0]["encoder"] for members in groups]
+    base_enc = encs[0]
+    max_k = max(e.data_shards for e in encs)
+    align = max(int(getattr(e, "width_align", 1) or 1) for e in encs)
+    chunks_per_batch = max(1, max_batch_bytes // (max_k * buffer_size))
+    span = _aligned(chunks_per_batch * buffer_size, align)
+    ring = _StagingRing(depth + 1, (max_k, span))
+    flat = [(gi, job) for gi, members in enumerate(groups) for job in members]
+    crcs = [{s: 0 for s in job["missing"]} for _, job in flat]
+    failed: dict[int, str] = {}  # group index -> error string
+    # width-packed segments, (group, member, shard offset, take); iterating
+    # group-major keeps each group's columns consecutive within a batch
+    batches: list[list[tuple[int, int, int, int]]] = []
+    cur: list[tuple[int, int, int, int]] = []
+    room = span
+    for mi, (gi, job) in enumerate(flat):
+        off = 0
+        size = int(job["shard_size"])
+        while off < size:
+            take = min(room, size - off)
+            cur.append((gi, mi, off, take))
+            off += take
+            room -= take
+            if room == 0:
+                batches.append(cur)
+                cur, room = [], span
+    if cur:
+        batches.append(cur)
+    with ExitStack() as stack:
+        outs = [
+            {
+                s: stack.enter_context(open(shard_file_name(job["base"], s), "wb"))
+                for s in job["missing"]
+            }
+            for _, job in flat
+        ]
+        inflight: deque = deque()  # FIFO of (handle, segments)
+
+        def drain_one() -> None:
+            lazy, segs = inflight.popleft()
+            width = sum(t for _, _, _, t in segs)
+            with trace_mod.span("rebuild.drain", width=width):
+                dec = np.asarray(lazy)  # (max_m, span) — the sync point
+                col = 0
+                for gi, mi, off, length in segs:
+                    if gi not in failed:
+                        for k, s in enumerate(flat[mi][1]["missing"]):
+                            row = dec[k, col : col + length]
+                            outs[mi][s].write(row)
+                            crcs[mi][s] = zlib.crc32(row, crcs[mi][s])
+                    col += length
+
+        def issue_prefetch(bi: int) -> None:
+            if bi < len(batches):
+                for gi, mi, off, length in batches[bi]:
+                    if gi in failed:
+                        continue
+                    src = flat[mi][1]["sources"]
+                    for s in flat[mi][1]["survivors"]:
+                        src[s].prefetch(off, length)
+
+        try:
+            for j in range(min(ahead, len(batches))):
+                issue_prefetch(j)
+            for bi, segs in enumerate(batches):
+                issue_prefetch(bi + ahead)
+                while len(inflight) >= depth:
+                    drain_one()
+                width = sum(t for _, _, _, t in segs)
+                blocks: list[dict] = []
+                with trace_mod.span("rebuild.stage", batch=bi, width=width):
+                    staging = ring.take()
+                    col = 0
+                    for gi, mi, off, length in segs:
+                        job = flat[mi][1]
+                        if gi not in failed:
+                            try:
+                                src = job["sources"]
+                                for i, s in enumerate(job["survivors"]):
+                                    src[s].read_into(off, staging[i, col : col + length])
+                            except Exception as e:  # noqa: BLE001
+                                failed[gi] = f"{type(e).__name__}: {e}"[:300]
+                        if gi not in failed:
+                            enc = encs[gi]
+                            if blocks and blocks[-1]["_gi"] == gi:
+                                blocks[-1]["width"] += length
+                            else:
+                                blocks.append({
+                                    "_gi": gi,
+                                    "encoder": enc,
+                                    "survivors": job["survivors"],
+                                    "wanted": job["missing"],
+                                    "col_start": col,
+                                    "width": length,
+                                })
+                        col += length
+                # a read failure may land after its group's block opened:
+                # drop any block of a now-failed group before dispatching
+                blocks = [b for b in blocks if b["_gi"] not in failed]
+                if blocks:
+                    decoded = base_enc.reconstruct_block(staging, blocks)
+                    inflight.append((decoded, segs))
+            while inflight:
+                drain_one()
+        except BaseException:
+            _discard_inflight(inflight)
+            raise
+    rebuilt: dict = {}
+    errors: dict = {}
+    for mi, (gi, job) in enumerate(flat):
+        if gi in failed:
+            for s in job["missing"]:
+                try:
+                    os.unlink(shard_file_name(job["base"], s))
+                except OSError:
+                    pass
+            errors[job["base"]] = failed[gi]
+            continue
+        try:
+            _verify_rebuilt_crcs(job["base"], crcs[mi])
+        except Exception as e:  # noqa: BLE001 — per-volume verify failure
+            # unlinks only that volume; the rest of the cohort is good
+            for s in job["missing"]:
+                try:
+                    os.unlink(shard_file_name(job["base"], s))
+                except OSError:
+                    pass
+            errors[job["base"]] = f"{type(e).__name__}: {e}"[:300]
+            continue
+        rebuilt[job["base"]] = list(job["missing"])
+    return rebuilt, errors
 
 
 def _rebuild_group(

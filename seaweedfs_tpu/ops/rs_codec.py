@@ -159,6 +159,30 @@ def clear_decode_matrix_cache() -> None:
     _reconstruction_matrix.cache_clear()
 
 
+class _FusedBlocks:
+    """Lazy handle for a block-diagonal fused decode on a non-xorsched
+    backend: per-block device dispatches stay in flight until np.asarray()
+    (the one sync point per staging batch, mirroring reconstruct_lazy's
+    contract).  Rows past a block's own output count inside its columns
+    are unspecified, like the materialized form."""
+
+    def __init__(self, shape: tuple[int, int], parts: list):
+        self.shape = shape
+        self._parts = parts  # (rows, col_start, width, backend handle)
+        self._out: Optional[np.ndarray] = None
+
+    def __array__(self, dtype=None, copy=None):
+        if self._out is None:
+            out = np.empty(self.shape, dtype=np.uint8)
+            for rows, c0, w, h in self._parts:
+                out[:rows, c0:c0 + w] = np.asarray(h)[:rows]
+            self._out = out
+            self._parts = []
+        if dtype is not None and dtype != self._out.dtype:
+            return self._out.astype(dtype)
+        return self._out
+
+
 class Encoder:
     """RS(d+p) encoder/reconstructor over GF(2^8).
 
@@ -603,6 +627,100 @@ class Encoder:
         if bucketed:
             return self._apply_bucketed(m, stack)
         return np.asarray(self._apply_lazy(m, stack))
+
+    def reconstruct_block(
+        self,
+        staging: np.ndarray,
+        blocks: Sequence[dict],
+    ):
+        """Block-diagonal fused decode: ONE dispatch over a staging batch
+        that packs MANY signature groups' survivor columns side by side.
+
+        `staging` is a (max_k, W) uint8 matrix; block g is a dict with
+        `survivors` / `wanted` (shard-id sequences), `col_start` / `width`
+        (its column range of the staging batch, disjoint across blocks) and
+        an optional `encoder` (its geometry; defaults to self — this is how
+        converted volumes join the same dispatch).  Block g's survivor rows
+        occupy staging[:k_g, col_start:col_start+width] and its decoded
+        shards land at the same columns of the returned (max_m, W) array,
+        rows [0, len(wanted_g)).  Rows past len(wanted_g) inside a block's
+        columns are UNSPECIFIED (never zeroed — the composite's zero blocks
+        are structural, not materialized).
+
+        GF matmul is column-independent, so packing different volumes'
+        columns into one batch is byte-exact; each block keeps its own
+        LRU'd decode matrix and (on the xorsched backend) its own compiled
+        XOR program — the stitched pass is dispatched as per-block column
+        ranges, never as one giant composite matrix.  Host backends return
+        the materialized ndarray; device backends return a lazy handle
+        whose np.asarray() is the synchronization point, like
+        reconstruct_lazy."""
+        staging = np.asarray(staging, dtype=np.uint8)
+        if staging.ndim != 2:
+            raise ValueError(f"want a 2-D (max_k, W) staging batch, got {staging.shape}")
+        if not blocks:
+            raise ValueError("blocks must name at least one signature group")
+        max_k, width_total = staging.shape
+        spans = []
+        for g, b in enumerate(blocks):
+            enc = b.get("encoder") or self
+            c0, w = int(b["col_start"]), int(b["width"])
+            if w <= 0 or c0 < 0 or c0 + w > width_total:
+                raise ValueError(
+                    f"block {g} columns [{c0}, {c0 + w}) outside staging width {width_total}"
+                )
+            if enc.data_shards > max_k:
+                raise ValueError(
+                    f"block {g} needs {enc.data_shards} survivor rows, staging has {max_k}"
+                )
+            m = enc.reconstruction_matrix(b["survivors"], b["wanted"])
+            spans.append((enc, m, c0, w))
+        by_col = sorted(spans, key=lambda s: s[2])
+        for (_, _, a0, aw), (_, _, b0, _bw) in zip(by_col, by_col[1:]):
+            if a0 + aw > b0:
+                raise ValueError("block column ranges overlap")
+        max_m = max(m.shape[0] for _, m, _, _ in spans)
+        if self.backend == "xorsched":
+            return self._reconstruct_block_xorsched(staging, spans, max_m)
+        # other backends: per-block dispatches (async on device backends,
+        # so blocks overlap in flight; _apply_lazy counts each), one sync
+        # point for the whole batch via the lazy wrapper
+        parts = []
+        for enc, m, c0, w in spans:
+            sub = staging[: enc.data_shards, c0:c0 + w]
+            if self.backend == "mesh":
+                self._count_dispatch()
+                h = self._mesh_dispatch().apply(m, sub, donate=False)
+            else:
+                h = self._apply_lazy(m, sub, donate=False)
+            parts.append((m.shape[0], c0, w, h))
+        return _FusedBlocks((max_m, width_total), parts)
+
+    def _reconstruct_block_xorsched(
+        self, staging: np.ndarray, spans: Sequence[tuple], max_m: int
+    ) -> np.ndarray:
+        """The stitched path: one native (or interpreter) pass over the
+        flat (block, width-tile) task list, each block writing its row
+        slices of the fused output in place."""
+        from seaweedfs_tpu.ops import xorsched
+
+        self._count_dispatch()
+        staging = np.ascontiguousarray(staging)
+        out = np.empty((max_m, staging.shape[1]), dtype=np.uint8)
+        progs, ins, outs = [], [], []
+        for enc, m, c0, w in spans:
+            progs.append(xorsched.get_schedule(m))
+            ins.append([staging[r, c0:c0 + w] for r in range(enc.data_shards)])
+            outs.append([out[r, c0:c0 + w] for r in range(m.shape[0])])
+        xorsched.apply_blocks(progs, ins, outputs_per_block=outs)
+        try:
+            from seaweedfs_tpu import stats
+
+            for event, v in xorsched.schedule_cache_info().items():
+                stats.XorschedCache.labels(event).set(v)
+        except Exception:  # noqa: BLE001 — metrics must never break dispatch
+            pass
+        return out
 
     def _bucket_for(self, n: int) -> Optional[int]:
         if self.backend in ("numpy", "native", "xorsched") or n == 0:

@@ -28,6 +28,14 @@ Two executors share the program:
   where the host has them, AVX2 otherwise, scalar everywhere else).  The
   symbol is version-probed so an old .so quietly yields the interpreter
   instead of crashing.
+
+`apply_blocks` runs MANY programs — a block-diagonal fused decode, one
+block per signature group — as ONE native call
+(`weedtpu_xor_schedule_apply_blocks`): every (block, width-tile) pair is an
+independent task, so the native side spreads the flat task list across a
+thread pool (`WEEDTPU_XORSCHED_THREADS`; width tiles never share output
+bytes).  Each block keeps its own LRU'd per-matrix program — the composite
+is never compiled as one giant matrix.
 """
 
 from __future__ import annotations
@@ -140,6 +148,11 @@ def _group(sets: list[set[int]], n_slots: int) -> tuple[list[tuple[int, int, int
 
 def _default_tile_sym() -> int:
     return config.env("WEEDTPU_XORSCHED_TILE_KB") * 1024
+
+
+def _default_threads() -> int:
+    # 0 = hardware concurrency (resolved by the native executor)
+    return max(0, config.env("WEEDTPU_XORSCHED_THREADS"))
 
 
 def compile_schedule(matrix: np.ndarray, tile_sym: Optional[int] = None) -> XorProgram:
@@ -322,14 +335,7 @@ def native_level() -> str:
     return {2: "gfni", 1: "avx2"}.get(int(lib.weedtpu_xorsched_level()), "scalar")
 
 
-def apply_native(prog: XorProgram, inputs: Sequence[np.ndarray]) -> Optional[list[np.ndarray]]:
-    """Run the schedule through libweedtpu.so; None when the library (or
-    the xorsched entry point — stale .so) is unavailable."""
-    from seaweedfs_tpu.utils import native as native_mod
-
-    lib = native_mod.load()
-    if lib is None or not hasattr(lib, "weedtpu_xor_schedule_apply"):
-        return None
+def _coerce_inputs(prog: XorProgram, inputs: Sequence[np.ndarray]) -> tuple[list[np.ndarray], int]:
     ins = [np.ascontiguousarray(np.frombuffer(i, dtype=np.uint8)) if not isinstance(i, np.ndarray)
            else np.ascontiguousarray(i, dtype=np.uint8) for i in inputs]
     if len(ins) != prog.cols:
@@ -338,9 +344,85 @@ def apply_native(prog: XorProgram, inputs: Sequence[np.ndarray]) -> Optional[lis
     for i in ins:
         if i.shape[0] != n:
             raise ValueError("input shards differ in length")
+    return ins, n
+
+
+def _native_apply_blocks(
+    lib,
+    progs: Sequence[XorProgram],
+    ins_per_block: Sequence[Sequence[np.ndarray]],
+    outs_per_block: Sequence[Sequence[np.ndarray]],
+    lens: Sequence[int],
+    tile_sym: int,
+    threads: int,
+) -> bool:
+    """Marshal the parallel block arrays for `weedtpu_xor_schedule_apply_blocks`.
+    Returns False when the call is rejected (caller falls back)."""
+    nb = len(progs)
+    sched = np.concatenate([np.ascontiguousarray(p.ops, dtype=np.int32) for p in progs])
+    sched_off = np.zeros(nb, dtype=np.uint64)
+    sched_words = np.asarray([p.ops.shape[0] for p in progs], dtype=np.uint64)
+    np.cumsum(sched_words[:-1], out=sched_off[1:])
+    n_slots = np.asarray([p.n_slots for p in progs], dtype=np.uint32)
+    in_planes = np.asarray([p.cols * 8 for p in progs], dtype=np.uint32)
+    out_base = np.asarray([p.out_base for p in progs], dtype=np.uint32)
+    out_planes = np.asarray([p.rows * 8 for p in progs], dtype=np.uint32)
+    ins_off = np.zeros(nb, dtype=np.uint64)
+    in_counts = np.asarray([len(b) for b in ins_per_block], dtype=np.uint64)
+    np.cumsum(in_counts[:-1], out=ins_off[1:])
+    outs_off = np.zeros(nb, dtype=np.uint64)
+    out_counts = np.asarray([len(b) for b in outs_per_block], dtype=np.uint64)
+    np.cumsum(out_counts[:-1], out=outs_off[1:])
+    flat_ins = [a for b in ins_per_block for a in b]
+    flat_outs = [a for b in outs_per_block for a in b]
+    InArr = ctypes.c_char_p * len(flat_ins)
+    OutArr = ctypes.c_void_p * len(flat_outs)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    rc = lib.weedtpu_xor_schedule_apply_blocks(
+        sched.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        sched_off.ctypes.data_as(u64p),
+        sched_words.ctypes.data_as(u64p),
+        n_slots.ctypes.data_as(u32p),
+        in_planes.ctypes.data_as(u32p),
+        out_base.ctypes.data_as(u32p),
+        out_planes.ctypes.data_as(u32p),
+        InArr(*[a.ctypes.data_as(ctypes.c_char_p) for a in flat_ins]),
+        ins_off.ctypes.data_as(u64p),
+        OutArr(*[a.ctypes.data_as(ctypes.c_void_p) for a in flat_outs]),
+        outs_off.ctypes.data_as(u64p),
+        np.asarray(lens, dtype=np.uint64).ctypes.data_as(u64p),
+        ctypes.c_uint32(nb),
+        ctypes.c_uint64(tile_sym),
+        ctypes.c_uint32(threads),
+    )
+    return bool(rc)
+
+
+def apply_native(
+    prog: XorProgram,
+    inputs: Sequence[np.ndarray],
+    threads: Optional[int] = None,
+) -> Optional[list[np.ndarray]]:
+    """Run the schedule through libweedtpu.so; None when the library (or
+    the xorsched entry point — stale .so) is unavailable.  threads > 1
+    routes through the width-parallel blocks entry (n_blocks = 1); the
+    default comes from WEEDTPU_XORSCHED_THREADS."""
+    from seaweedfs_tpu.utils import native as native_mod
+
+    lib = native_mod.load()
+    if lib is None or not hasattr(lib, "weedtpu_xor_schedule_apply"):
+        return None
+    ins, n = _coerce_inputs(prog, inputs)
     # np.empty, not zeros: the backward transpose writes every output byte,
     # and the zeroing pass costs ~15% of the whole apply at these speeds
     outs = [np.empty(n, dtype=np.uint8) for _ in range(prog.rows)]
+    if threads is None:
+        threads = _default_threads()
+    if threads != 1 and hasattr(lib, "weedtpu_xor_schedule_apply_blocks"):
+        if _native_apply_blocks(lib, [prog], [ins], [outs], [n], prog.tile_sym, threads):
+            return outs
+        return None
     ops = np.ascontiguousarray(prog.ops, dtype=np.int32)
     InArr = ctypes.c_char_p * prog.cols
     OutArr = ctypes.c_void_p * prog.rows
@@ -358,6 +440,72 @@ def apply_native(prog: XorProgram, inputs: Sequence[np.ndarray]) -> Optional[lis
     )
     if not rc:
         return None
+    return outs
+
+
+def apply_blocks(
+    progs: Sequence[XorProgram],
+    inputs_per_block: Sequence[Sequence[np.ndarray]],
+    threads: Optional[int] = None,
+    outputs_per_block: Optional[Sequence[Sequence[np.ndarray]]] = None,
+) -> list[list[np.ndarray]]:
+    """Run a block-diagonal set of schedules as one stitched pass.
+
+    Block g applies progs[g] to inputs_per_block[g]; blocks are mutually
+    independent (disjoint columns of the fused decode), so the native
+    executor walks one flat (block, width-tile) task list across
+    `threads` workers (default WEEDTPU_XORSCHED_THREADS; tiles never
+    share output bytes).  Falls back to the per-block interpreter when
+    the native entry point is unavailable.  Byte-identical either way.
+    Blocks may have different lengths but must share tile_sym.
+
+    `outputs_per_block` lets the caller supply the destination arrays —
+    e.g. contiguous row slices of one fused output matrix — which the
+    native executor writes in place (zero-copy stitch); each must be a
+    C-contiguous uint8 array of the block's input length.
+    """
+    if len(progs) != len(inputs_per_block):
+        raise ValueError(f"{len(progs)} programs but {len(inputs_per_block)} input blocks")
+    if not progs:
+        return []
+    tile_sym = progs[0].tile_sym
+    for p in progs:
+        if p.tile_sym != tile_sym:
+            raise ValueError("all blocks must share tile_sym")
+    if threads is None:
+        threads = _default_threads()
+    coerced: list[list[np.ndarray]] = []
+    lens: list[int] = []
+    for prog, inputs in zip(progs, inputs_per_block):
+        ins, n = _coerce_inputs(prog, inputs)
+        coerced.append(ins)
+        lens.append(n)
+    if outputs_per_block is None:
+        outs = [[np.empty(n, dtype=np.uint8) for _ in range(p.rows)]
+                for p, n in zip(progs, lens)]
+    else:
+        if len(outputs_per_block) != len(progs):
+            raise ValueError(f"{len(progs)} programs but {len(outputs_per_block)} output blocks")
+        outs = []
+        for prog, block, n in zip(progs, outputs_per_block, lens):
+            if len(block) != prog.rows:
+                raise ValueError(f"program wants {prog.rows} outputs, got {len(block)}")
+            for a in block:
+                if not (isinstance(a, np.ndarray) and a.dtype == np.uint8
+                        and a.flags.c_contiguous and a.shape == (n,)):
+                    raise ValueError(
+                        "outputs must be C-contiguous uint8 arrays matching the block length"
+                    )
+            outs.append(list(block))
+    from seaweedfs_tpu.utils import native as native_mod
+
+    lib = native_mod.load()
+    if lib is not None and hasattr(lib, "weedtpu_xor_schedule_apply_blocks"):
+        if _native_apply_blocks(lib, progs, coerced, outs, lens, tile_sym, threads):
+            return outs
+    for p, ins, block in zip(progs, coerced, outs):
+        for dst, src in zip(block, apply(p, ins)):
+            np.copyto(dst, src)
     return outs
 
 

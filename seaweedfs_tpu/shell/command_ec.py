@@ -1246,6 +1246,17 @@ def _fleet_risk_lines(env: CommandEnv) -> list[str]:
         + f" queue={st.get('queue_depth', 0)} inflight={st.get('inflight', 0)}"
         + f" stripes[{hist_s}]"
     ]
+    batches = st.get("batches") or []
+    if batches:
+        fused = st.get("fused_volumes_total", 0)
+        last = batches[-1]
+        lines.append(
+            f"fleet: batches={len(batches)} fused_volumes={fused} last["
+            f"volumes={last.get('volumes', 0)}"
+            f" sigs={last.get('signature_groups', 0)}"
+            f" dispatches={last.get('dispatch_groups', 0)}"
+            f" wall={last.get('wall_s', 0.0):.2f}s]"
+        )
     suspects = st.get("suspects") or []
     if suspects:
         lines.append(f"fleet: suspects={' '.join(suspects)}")

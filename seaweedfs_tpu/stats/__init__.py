@@ -451,6 +451,18 @@ RepairBackoff = REGISTRY.counter(
     "RESOURCE_EXHAUSTED (the rebuild admission lane pushing back) or a "
     "transport failure",
 )
+RepairFusedVolumes = REGISTRY.counter(
+    "weedtpu_repair_fused_volumes_total",
+    "volumes whose rebuilds rode a fused batch dispatch (heterogeneous "
+    "block-diagonal decode) — divided by dispatch count this is the "
+    "batch occupancy a storm achieved",
+)
+RepairDispatchGroups = REGISTRY.gauge(
+    "weedtpu_repair_dispatch_groups",
+    "decode dispatch groups the most recent repair batch ran: 1 means "
+    "the whole cohort fused into one block-diagonal dispatch, higher "
+    "values mean per-signature-group dispatches (fusion off or absent)",
+)
 PlacementViolations = REGISTRY.gauge(
     "weedtpu_placement_violations",
     "stripes x domains currently violating the failure-domain invariant "

@@ -521,6 +521,23 @@ register_env(
     parse=_clamped_int(1),
 )
 register_env(
+    "WEEDTPU_XORSCHED_THREADS", int, 1,
+    "Worker threads of the width-parallel native xorsched executor: the "
+    "fused block-diagonal decode flattens to independent (block, "
+    "width-tile) tasks, spread across this many threads. 0 means "
+    "hardware concurrency (resolved natively); 1 keeps the PR 17 "
+    "single-stream path; clamped to >= 0.",
+    parse=_clamped_int(0),
+)
+register_env(
+    "WEEDTPU_REBUILD_FUSE", str, "on",
+    "Heterogeneous rebuild fusion in rebuild_ec_files_batch: 'on' fuses "
+    "ALL signature groups of a batch into one block-diagonal decode "
+    "dispatch (dispatch_groups == 1); 'off' restores the PR 16 "
+    "per-signature-group dispatches (the bench baseline).",
+    parse=_enum("on", "off"),
+)
+register_env(
     "WEEDTPU_REPAIR", str, "off",
     "Master-side fleet repair scheduler: `on` enumerates every stripe "
     "left under-replicated by a dead/quarantined holder, ranks by "

@@ -242,10 +242,10 @@ def _build_volume(dirpath, vid, size, seed):
 
 
 def test_rebuild_batch_width_packs_and_matches_serial(tmp_path):
-    """Three volumes, two sharing a missing signature: the shared pair
-    fuses into ONE dispatch group, batches pack columns across volume
-    boundaries (sizes chosen to not align), and every rebuilt byte
-    matches the encode-time golden."""
+    """Three volumes across two missing signatures: heterogeneous fusion
+    (the default) runs the whole cohort as ONE block-diagonal dispatch,
+    batches pack columns across volume boundaries (sizes chosen to not
+    align), and every rebuilt byte matches the encode-time golden."""
     specs = [
         (21, 333_000, [12, 13]),
         (22, 150_000, [12, 13]),  # same signature as 21 -> same group
@@ -279,7 +279,10 @@ def test_rebuild_batch_width_packs_and_matches_serial(tmp_path):
             for src in job["sources"].values():
                 src.close()
     assert not res["errors"], res["errors"]
-    assert res["dispatch_groups"] == 2
+    assert res["dispatch_groups"] == 1  # heterogeneous fusion: one dispatch
+    assert res["signature_groups"] == 2
+    assert res["volumes_fused"] == 3
+    assert res["block_order"] == [j["base"] for j in jobs]
     for base, (golden, missing) in goldens.items():
         assert sorted(res["rebuilt"][base]) == sorted(missing)
         for s in missing:
@@ -523,6 +526,17 @@ def test_scheduler_end_to_end_two_missing_first(repair_cluster, tmp_path):
     by_vid = {e["volume_id"]: e["missing"] for e in dispatched}
     assert by_vid[22] == 2 and by_vid[21] == 1
     assert any(e["state"] == "done" for e in st["events"])
+    # fusion observability: each dispatched batch left an occupancy record
+    # with 2-before-1 preserved as in-batch block order (block_missing
+    # non-increasing) and the whole batch fused to ONE decode dispatch
+    assert st["batches"], "no per-batch occupancy records"
+    for b in st["batches"]:
+        assert b["dispatch_groups"] == 1
+        assert b["volumes"] == len(b["block_order"]) == len(b["block_missing"])
+        assert b["block_missing"] == sorted(b["block_missing"], reverse=True)
+        assert b["wall_s"] > 0 and b["age_s"] >= 0
+    assert st["fused_volumes_total"] == sum(b["volumes"] for b in st["batches"])
+    assert {v for b in st["batches"] for v in b["block_order"]} >= {21, 22}
     # rebuilt bytes are REAL: every shard of both volumes reads somewhere
     for vid in (21, 22):
         holders = master.topology.lookup_ec_shards(vid)

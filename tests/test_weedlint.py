@@ -130,15 +130,22 @@ def test_wire_drift_fixture_fires():
     # byte-accounting response-key drift both fire
     assert "target_familly" in msgs, findings
     assert "bytes_wrote" in msgs, findings
+    # the rebuild-batch fusion shapes: the fuse mode-switch typo and the
+    # block-order response-key drift both fire
+    assert "'fused'" in msgs, findings
+    assert "blocks_order" in msgs, findings
     # the legitimate reads stay clean: req["volume_id"] (line 12), the
     # extended slab-read shape's projection/projection_rows (lines 17-18),
-    # the inline mode-switch read req.get("inline") (line 31), and the
-    # convert shape's target_family/cutover reads (lines 46-47) — and the
-    # good "mode" response key (lines 33/49) is flagged only for its BAD
-    # sibling keys, never for itself
-    assert not any(f.line in (12, 17, 18, 31, 46, 47) for f in drift), drift
+    # the inline mode-switch read req.get("inline") (line 31), the
+    # convert shape's target_family/cutover reads (lines 46-47), and the
+    # batch shape's volume_ids read (line 65) — and the good "mode"
+    # (lines 33/49) and fusion-accounting response keys (lines 68-69) are
+    # flagged only for their BAD sibling keys, never for themselves
+    assert not any(f.line in (12, 17, 18, 31, 46, 47, 65) for f in drift), drift
     assert "returns key 'mode'" not in msgs, drift
     assert "returns key 'bytes_read'" not in msgs, drift
+    assert "returns key 'dispatch_groups'" not in msgs, drift
+    assert "returns key 'signature_groups'" not in msgs, drift
 
 
 def test_parse_proto_oneof_fields_belong_to_message():
@@ -163,6 +170,11 @@ def test_parse_proto_oneof_fields_belong_to_message():
     }
     assert messages["GenThingResponse"] == {
         "shard_ids", "mode", "inline_rows", "delta_updates"
+    }
+    # the rebuild-batch fusion fixture shapes parse too
+    assert messages["BatchThingRequest"] == {"volume_ids", "fuse"}
+    assert messages["BatchThingResponse"] == {
+        "dispatch_groups", "signature_groups", "volumes_fused", "block_order"
     }
 
 

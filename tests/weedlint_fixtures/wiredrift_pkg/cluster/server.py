@@ -51,3 +51,21 @@ class ConvertServer:
             "bytes_read": len(fam or ""),
             "bytes_wrote": bad,  # BAD: the response field is bytes_written
         }
+
+
+class BatchServer:
+    """Rebuild-batch-fusion-shaped drift: the handler reads the fuse
+    mode-switch via a typo and returns the in-batch block order under a
+    response key the schema does not have."""
+
+    def _build(self, svc):
+        svc.add("RebuildBatch", self._rpc_rebuild_batch)
+
+    def _rpc_rebuild_batch(self, req, ctx):
+        vids = req.get("volume_ids")  # fine: in BatchThingRequest
+        fuse = req["fused"]  # BAD: typo of the fuse mode-switch
+        return {
+            "dispatch_groups": 1 if fuse else len(vids or ()),
+            "signature_groups": len(vids or ()),
+            "blocks_order": list(vids or ()),  # BAD: field is block_order
+        }
