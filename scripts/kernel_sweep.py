@@ -121,6 +121,30 @@ def load_done(
     return done
 
 
+def open_resume_out(out_path: str, resume: bool):
+    """Open the harvest file for the persist discipline. On resume, a
+    crash mid-write leaves a torn tail with no newline; appending straight
+    after it would glue the next record onto the fragment and corrupt
+    BOTH — terminate the tail first (load_done already skips the torn
+    fragment either way)."""
+    out_f = open(out_path, "a" if resume else "w", encoding="utf-8")
+    if resume and out_f.tell() > 0:
+        with open(out_path, "rb") as chk:
+            chk.seek(-1, os.SEEK_END)
+            if chk.read(1) != b"\n":
+                out_f.write("\n")
+                out_f.flush()
+    return out_f
+
+
+def persist_record(out_f, rec: dict) -> None:
+    """One line per config, flushed+fsynced AS IT LANDS: a tunnel wedge
+    one variant later must not cost the results already measured."""
+    out_f.write(json.dumps(rec) + "\n")
+    out_f.flush()
+    os.fsync(out_f.fileno())
+
+
 def _median_time(fn, iters=3, warmup=1):
     for _ in range(warmup):
         fn()
@@ -203,24 +227,11 @@ def main():
     out_f = None
     if out_path:
         os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
-        out_f = open(out_path, "a" if resume else "w", encoding="utf-8")
-        if resume and out_f.tell() > 0:
-            # a crash mid-write leaves a torn tail with no newline; appending
-            # straight after it would glue the next record onto the fragment
-            # and corrupt BOTH — terminate the tail first
-            with open(out_path, "rb") as chk:
-                chk.seek(-1, os.SEEK_END)
-                if chk.read(1) != b"\n":
-                    out_f.write("\n")
-                    out_f.flush()
+        out_f = open_resume_out(out_path, resume)
 
     def persist(rec: dict) -> None:
-        # one line per config, flushed+fsynced AS IT LANDS: a tunnel wedge
-        # one variant later must not cost the results already measured
         if out_f is not None:
-            out_f.write(json.dumps(rec) + "\n")
-            out_f.flush()
-            os.fsync(out_f.fileno())
+            persist_record(out_f, rec)
 
     key = jax.random.PRNGKey(0)
     data = jax.block_until_ready(

@@ -92,6 +92,8 @@ class RaftNode:
         tmp = self._state_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())  # term/vote must survive a crash (election safety)
         os.replace(tmp, self._state_path)
 
     # -- lifecycle ------------------------------------------------------------

@@ -1177,6 +1177,8 @@ class VolumeServer:
                     with open(tmp, "wb") as f:
                         for chunk in chunks:
                             f.write(chunk)
+                        f.flush()
+                        os.fsync(f.fileno())
             for ext, tmp in tmps.items():
                 os.replace(tmp, base + ext)
         finally:
@@ -1598,6 +1600,8 @@ class VolumeServer:
                         with open(tmp, "wb") as f:
                             for chunk in chunks:
                                 f.write(chunk)
+                            f.flush()
+                            os.fsync(f.fileno())
                         os.replace(tmp, base + name)
                     finally:
                         if os.path.exists(tmp):
@@ -2316,6 +2320,8 @@ class VolumeServer:
                         with open(tmp, "wb") as f:
                             for chunk in chunks:
                                 f.write(chunk)
+                            f.flush()
+                            os.fsync(f.fileno())
                         os.replace(tmp, base + ext)
                     finally:
                         if os.path.exists(tmp):

@@ -256,22 +256,36 @@ class _Journal:
 
     @staticmethod
     def read(path: str) -> list[dict]:
+        return _Journal.read_prefix(path)[0]
+
+    @staticmethod
+    def read_prefix(path: str) -> tuple[list[dict], int]:
+        """Records of the VALID journal prefix + its byte length. A torn
+        tail (crash mid-append) is excluded — including a parseable final
+        record with no terminating newline, which a later append would
+        glue into garbage; dropping it costs at most one chunk's
+        re-encode. Resume truncates the file to the returned length
+        before reopening for append (the `.ecp` discipline)."""
         try:
             with open(path, "rb") as f:
                 raw = f.read()
         except OSError:
-            return []
-        out = []
+            return [], 0
+        out: list[dict] = []
+        pos = valid = 0
         for line in raw.split(b"\n"):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                break  # torn tail (crash mid-append): ignore it and stop
-            if isinstance(rec, dict):
-                out.append(rec)
-        return out
+            end = pos + len(line) + 1  # + the newline split() removed
+            if end > len(raw):
+                break  # unterminated tail: never append after it
+            if line.strip():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break
+                if isinstance(rec, dict):
+                    out.append(rec)
+            pos = valid = end
+        return out, valid
 
 
 def _begin_record(
@@ -395,7 +409,7 @@ def convert_ec_files(
 
     # -- resume decision ------------------------------------------------------
     begin = _begin_record(info, src_geom, tgt_geom)
-    records = _Journal.read(jpath)
+    records, journal_valid_bytes = _Journal.read_prefix(jpath)
     resumed = False
     done_large = done_small = 0
     crcs = [0] * total_t
@@ -433,6 +447,17 @@ def convert_ec_files(
         # fresh start: scrub any stale staged output + journal
         discard_staged(base_file_name, keep_journal=False)
         records = []
+    else:
+        # the crash that made this a resume may have left a torn tail
+        # after the last valid record; _Journal.append reopens in 'ab',
+        # so drop the fragment first or the next record glues onto it and
+        # hides every later record (verified/cutover) from readers
+        try:
+            if os.path.getsize(jpath) > journal_valid_bytes:
+                with open(jpath, "r+b") as jf:
+                    jf.truncate(journal_valid_bytes)
+        except OSError:
+            pass
 
     journal = _Journal(jpath)
     written_since_mark = 0

@@ -514,6 +514,8 @@ def write_ec_info(
     tmp = base_file_name + ".eci.tmp"
     with open(tmp, "w") as f:
         json.dump(info, f)
+        f.flush()
+        os.fsync(f.fileno())  # the .eci is load-bearing: geometry + dat_size
     os.replace(tmp, base_file_name + ".eci")
 
 
@@ -1678,12 +1680,19 @@ def write_dat_file(
         dat_file_size, large_block_size, small_block_size, data_shards
     )
 
+    # stage under a dot-tmp name: serving paths discover <base>.dat by
+    # existence, so a crash mid-decode must never leave a torn .dat there
+    tmp_dat = base_file_name + ".dat.tmp"
     with ExitStack() as stack:
+        # no-op after the publishing replace; reaps the stage on any failure
+        stack.callback(
+            lambda: os.path.exists(tmp_dat) and os.remove(tmp_dat)
+        )
         ins = [
             stack.enter_context(open(shard_file_name(base_file_name, s), "rb"))
             for s in range(data_shards)
         ]
-        out = stack.enter_context(open(base_file_name + ".dat", "wb"))
+        out = stack.enter_context(open(tmp_dat, "wb"))
         written = 0
         # large rows
         for row in range(n_large):
@@ -1711,6 +1720,9 @@ def write_dat_file(
                     f"{dat_file_size} — truncated shards or stale size"
                 )
             row += 1
+        out.flush()
+        os.fsync(out.fileno())
+        os.replace(tmp_dat, base_file_name + ".dat")
 
 
 def write_idx_file_from_ec_index(base_file_name: str) -> None:
@@ -1722,7 +1734,8 @@ def write_idx_file_from_ec_index(base_file_name: str) -> None:
         ecx = f.read()
     entries = list(idx_mod.walk_index_buffer(ecx))
     deleted = read_ecj(base_file_name)
-    with open(base_file_name + ".idx", "wb") as out:
+    tmp_idx = base_file_name + ".idx.tmp"
+    with open(tmp_idx, "wb") as out:
         for key, off, size in entries:
             if types.is_deleted(size):
                 out.write(types.pack_index_entry(key, 0, types.TOMBSTONE_FILE_SIZE))
@@ -1730,6 +1743,9 @@ def write_idx_file_from_ec_index(base_file_name: str) -> None:
                 out.write(types.pack_index_entry(key, off, size))
         for key in deleted:
             out.write(types.pack_index_entry(key, 0, types.TOMBSTONE_FILE_SIZE))
+        out.flush()
+        os.fsync(out.fileno())
+    os.replace(tmp_idx, base_file_name + ".idx")
 
 
 # -- .ecj deletion journal ---------------------------------------------------

@@ -97,6 +97,8 @@ class LocalRemoteStorage(RemoteStorageClient):
                     raise IOError(f"short reader for {key}")
                 f.write(chunk)
                 remaining -= len(chunk)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, p)
 
     def read_range(self, key: str, offset: int, size: int) -> bytes:

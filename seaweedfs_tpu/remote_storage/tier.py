@@ -52,6 +52,10 @@ def tier_move(
     tmp = tier_info_path(base_path) + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(info, f)
+        f.flush()
+        # the tierinfo is about to be the ONLY pointer to the moved volume
+        # (local .dat removed below) — it must be durable first
+        os.fsync(f.fileno())
     os.replace(tmp, tier_info_path(base_path))
     if not keep_local:
         os.remove(dat)

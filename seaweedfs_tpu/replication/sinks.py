@@ -55,6 +55,8 @@ class LocalSink(ReplicationSink):
         tmp = p + ".repl"
         with open(tmp, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, p)
 
     def delete(self, key: str, is_dir: bool = False) -> None:

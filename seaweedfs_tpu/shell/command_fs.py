@@ -211,11 +211,21 @@ def do_fs_meta_load(args: list[str], env: CommandEnv, w: TextIO) -> None:
     fc = env.filer_client()
     count = 0
     with open(flags["i"], encoding="utf-8") as f:
-        for line in f:
+        for lineno, line in enumerate(f, start=1):
             line = line.strip()
             if not line:
                 continue
-            fc.create(Entry.from_dict(json.loads(line)))
+            try:
+                d = json.loads(line)
+            except ValueError:
+                # a restore is not a crash-resume: a torn/corrupt dump line
+                # must abort loudly, not be skipped — partial restores are
+                # worse than failed ones
+                raise ShellError(
+                    f"corrupt dump line {lineno} in {flags['i']} — "
+                    f"restore aborted after {count} entries"
+                )
+            fc.create(Entry.from_dict(d))
             count += 1
     w.write(f"loaded {count} entries from {flags['i']}\n")
 

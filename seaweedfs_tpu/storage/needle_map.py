@@ -191,6 +191,8 @@ class SortedFileNeedleMap:
         tmp = self.meta_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"idx_size": idx_size}, f)
+            f.flush()
+            os.fsync(f.fileno())  # watermark vouches for sdx coverage
         os.replace(tmp, self.meta_path)
 
     # -- map interface -------------------------------------------------------

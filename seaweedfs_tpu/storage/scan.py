@@ -152,6 +152,8 @@ def rebuild_idx(base_path: str, verify_crc: bool = True) -> int:
                         types.pack_index_entry(n.id, 0, types.TOMBSTONE_FILE_SIZE)
                     )
                 count += 1
+            out.flush()
+            os.fsync(out.fileno())
     except BaseException:
         try:
             os.remove(tmp)

@@ -409,6 +409,10 @@ class Volume:
                     idxf.write(
                         types.pack_index_entry(key, types.offset_to_bytes(offset), n.size)
                     )
+                dat.flush()
+                os.fsync(dat.fileno())
+                idxf.flush()
+                os.fsync(idxf.fileno())
             self._dat.close()
             self._idx.close()
             os.replace(cpd_dat, self.dat_path)

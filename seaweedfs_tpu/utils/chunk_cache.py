@@ -104,6 +104,8 @@ class ChunkCache:
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path)
                 with self._lock:
                     self._disk_bytes += len(data) - prev

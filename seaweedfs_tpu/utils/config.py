@@ -297,6 +297,26 @@ register_env(
     "acquisition-order graph here as JSON (edges + acquisition sites).",
 )
 register_env(
+    "WEEDTPU_FS_OBSERVE", str, "",
+    "Opt-in filesystem-op recorder (weedsafe dynamic half): the directory "
+    "to observe — write/fsync/rename/unlink ops on paths under it are "
+    "recorded with creation sites for crash-prefix replay (see "
+    "seaweedfs_tpu/analysis/fsrec.py). Empty (default) = off.",
+)
+register_env(
+    "WEEDTPU_FS_OBSERVE_OUT", str, "",
+    "Optional path: an observed session dumps its recorded filesystem op "
+    "trace here as JSON (op kinds, offsets, payload hex, creation sites).",
+)
+register_env(
+    "WEEDTPU_FSREPLAY_MAX_PREFIXES", int, 48,
+    "Crash-prefix replay budget per recorded workload: at most this many "
+    "prefixes of the op trace are materialized and driven through the "
+    "real resume entrypoints (evenly sampled, endpoints always kept) so "
+    "the tier-1 replay gate stays inside its time budget. <=0 = every "
+    "prefix.",
+)
+register_env(
     "WEEDTPU_HEDGE_READS", bool, True,
     "Hedged degraded-read shard fetches: once a survivor fetch has run "
     "past the per-peer EWMA-derived hedge delay, launch ONE backup fetch "

@@ -28,6 +28,18 @@ if _weedtpu_config.env("WEEDTPU_LOCK_OBSERVE"):
 
     _LOCK_RECORDER = _lockrec.install()
 
+# Opt-in filesystem-op recorder (WEEDTPU_FS_OBSERVE=<dir>): interpose the
+# weedsafe recording shims over open/os.fsync/rename/unlink for paths
+# under the named directory — the dynamic half of the durability family.
+# The replay tests install their own scoped recorders; this session-level
+# hook exists to capture traces from ad-hoc runs for offline inspection.
+_FS_RECORDER = None
+_fs_observe_root = _weedtpu_config.env("WEEDTPU_FS_OBSERVE")
+if _fs_observe_root:
+    from seaweedfs_tpu.analysis import fsrec as _fsrec
+
+    _FS_RECORDER = _fsrec.install(_fs_observe_root)
+
 # The axon sitecustomize (interpreter start) calls
 # jax.config.update("jax_platforms", "axon,cpu"), which outranks the env var —
 # push it back to cpu before any backend initializes.
@@ -36,6 +48,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
+    )
 
 
 @pytest.fixture(autouse=True)
@@ -69,6 +87,10 @@ def pytest_sessionfinish(session, exitstatus):
     """Instrumented-lock gate: the tier-1 run's OBSERVED lock-order graph
     (package locks only — jax/stdlib internals order their own locks)
     must be acyclic, or the session fails even with every test green."""
+    if _FS_RECORDER is not None:
+        fs_out = _weedtpu_config.env("WEEDTPU_FS_OBSERVE_OUT")
+        if fs_out:
+            _FS_RECORDER.trace().dump(fs_out)
     if _LOCK_RECORDER is None:
         return
     out_path = _weedtpu_config.env("WEEDTPU_LOCK_OBSERVE_OUT")

@@ -1,0 +1,571 @@
+"""weedsafe crash-prefix replay — the dynamic half of the durability
+family. Record every filesystem op of a real journaled workload (the
+`analysis.fsrec` shims), then for every sampled crash prefix x variant
+(clean/torn/lost tail) materialize the post-crash tree into a scratch
+dir and drive the REAL resume entrypoint, asserting it either resumes
+byte-identical to the warm path or refuses cleanly — never serves or
+commits corrupt bytes.
+
+Covers all four journal formats in the tree:
+  .ecp  inline-ingest journal   -> InlineStripeBuilder.resume + seal
+  .ecc  convert journal         -> convert_ec_files resume + cutover
+  scrub cursor JSON             -> ScrubCursor load (fresh-or-saved)
+  kernel_sweep harvest JSONL    -> load_done record recovery
+
+Replayer primitives (trace determinism, torn/lost tail synthesis, prefix
+byte accounting, schedule sampling) and a planted fsync-removal
+regression (the harness must CATCH a deliberately broken watermark
+protocol) ride along."""
+
+import json
+import os
+import sys
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.analysis import fsrec
+from seaweedfs_tpu.ec import convert, ingest, scrub, stripe
+from seaweedfs_tpu.ec.constants import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.ops.rs_codec import Encoder, geometry_for
+from seaweedfs_tpu.utils import config
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+import kernel_sweep as ks  # noqa: E402
+
+sys.path.pop(0)
+
+ENC = Encoder(10, 4, backend="numpy")
+LARGE, SMALL, BUF = 8192, 2048, 2048
+LARGE_ROW = LARGE * 10
+
+
+# -- record / replay drivers --------------------------------------------------
+
+
+def _record(root, workload) -> fsrec.FsTrace:
+    rec = fsrec.install(str(root))
+    try:
+        workload()
+    finally:
+        trace = rec.trace()
+        fsrec.uninstall()
+    return trace
+
+
+def _dedup_key(state: dict) -> tuple:
+    return tuple(sorted((p, len(b), zlib.crc32(b)) for p, b in state.items()))
+
+
+def _replay(trace, scratch_root, check, extra_prefixes=()):
+    """Drive `check(scratch_dir, n_ops, variant)` over the sampled prefix
+    schedule x crash variants (deduping identical post-crash states —
+    many prefixes between durability points settle to the same bytes).
+    `extra_prefixes` pins known-interesting crash points the even sample
+    might skip. Returns the list of check results."""
+    sched = set(
+        fsrec.prefix_schedule(
+            len(trace.ops), int(config.env("WEEDTPU_FSREPLAY_MAX_PREFIXES"))
+        )
+    )
+    sched.update(extra_prefixes)
+    seen, outcomes, n_dirs = set(), [], 0
+    for n in sorted(sched):
+        for variant in fsrec.VARIANTS:
+            state = fsrec.simulate_prefix(trace, n, variant)
+            key = _dedup_key(state)
+            if key in seen:
+                continue
+            seen.add(key)
+            dest = os.path.join(str(scratch_root), f"p{n_dirs}")
+            n_dirs += 1
+            os.makedirs(dest)
+            for rel, data in state.items():
+                p = os.path.join(dest, rel)
+                d = os.path.dirname(p)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                with open(p, "wb") as f:
+                    f.write(data)
+            outcomes.append(check(dest, n, variant))
+    return outcomes
+
+
+# -- ingest: the .ecp journal -------------------------------------------------
+
+
+def _warm_oracle(cache_root, cache: dict, dat_bytes: bytes) -> str:
+    """Warm write_ec_files reference for exactly these .dat bytes,
+    memoized — many crash prefixes settle to the same .dat content."""
+    key = (len(dat_bytes), zlib.crc32(dat_bytes))
+    if key not in cache:
+        wbase = os.path.join(str(cache_root), f"w{len(cache)}", "v")
+        os.makedirs(os.path.dirname(wbase))
+        with open(wbase + ".dat", "wb") as f:
+            f.write(dat_bytes)
+        stripe.write_ec_files(
+            wbase, large_block_size=LARGE, small_block_size=SMALL,
+            buffer_size=BUF, encoder=ENC,
+        )
+        cache[key] = wbase
+    return cache[key]
+
+
+def _assert_matches_warm(base: str, wbase: str, ctx: str) -> None:
+    for s in range(TOTAL_SHARDS_COUNT):
+        with open(stripe.shard_file_name(base, s), "rb") as f:
+            got = f.read()
+        with open(stripe.shard_file_name(wbase, s), "rb") as f:
+            want = f.read()
+        assert got == want, f"{ctx}: shard {s} differs from warm re-encode"
+    with open(base + ".eci", "rb") as f, open(wbase + ".eci", "rb") as g:
+        assert f.read() == g.read(), f"{ctx}: .eci differs from warm re-encode"
+
+
+def test_ingest_journal_crash_prefix_replay(tmp_path, monkeypatch):
+    """Every crash prefix of a full inline-ingest life (bursty appends +
+    polls, a journaled delta overwrite, seal) resumes byte-identical to
+    warm write_ec_files on whatever .dat survived, or refuses (resume ->
+    None) and the warm fallback covers it. The mid-overwrite torn-write
+    prefix — .dat matching neither the old nor the new intent bytes —
+    must land on the refuse path."""
+    t0 = time.monotonic()
+    monkeypatch.setenv("WEEDTPU_INLINE_EC_DELTA", "1")
+    work = tmp_path / "work"
+    work.mkdir()
+    base = os.path.join(str(work), "7")
+    n_bytes = LARGE_ROW + SMALL * 10 + 617
+    data = np.random.default_rng(7).integers(
+        0, 256, n_bytes, dtype=np.uint8
+    ).tobytes()
+    ow_off, ow_len = 96, 64
+    old_seg = data[ow_off : ow_off + ow_len]
+    new_seg = bytes(b ^ 0xFF for b in old_seg)  # differs in EVERY byte:
+    # a torn half-write can match neither old nor new
+
+    def workload():
+        # superblock prefix BEFORE the builder: the journal pins dat_rev
+        # (bytes 4:6), so the pin must be durable when `begin` is journaled
+        with open(base + ".dat", "wb") as f:
+            f.write(data[:32])
+            f.flush()
+            os.fsync(f.fileno())
+        b = ingest.InlineStripeBuilder(base, ENC, LARGE, SMALL, buffer_size=BUF)
+        with open(base + ".dat", "ab") as f:
+            for off in range(32, n_bytes, 30_000):
+                f.write(data[off : off + 30_000])
+                f.flush()
+                os.fsync(f.fileno())
+                b.poll()
+
+        def mutate():
+            with open(base + ".dat", "r+b") as g:
+                g.seek(ow_off)
+                g.write(new_seg)
+                g.flush()
+                os.fsync(g.fileno())
+
+        b.overwrite(ow_off, old_seg, new_seg, mutate=mutate)
+        b.seal()
+
+    trace = _record(work, workload)
+
+    # pin the crash point INSIDE the overwrite mutation: first .dat write
+    # after the journaled "ow" intent record
+    ow_idx = next(
+        i for i, op in enumerate(trace.ops)
+        if op.kind == "write" and op.path.endswith(".ecp") and b'"ow"' in op.data
+    )
+    mutate_idx = next(
+        i for i, op in enumerate(trace.ops[ow_idx + 1 :], start=ow_idx + 1)
+        if op.kind == "write" and op.path.endswith(".dat")
+    )
+
+    oracles = tmp_path / "oracles"
+    oracles.mkdir()
+    cache: dict = {}
+
+    def check(dest, n, variant):
+        sb = os.path.join(dest, "7")
+        has_dat = os.path.exists(sb + ".dat")
+        b = ingest.InlineStripeBuilder.resume(sb, ENC, LARGE, SMALL, buffer_size=BUF)
+        ctx = f"{variant} prefix {n}"
+        if b is not None:
+            b.seal()
+            with open(sb + ".dat", "rb") as f:
+                dat = f.read()
+            _assert_matches_warm(sb, _warm_oracle(oracles, cache, dat), ctx)
+            return ("resumed", n, variant)
+        if not has_dat:
+            return ("no-dat", n, variant)
+        with open(sb + ".dat", "rb") as f:
+            dat = f.read()
+        if len(dat) == 0:
+            return ("empty-dat", n, variant)
+        # refused: the warm fallback re-encodes from the durable .dat
+        ingest._cleanup_partials(sb)
+        stripe.write_ec_files(
+            sb, large_block_size=LARGE, small_block_size=SMALL,
+            buffer_size=BUF, encoder=ENC,
+        )
+        _assert_matches_warm(sb, _warm_oracle(oracles, cache, dat), ctx)
+        return ("warm", n, variant)
+
+    outcomes = _replay(trace, tmp_path / "replay", check,
+                       extra_prefixes={mutate_idx + 1})
+    kinds = [o[0] for o in outcomes]
+    assert "resumed" in kinds, kinds
+    assert "warm" in kinds, kinds
+    # the torn mid-mutation .dat is unresolvable — must refuse, never patch
+    assert ("warm", mutate_idx + 1, "torn") in outcomes, outcomes
+    assert time.monotonic() - t0 < 30.0
+
+
+# -- convert: the .ecc journal ------------------------------------------------
+
+
+def test_convert_journal_crash_prefix_replay(tmp_path):
+    """Every crash prefix of convert + cutover re-drives convert_ec_files
+    (the documented recovery entrypoint) to a fully cut-over volume whose
+    shards are byte-identical to the decode->re-encode oracle. Pinned
+    prefixes guarantee the journal-watermark resume and the mid-swap
+    finish_cutover windows are both exercised."""
+    t0 = time.monotonic()
+    CL, CS, FAM = 4096, 512, "cauchy_12_3"
+    enc = Encoder(10, 4, matrix_kind="vandermonde", backend="numpy")
+    work = tmp_path / "work"
+    work.mkdir()
+    base = os.path.join(str(work), "1")
+    data = np.random.default_rng(3).integers(0, 256, 20000, dtype=np.uint8).tobytes()
+    with open(base + ".dat", "wb") as f:
+        f.write(data)
+    stripe.write_ec_files(
+        base, large_block_size=CL, small_block_size=CS, buffer_size=CS, encoder=enc
+    )
+    os.unlink(base + ".dat")  # conversions stream the virtual dat
+
+    geom = geometry_for(FAM)
+    ob = os.path.join(str(tmp_path), "oracle", "1")
+    os.makedirs(os.path.dirname(ob))
+    with open(ob + ".dat", "wb") as f:
+        f.write(data)
+    stripe.write_ec_files(
+        ob, large_block_size=CL, small_block_size=CS, buffer_size=CS,
+        encoder=Encoder(
+            geom.data_shards, geom.parity_shards,
+            matrix_kind=geom.matrix_kind, backend="numpy",
+        ),
+    )
+
+    def convert_once(b):
+        return convert.convert_ec_files(
+            b, FAM, encoder=Encoder(10, 4, matrix_kind="vandermonde", backend="numpy"),
+            buffer_size=CS, journal_bytes=2048, verify=True,
+        )
+
+    def workload():
+        convert_once(base)
+        convert.cutover(base)
+
+    trace = _record(work, workload)
+
+    def after_record(tag: bytes) -> int:
+        i = next(
+            k for k, op in enumerate(trace.ops)
+            if op.kind == "write" and op.path.endswith(".ecc") and tag in op.data
+        )
+        assert trace.ops[i + 2].kind == "fsync", trace.ops[i : i + 3]
+        return i + 3  # write, flush, fsync — record durable, nothing after
+
+    extra = {after_record(b'"watermark"'), after_record(b'"cutover"')}
+
+    def check(dest, n, variant):
+        sb = os.path.join(dest, "1")
+        res = convert_once(sb)
+        if res["mode"] in ("converted", "resumed"):
+            convert.cutover(sb)
+        ctx = f"{variant} prefix {n}"
+        info = stripe.read_ec_info(sb)
+        assert info is not None, f"{ctx}: cut-over volume lost its .eci"
+        assert stripe.geometry_from_info(info).family == FAM, ctx
+        assert not convert.pending_cutover(sb), f"{ctx}: swap left unfinished"
+        for s in range(geom.total_shards):
+            with open(stripe.shard_file_name(sb, s), "rb") as f:
+                got = f.read()
+            with open(stripe.shard_file_name(ob, s), "rb") as f:
+                want = f.read()
+            assert got == want, f"{ctx}: shard {s} differs from oracle"
+        return res["mode"]
+
+    modes = _replay(trace, tmp_path / "replay", check, extra_prefixes=extra)
+    assert "resumed" in modes, modes   # a journal-watermark resume ran
+    assert "cutover" in modes, modes   # a mid-swap prefix was finished
+    assert "noop" in modes, modes      # the complete trace needs nothing
+    assert time.monotonic() - t0 < 30.0
+
+
+# -- scrub cursor -------------------------------------------------------------
+
+
+def test_scrub_cursor_crash_prefix_replay(tmp_path):
+    """Every crash prefix of a point/save/quarantine sequence loads as
+    either fresh zeros or EXACTLY one of the states save() persisted —
+    the tmp+fsync+replace discipline never exposes a torn cursor."""
+    work = tmp_path / "work"
+    work.mkdir()
+    cpath = os.path.join(str(work), "scrub_cursor.json")
+    saved = []
+
+    def workload():
+        cur = scrub.ScrubCursor(cpath)
+        for i in range(1, 6):
+            cur.point(i, i % 14, i * 1000, i * 7)
+            cur.save()
+            saved.append((i, i % 14, i * 1000, i * 7, 0, ()))
+        cur.add_quarantine(3, 5, "crc-mismatch")  # saves immediately
+        saved.append((5, 5 % 14, 5000, 35, 0, ((3, 5),)))
+
+    trace = _record(work, workload)
+    fresh = (0, 0, 0, 0, 0, ())
+    allowed = {fresh, *saved}
+    states = set()
+
+    def check(dest, n, variant):
+        cur = scrub.ScrubCursor(os.path.join(dest, "scrub_cursor.json"))
+        got = (
+            cur.vid, cur.shard, cur.offset, cur.crc, cur.cycles,
+            tuple((q["vid"], q["shard"]) for q in cur.quarantine),
+        )
+        assert got in allowed, (
+            f"{variant} prefix {n}: cursor loaded state {got} that was "
+            f"never saved"
+        )
+        states.add(got)
+        return got
+
+    _replay(trace, tmp_path / "replay", check)
+    assert fresh in states
+    assert len(states & set(saved)) >= 2  # real mid-sequence resumes seen
+
+
+# -- kernel_sweep harvest JSONL ----------------------------------------------
+
+
+def test_kernel_sweep_harvest_crash_prefix_replay(tmp_path):
+    """Every crash prefix of a persist-per-record harvest (including a
+    close + resume-reopen cycle) loads as an exact subset of the records
+    actually persisted — a torn tail is skipped, never merged into a
+    neighbouring record."""
+    work = tmp_path / "work"
+    work.mkdir()
+    out = os.path.join(str(work), "harvest.jsonl")
+    recs = [
+        {"variant": f"v{i}", "platform": "cpu", "tiny": False, "steady_gbps": float(i)}
+        for i in range(5)
+    ]
+
+    def workload():
+        f = ks.open_resume_out(out, resume=False)
+        for r in recs[:3]:
+            ks.persist_record(f, r)
+        f.close()
+        f = ks.open_resume_out(out, resume=True)
+        for r in recs[3:]:
+            ks.persist_record(f, r)
+        f.close()
+
+    trace = _record(work, workload)
+    by_name = {r["variant"]: r for r in recs}
+    counts = set()
+
+    def check(dest, n, variant):
+        done = ks.load_done(
+            os.path.join(dest, "harvest.jsonl"), platform="cpu", tiny=False
+        )
+        for name, rec in done.items():
+            assert by_name.get(name) == rec, (
+                f"{variant} prefix {n}: harvest recovered a record that was "
+                f"never persisted: {rec}"
+            )
+        counts.add(len(done))
+        return len(done)
+
+    _replay(trace, tmp_path / "replay", check)
+    # each fsync'd record becomes recoverable exactly once, in order
+    assert counts >= {0, 1, 2, 3, 4, 5}
+
+
+def test_open_resume_out_terminates_torn_tail(tmp_path):
+    """Resuming over a harvest file whose last line is torn (crash
+    mid-write, no newline) must not glue the next record onto the
+    fragment — both the fragment's neighbours stay recoverable."""
+    out = os.path.join(str(tmp_path), "h.jsonl")
+    whole = {"variant": "v0", "platform": "cpu", "tiny": False}
+    with open(out, "w", encoding="utf-8") as f:
+        f.write(json.dumps(whole) + "\n")
+        f.write('{"variant": "torn-v1", "plat')  # torn: no newline
+    f2 = ks.open_resume_out(out, resume=True)
+    fresh = {"variant": "v2", "platform": "cpu", "tiny": False}
+    ks.persist_record(f2, fresh)
+    f2.close()
+    done = ks.load_done(out, platform="cpu", tiny=False)
+    assert done == {"v0": whole, "v2": fresh}
+
+
+# -- replayer primitives ------------------------------------------------------
+
+
+def _simple_workload(root):
+    a = os.path.join(str(root), "a.bin")
+    with open(a, "wb") as f:
+        f.write(b"0123456789")
+        f.flush()
+        os.fsync(f.fileno())
+    with open(a, "r+b") as f:
+        f.seek(2)
+        f.write(b"XY")
+        f.truncate(6)
+    os.replace(a, os.path.join(str(root), "b.bin"))
+    with open(os.path.join(str(root), "c.bin"), "wb") as f:
+        f.write(b"unsynced-tail!")
+    os.unlink(os.path.join(str(root), "b.bin"))
+
+
+def test_trace_determinism(tmp_path):
+    """Two identical workloads record identical op sequences (up to
+    creation sites) — replay coverage is reproducible, not load-bearing
+    on dict ordering or handle identity."""
+    traces = []
+    for name in ("one", "two"):
+        d = tmp_path / name
+        d.mkdir()
+        traces.append(_record(d, lambda d=d: _simple_workload(d)))
+    assert traces[0].ops, "recorder captured nothing"
+    assert [op.sig() for op in traces[0].ops] == [op.sig() for op in traces[1].ops]
+
+
+def test_torn_and_lost_tail_synthesis(tmp_path):
+    d = tmp_path / "w"
+    d.mkdir()
+
+    def wl():
+        with open(os.path.join(str(d), "t.bin"), "wb") as f:
+            f.write(b"0123456789")  # never fsynced
+
+    trace = _record(d, wl)
+    n = len(trace.ops)
+    assert fsrec.simulate_prefix(trace, n, "clean")["t.bin"] == b"0123456789"
+    assert fsrec.simulate_prefix(trace, n, "torn")["t.bin"] == b"01234"
+    assert fsrec.simulate_prefix(trace, n, "lost")["t.bin"] == b""
+    with pytest.raises(ValueError, match="unknown variant"):
+        fsrec.simulate_prefix(trace, n, "half-torn")
+
+
+def test_prefix_byte_accounting(tmp_path):
+    d = tmp_path / "w"
+    d.mkdir()
+    p = os.path.join(str(d), "a.bin")
+
+    def wl():
+        with open(p, "wb") as f:
+            f.write(b"abcdef")
+            f.flush()
+            os.fsync(f.fileno())
+        with open(p, "r+b") as f:
+            f.seek(2)
+            f.write(b"XY")
+            f.truncate(4)
+
+    trace = _record(d, wl)
+    n = len(trace.ops)
+    # the fsync'd base survives every variant; the unsynced patch+truncate
+    # tail survives clean and torn (truncate is metadata: never "half")
+    assert fsrec.simulate_prefix(trace, n, "lost")["a.bin"] == b"abcdef"
+    assert fsrec.simulate_prefix(trace, n, "clean")["a.bin"] == b"abXY"
+    assert fsrec.simulate_prefix(trace, n, "torn")["a.bin"] == b"abXY"
+    # prefix ending right at the fsync: only the durable base exists
+    k = next(i for i, op in enumerate(trace.ops) if op.kind == "fsync") + 1
+    for v in fsrec.VARIANTS:
+        assert fsrec.simulate_prefix(trace, k, v)["a.bin"] == b"abcdef"
+    # prefix ending right after the 2-byte patch write: torn applies half
+    w = next(
+        i for i, op in enumerate(trace.ops)
+        if op.kind == "write" and op.data == b"XY"
+    ) + 1
+    assert fsrec.simulate_prefix(trace, w, "torn")["a.bin"] == b"abXdef"
+    assert fsrec.simulate_prefix(trace, w, "clean")["a.bin"] == b"abXYef"
+    assert fsrec.simulate_prefix(trace, w, "lost")["a.bin"] == b"abcdef"
+
+
+def test_prefix_schedule_sampling():
+    assert fsrec.prefix_schedule(5, 0) == [0, 1, 2, 3, 4, 5]  # <=0: every prefix
+    assert fsrec.prefix_schedule(5, 100) == [0, 1, 2, 3, 4, 5]
+    s = fsrec.prefix_schedule(1000, 48)
+    assert s[0] == 0 and s[-1] == 1000
+    assert len(s) <= 48 and s == sorted(set(s))
+    assert fsrec.prefix_schedule(7, 1) == [7]
+
+
+# -- planted regression: the harness must catch a removed fsync ---------------
+
+
+def _watermark_workload(root, broken: bool):
+    """Miniature of the ingest watermark discipline: part bytes, (fsync),
+    then a journaled watermark vouching for them. `broken=True` removes
+    the part fsync — the classic record-before-fsync protocol hole."""
+    part = os.path.join(str(root), "x.part")
+    jrn = os.path.join(str(root), "x.journal")
+    payload = bytes(range(64))
+    jf = open(jrn, "ab")
+    try:
+        with open(part, "ab") as pf:
+            for i in range(4):
+                pf.write(payload)
+                pf.flush()
+                if not broken:
+                    os.fsync(pf.fileno())
+                jf.write(
+                    json.dumps({"kind": "rows", "bytes": (i + 1) * 64}).encode()
+                    + b"\n"
+                )
+                jf.flush()
+                os.fsync(jf.fileno())
+    finally:
+        jf.close()
+
+
+def _watermark_violations(trace) -> int:
+    viol = 0
+    for n in fsrec.prefix_schedule(len(trace.ops), 0):
+        for variant in fsrec.VARIANTS:
+            state = fsrec.simulate_prefix(trace, n, variant)
+            vouched = 0
+            for line in state.get("x.journal", b"").split(b"\n"):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail vouches for nothing
+                vouched = max(vouched, int(rec.get("bytes", 0)))
+            if len(state.get("x.part", b"")) < vouched:
+                viol += 1
+    return viol
+
+
+def test_planted_fsync_removal_is_caught(tmp_path):
+    good = tmp_path / "good"
+    good.mkdir()
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    tg = _record(good, lambda: _watermark_workload(good, broken=False))
+    tb = _record(bad, lambda: _watermark_workload(bad, broken=True))
+    assert _watermark_violations(tg) == 0, (
+        "fsync-then-record protocol flagged a false violation"
+    )
+    assert _watermark_violations(tb) > 0, (
+        "replayer missed a journal watermark vouching for undurable bytes"
+    )

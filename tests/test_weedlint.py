@@ -504,3 +504,67 @@ def test_graph_cycle_detection():
     assert graph_mod.cyclic_components(edges) == [["a", "b", "c"]]
     assert graph_mod.cyclic_components({"x": {"x"}}) == [["x"]]
     assert graph_mod.cyclic_components({"x": {"y"}}) == []
+
+
+# -- durability family --------------------------------------------------------
+
+DURABILITY_RULES = (
+    "fsync-missing-before-rename",
+    "record-before-fsync",
+    "tmp-visible-name",
+    "torn-tail-unhandled",
+)
+
+
+def test_durability_fixture_fires_each_rule_on_marked_line():
+    """Every durability rule fires exactly on its `MARK <rule>` line in
+    the planted fixture, and the good_* twins stay clean (exact-count
+    check: no extra findings anywhere else in the file)."""
+    findings = [
+        f
+        for f in run(paths=[fixture("durability_bad.py")])
+        if f.rule in DURABILITY_RULES
+    ]
+    with open(fixture("durability_bad.py"), encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    expected = {
+        (rule, i + 1)
+        for i, line in enumerate(lines)
+        for rule in DURABILITY_RULES
+        if f"MARK {rule}" in line
+    }
+    assert len(expected) == len(DURABILITY_RULES)
+    assert {(f.rule, f.line) for f in findings} == expected, findings
+
+
+def test_durability_rules_in_catalog():
+    for rule in DURABILITY_RULES:
+        assert rule in RULES
+
+
+# -- per-file parse cache -----------------------------------------------------
+
+
+def test_parse_cache_reuses_context_and_invalidates(tmp_path):
+    """load_files returns the SAME FileContext for an unchanged file (one
+    ast.parse per file per CI run, not per checker invocation) and
+    re-parses when content changes."""
+    from seaweedfs_tpu.analysis import load_files
+
+    p = tmp_path / "m.py"
+    p.write_text("import json\nx = 1\n")
+    (a,), _ = load_files([str(p)])
+    (b,), _ = load_files([str(p)])
+    assert a is b
+    p.write_text("import json\nxx = 22  # longer\n")
+    (c,), _ = load_files([str(p)])
+    assert c is not b
+
+
+def test_parse_cache_resets_suppression_state():
+    """A cached FileContext is shared across runs; suppression used-flags
+    must reset on reuse or the second strict run would mis-report
+    unused-suppression findings."""
+    first = {(f.rule, f.line) for f in run(paths=[fixture("suppressed.py")], strict=True)}
+    second = {(f.rule, f.line) for f in run(paths=[fixture("suppressed.py")], strict=True)}
+    assert first == second

@@ -1,0 +1,54 @@
+"""Planted durability-protocol violations: every rule in weedlint's
+`durability` family must fire exactly on its marked line here, and the
+`good_*` twins must stay clean. Never imported — parsed by weedlint only.
+"""
+
+import json
+import os
+
+
+def bad_rename(path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("state")
+    os.replace(tmp, path)  # MARK fsync-missing-before-rename
+
+
+def good_rename(path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("state")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def bad_record(journal, shard):
+    journal.append({"kind": "rows", "rows": 3})  # MARK record-before-fsync
+
+
+def good_record(journal, shard):
+    os.fsync(shard.fileno())
+    journal.append({"kind": "rows", "rows": 3})
+
+
+def bad_visible(base):
+    with open(base + ".dat", "wb") as f:  # MARK tmp-visible-name
+        f.write(b"x")
+
+
+def bad_torn(f):
+    out = []
+    for line in f:
+        out.append(json.loads(line))  # MARK torn-tail-unhandled
+    return out
+
+
+def good_torn(f):
+    out = []
+    for line in f:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            break
+    return out
